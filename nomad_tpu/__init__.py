@@ -1,0 +1,21 @@
+"""nomad_tpu — a TPU-native cluster scheduler with the capabilities of
+HashiCorp Nomad (v0.1.2-era reference).
+
+The package mirrors the reference's layering (see SURVEY.md):
+
+- ``nomad_tpu.structs``   — data model (Job/Node/Allocation/Evaluation/Plan),
+  reference: /root/reference/nomad/structs/structs.go
+- ``nomad_tpu.state``     — in-memory MVCC state store with snapshots + watch,
+  reference: /root/reference/nomad/state/state_store.go
+- ``nomad_tpu.scheduler`` — pure-logic schedulers behind a Factory registry,
+  reference: /root/reference/scheduler/
+- ``nomad_tpu.ops``       — the TPU compute path: dense constraint-mask +
+  argmax bin-pack kernels (JAX/XLA/pallas)
+- ``nomad_tpu.tpu``       — the TPU placement solver wired into the scheduler seam
+- ``nomad_tpu.parallel``  — device-mesh sharding of the node axis (shard_map/pjit)
+- ``nomad_tpu.server``    — control plane: eval broker, plan queue, plan applier,
+  workers, heartbeats, raft-style replicated FSM
+- ``nomad_tpu.client``    — node agent: fingerprinting, drivers, alloc runners
+"""
+
+__version__ = "0.1.0"

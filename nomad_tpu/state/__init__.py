@@ -1,0 +1,3 @@
+from nomad_tpu.state.store import StateSnapshot, StateStore, StateRestore, WatchItem
+
+__all__ = ["StateStore", "StateSnapshot", "StateRestore", "WatchItem"]

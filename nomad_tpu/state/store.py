@@ -1,0 +1,477 @@
+"""In-memory MVCC state store with snapshots and watch/notify.
+
+Fresh design with the capabilities of the reference's go-memdb-backed
+StateStore (/root/reference/nomad/state/state_store.go:28-815, schema at
+nomad/state/schema.go:10-188, notify at nomad/state/notify.go):
+
+- tables: ``index``, ``nodes``, ``jobs``, ``evals``, ``allocs``
+- secondary indexes: allocs by (job, node, eval), evals by job
+  (jobs-by-scheduler-type is a scan; the jobs table stays small)
+- copy-on-write ``snapshot()`` giving an immutable point-in-time view
+- per-item watch registration powering blocking queries
+- ``restore()`` bulk loader used by snapshot/FSM restore
+
+Instead of radix trees we keep plain dicts whose *container* is copied on
+snapshot; stored objects are immutable by convention (callers pass ownership
+on upsert and must not mutate afterwards — the same contract go-memdb
+enforces, state_store.go:25-27).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+)
+
+# A watch item is a (kind, key) tuple, e.g. ("table", "nodes"),
+# ("alloc_node", node_id). Mirrors nomad/watch/watch.go:11-37.
+WatchItem = Tuple[str, str]
+
+
+def item_table(name: str) -> WatchItem:
+    return ("table", name)
+
+
+def item_node(node_id: str) -> WatchItem:
+    return ("node", node_id)
+
+
+def item_job(job_id: str) -> WatchItem:
+    return ("job", job_id)
+
+
+def item_eval(eval_id: str) -> WatchItem:
+    return ("eval", eval_id)
+
+
+def item_alloc(alloc_id: str) -> WatchItem:
+    return ("alloc", alloc_id)
+
+
+def item_alloc_node(node_id: str) -> WatchItem:
+    return ("alloc_node", node_id)
+
+
+def item_alloc_job(job_id: str) -> WatchItem:
+    return ("alloc_job", job_id)
+
+
+def item_alloc_eval(eval_id: str) -> WatchItem:
+    return ("alloc_eval", eval_id)
+
+
+class _Watch:
+    """Watch registry: condition-variable fan-out keyed by WatchItem
+    (reference: nomad/state/notify.go)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._waiters: Dict[WatchItem, Set[threading.Event]] = {}
+
+    def watch(self, items: Iterable[WatchItem], event: threading.Event) -> None:
+        with self._lock:
+            for item in items:
+                self._waiters.setdefault(item, set()).add(event)
+
+    def stop_watch(self, items: Iterable[WatchItem], event: threading.Event) -> None:
+        with self._lock:
+            for item in items:
+                waiters = self._waiters.get(item)
+                if waiters is not None:
+                    waiters.discard(event)
+                    if not waiters:
+                        del self._waiters[item]
+
+    def notify(self, items: Iterable[WatchItem]) -> None:
+        with self._lock:
+            for item in items:
+                for event in self._waiters.get(item, ()):
+                    event.set()
+
+
+class _Tables:
+    """The raw table containers. Snapshots shallow-copy these dicts."""
+
+    def __init__(self) -> None:
+        self.indexes: Dict[str, int] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.jobs: Dict[str, Job] = {}
+        self.evals: Dict[str, Evaluation] = {}
+        self.allocs: Dict[str, Allocation] = {}
+        # Secondary indexes: id sets keyed by foreign key.
+        self.evals_by_job: Dict[str, Set[str]] = {}
+        self.allocs_by_job: Dict[str, Set[str]] = {}
+        self.allocs_by_node: Dict[str, Set[str]] = {}
+        self.allocs_by_eval: Dict[str, Set[str]] = {}
+
+    def copy(self) -> "_Tables":
+        new = _Tables()
+        new.indexes = dict(self.indexes)
+        new.nodes = dict(self.nodes)
+        new.jobs = dict(self.jobs)
+        new.evals = dict(self.evals)
+        new.allocs = dict(self.allocs)
+        new.evals_by_job = {k: set(v) for k, v in self.evals_by_job.items()}
+        new.allocs_by_job = {k: set(v) for k, v in self.allocs_by_job.items()}
+        new.allocs_by_node = {k: set(v) for k, v in self.allocs_by_node.items()}
+        new.allocs_by_eval = {k: set(v) for k, v in self.allocs_by_eval.items()}
+        return new
+
+
+class _StateView:
+    """Read methods shared by the live store and snapshots. Implements the
+    scheduler State interface (reference: scheduler/scheduler.go:55-71)."""
+
+    _t: _Tables
+
+    # -- nodes ------------------------------------------------------------
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._t.nodes.get(node_id)
+
+    def nodes(self) -> List[Node]:
+        return list(self._t.nodes.values())
+
+    # -- jobs -------------------------------------------------------------
+
+    def job_by_id(self, job_id: str) -> Optional[Job]:
+        return self._t.jobs.get(job_id)
+
+    def jobs(self) -> List[Job]:
+        return list(self._t.jobs.values())
+
+    def jobs_by_scheduler(self, scheduler_type: str) -> List[Job]:
+        """Jobs by type, backing system-job fan-out on node updates
+        (state_store.go schema "type" index; node_endpoint.go:459)."""
+        return [j for j in self._t.jobs.values() if j.type == scheduler_type]
+
+    # -- evals ------------------------------------------------------------
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._t.evals.get(eval_id)
+
+    def evals(self) -> List[Evaluation]:
+        return list(self._t.evals.values())
+
+    def evals_by_job(self, job_id: str) -> List[Evaluation]:
+        ids = self._t.evals_by_job.get(job_id, set())
+        return [self._t.evals[i] for i in ids]
+
+    # -- allocs -----------------------------------------------------------
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._t.allocs.get(alloc_id)
+
+    def allocs(self) -> List[Allocation]:
+        return list(self._t.allocs.values())
+
+    def allocs_by_job(self, job_id: str) -> List[Allocation]:
+        ids = self._t.allocs_by_job.get(job_id, set())
+        return [self._t.allocs[i] for i in ids]
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        ids = self._t.allocs_by_node.get(node_id, set())
+        return [self._t.allocs[i] for i in ids]
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        ids = self._t.allocs_by_eval.get(eval_id, set())
+        return [self._t.allocs[i] for i in ids]
+
+    # -- indexes ----------------------------------------------------------
+
+    def get_index(self, table: str) -> int:
+        """Latest commit index that modified ``table``
+        (state_store.go Index table)."""
+        return self._t.indexes.get(table, 0)
+
+    def latest_index(self) -> int:
+        return max(self._t.indexes.values(), default=0)
+
+
+class StateSnapshot(_StateView):
+    """Immutable point-in-time view (reference: state_store.go:54-66).
+
+    Also supports *optimistic* local mutation (upsert_allocs) so the plan
+    applier can pipeline verification of plan N+1 against the effects of
+    plan N before Raft applies it (plan_apply.go:100-117); snapshots are
+    private to their creator so this never races.
+    """
+
+    def __init__(self, tables: _Tables):
+        self._t = tables
+
+    # The plan applier attaches allocs optimistically; reuse the same
+    # write-side helpers against the snapshot's private tables.
+    def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
+        _upsert_allocs(self._t, index, allocs)
+
+
+class StateRestore:
+    """Bulk loader used by FSM snapshot restore
+    (reference: state_store.go:767-815)."""
+
+    def __init__(self, store: "StateStore"):
+        self._store = store
+        self._tables = _Tables()
+
+    def node_restore(self, node: Node) -> None:
+        self._tables.nodes[node.id] = node
+        self._tables.indexes["nodes"] = max(
+            self._tables.indexes.get("nodes", 0), node.modify_index
+        )
+
+    def job_restore(self, job: Job) -> None:
+        self._tables.jobs[job.id] = job
+        self._tables.indexes["jobs"] = max(
+            self._tables.indexes.get("jobs", 0), job.modify_index
+        )
+
+    def eval_restore(self, ev: Evaluation) -> None:
+        self._tables.evals[ev.id] = ev
+        self._tables.evals_by_job.setdefault(ev.job_id, set()).add(ev.id)
+        self._tables.indexes["evals"] = max(
+            self._tables.indexes.get("evals", 0), ev.modify_index
+        )
+
+    def alloc_restore(self, alloc: Allocation) -> None:
+        t = self._tables
+        t.allocs[alloc.id] = alloc
+        t.allocs_by_job.setdefault(alloc.job_id, set()).add(alloc.id)
+        t.allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
+        t.allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+        t.indexes["allocs"] = max(
+            t.indexes.get("allocs", 0), alloc.modify_index
+        )
+
+    def index_restore(self, table: str, index: int) -> None:
+        self._tables.indexes[table] = index
+
+    def commit(self) -> None:
+        self._store._install(self._tables)
+
+
+def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
+    for alloc in allocs:
+        existing = t.allocs.get(alloc.id)
+        if existing is None:
+            alloc.create_index = index
+        else:
+            alloc.create_index = existing.create_index
+            # De-index under stale foreign keys if they changed.
+            if existing.node_id != alloc.node_id:
+                t.allocs_by_node.get(existing.node_id, set()).discard(alloc.id)
+            if existing.job_id != alloc.job_id:
+                t.allocs_by_job.get(existing.job_id, set()).discard(alloc.id)
+            if existing.eval_id != alloc.eval_id:
+                t.allocs_by_eval.get(existing.eval_id, set()).discard(alloc.id)
+        alloc.modify_index = index
+        t.allocs[alloc.id] = alloc
+        t.allocs_by_job.setdefault(alloc.job_id, set()).add(alloc.id)
+        t.allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
+        t.allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+    t.indexes["allocs"] = index
+
+
+class StateStore(_StateView):
+    """The live, mutable state store. All writes stamp create/modify
+    indexes and fire watch notifications (reference: state_store.go:91-760)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._t = _Tables()
+        self.watch = _Watch()
+
+    # -- snapshot/restore -------------------------------------------------
+
+    def snapshot(self) -> StateSnapshot:
+        with self._lock:
+            return StateSnapshot(self._t.copy())
+
+    def restore(self) -> StateRestore:
+        return StateRestore(self)
+
+    def _install(self, tables: _Tables) -> None:
+        with self._lock:
+            self._t = tables
+        self.watch.notify(
+            [
+                item_table("nodes"),
+                item_table("jobs"),
+                item_table("evals"),
+                item_table("allocs"),
+            ]
+        )
+
+    # -- nodes ------------------------------------------------------------
+
+    def upsert_node(self, index: int, node: Node) -> None:
+        """reference: state_store.go UpsertNode"""
+        with self._lock:
+            existing = self._t.nodes.get(node.id)
+            if existing is None:
+                node.create_index = index
+            else:
+                node.create_index = existing.create_index
+            node.modify_index = index
+            self._t.nodes[node.id] = node
+            self._t.indexes["nodes"] = index
+        self.watch.notify([item_table("nodes"), item_node(node.id)])
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            if node_id not in self._t.nodes:
+                raise KeyError(f"node not found: {node_id}")
+            del self._t.nodes[node_id]
+            self._t.indexes["nodes"] = index
+        self.watch.notify([item_table("nodes"), item_node(node_id)])
+
+    def update_node_status(self, index: int, node_id: str, status: str) -> None:
+        with self._lock:
+            existing = self._t.nodes.get(node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            node = existing.copy()
+            node.status = status
+            node.modify_index = index
+            self._t.nodes[node_id] = node
+            self._t.indexes["nodes"] = index
+        self.watch.notify([item_table("nodes"), item_node(node_id)])
+
+    def update_node_drain(self, index: int, node_id: str, drain: bool) -> None:
+        with self._lock:
+            existing = self._t.nodes.get(node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            node = existing.copy()
+            node.drain = drain
+            node.modify_index = index
+            self._t.nodes[node_id] = node
+            self._t.indexes["nodes"] = index
+        self.watch.notify([item_table("nodes"), item_node(node_id)])
+
+    # -- jobs -------------------------------------------------------------
+
+    def upsert_job(self, index: int, job: Job) -> None:
+        with self._lock:
+            existing = self._t.jobs.get(job.id)
+            if existing is None:
+                job.create_index = index
+            else:
+                job.create_index = existing.create_index
+            job.modify_index = index
+            self._t.jobs[job.id] = job
+            self._t.indexes["jobs"] = index
+        self.watch.notify([item_table("jobs"), item_job(job.id)])
+
+    def delete_job(self, index: int, job_id: str) -> None:
+        with self._lock:
+            if job_id not in self._t.jobs:
+                raise KeyError(f"job not found: {job_id}")
+            del self._t.jobs[job_id]
+            self._t.indexes["jobs"] = index
+        self.watch.notify([item_table("jobs"), item_job(job_id)])
+
+    # -- evals ------------------------------------------------------------
+
+    def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
+        items: List[WatchItem] = [item_table("evals")]
+        with self._lock:
+            for ev in evals:
+                existing = self._t.evals.get(ev.id)
+                if existing is None:
+                    ev.create_index = index
+                else:
+                    ev.create_index = existing.create_index
+                ev.modify_index = index
+                self._t.evals[ev.id] = ev
+                self._t.evals_by_job.setdefault(ev.job_id, set()).add(ev.id)
+                items.append(item_eval(ev.id))
+            self._t.indexes["evals"] = index
+        self.watch.notify(items)
+
+    def delete_eval(self, index: int, eval_ids: List[str], alloc_ids: List[str]) -> None:
+        """Delete evals + allocs together, used by GC
+        (reference: state_store.go DeleteEval)."""
+        items: List[WatchItem] = [item_table("evals"), item_table("allocs")]
+        with self._lock:
+            t = self._t
+            for eval_id in eval_ids:
+                ev = t.evals.pop(eval_id, None)
+                if ev is not None:
+                    ids = t.evals_by_job.get(ev.job_id)
+                    if ids is not None:
+                        ids.discard(eval_id)
+                        if not ids:
+                            del t.evals_by_job[ev.job_id]
+                    items.append(item_eval(eval_id))
+            for alloc_id in alloc_ids:
+                alloc = t.allocs.pop(alloc_id, None)
+                if alloc is not None:
+                    for idx_map, key in (
+                        (t.allocs_by_job, alloc.job_id),
+                        (t.allocs_by_node, alloc.node_id),
+                        (t.allocs_by_eval, alloc.eval_id),
+                    ):
+                        ids = idx_map.get(key)
+                        if ids is not None:
+                            ids.discard(alloc_id)
+                            if not ids:
+                                del idx_map[key]
+                    items.extend(
+                        [
+                            item_alloc(alloc_id),
+                            item_alloc_job(alloc.job_id),
+                            item_alloc_node(alloc.node_id),
+                            item_alloc_eval(alloc.eval_id),
+                        ]
+                    )
+            t.indexes["evals"] = index
+            t.indexes["allocs"] = index
+        self.watch.notify(items)
+
+    # -- allocs -----------------------------------------------------------
+
+    def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
+        items: List[WatchItem] = [item_table("allocs")]
+        with self._lock:
+            _upsert_allocs(self._t, index, allocs)
+            for alloc in allocs:
+                items.extend(
+                    [
+                        item_alloc(alloc.id),
+                        item_alloc_job(alloc.job_id),
+                        item_alloc_node(alloc.node_id),
+                        item_alloc_eval(alloc.eval_id),
+                    ]
+                )
+        self.watch.notify(items)
+
+    def update_alloc_from_client(self, index: int, alloc: Allocation) -> None:
+        """Client status update: only client-side fields are trusted
+        (reference: state_store.go UpdateAllocFromClient)."""
+        with self._lock:
+            existing = self._t.allocs.get(alloc.id)
+            if existing is None:
+                raise KeyError(f"alloc not found: {alloc.id}")
+            new = existing.copy()
+            new.client_status = alloc.client_status
+            new.client_description = alloc.client_description
+            new.modify_index = index
+            self._t.allocs[alloc.id] = new
+            self._t.indexes["allocs"] = index
+            alloc = new
+        self.watch.notify(
+            [
+                item_table("allocs"),
+                item_alloc(alloc.id),
+                item_alloc_job(alloc.job_id),
+                item_alloc_node(alloc.node_id),
+                item_alloc_eval(alloc.eval_id),
+            ]
+        )

@@ -1,0 +1,806 @@
+"""Core data model for the scheduler.
+
+This is a fresh, Python-idiomatic data model with the same capabilities as the
+reference's ``nomad/structs/structs.go`` (see SURVEY.md §2.2). Field-for-field
+parity is intentional where the scheduler semantics depend on it (resource
+dimensions, statuses, plan shape); representation is not (dataclasses instead
+of msgpack-tagged Go structs).
+
+Reference citations (``file:line`` into /root/reference):
+- Node:            nomad/structs/structs.go:447-543
+- Resources:       nomad/structs/structs.go:547-621
+- Job/TaskGroup/Task: nomad/structs/structs.go:742-1075
+- Constraint:      nomad/structs/structs.go:1077-1112
+- Allocation:      nomad/structs/structs.go:1129-1222
+- AllocMetric:     nomad/structs/structs.go:1227-1307
+- Evaluation:      nomad/structs/structs.go:1341-1457
+- Plan/PlanResult: nomad/structs/structs.go:1462-1575
+- fit/score funcs: nomad/structs/funcs.go:9-124
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import re
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Constants
+# ---------------------------------------------------------------------------
+
+NODE_STATUS_INIT = "initializing"
+NODE_STATUS_READY = "ready"
+NODE_STATUS_DOWN = "down"
+
+JOB_TYPE_CORE = "_core"
+JOB_TYPE_SERVICE = "service"
+JOB_TYPE_BATCH = "batch"
+JOB_TYPE_SYSTEM = "system"
+
+JOB_STATUS_PENDING = "pending"
+JOB_STATUS_RUNNING = "running"
+JOB_STATUS_COMPLETE = "complete"
+JOB_STATUS_DEAD = "dead"
+
+JOB_MIN_PRIORITY = 1
+JOB_DEFAULT_PRIORITY = 50
+JOB_MAX_PRIORITY = 100
+CORE_JOB_PRIORITY = JOB_MAX_PRIORITY * 2
+
+ALLOC_DESIRED_STATUS_RUN = "run"
+ALLOC_DESIRED_STATUS_STOP = "stop"
+ALLOC_DESIRED_STATUS_EVICT = "evict"
+ALLOC_DESIRED_STATUS_FAILED = "failed"
+
+ALLOC_CLIENT_STATUS_PENDING = "pending"
+ALLOC_CLIENT_STATUS_RUNNING = "running"
+ALLOC_CLIENT_STATUS_DEAD = "dead"
+ALLOC_CLIENT_STATUS_FAILED = "failed"
+
+EVAL_STATUS_PENDING = "pending"
+EVAL_STATUS_COMPLETE = "complete"
+EVAL_STATUS_FAILED = "failed"
+
+EVAL_TRIGGER_JOB_REGISTER = "job-register"
+EVAL_TRIGGER_JOB_DEREGISTER = "job-deregister"
+EVAL_TRIGGER_NODE_UPDATE = "node-update"
+EVAL_TRIGGER_SCHEDULED = "scheduled"
+EVAL_TRIGGER_ROLLING_UPDATE = "rolling-update"
+
+CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
+CONSTRAINT_REGEX = "regexp"
+CONSTRAINT_VERSION = "version"
+
+CORE_JOB_EVAL_GC = "eval-gc"
+CORE_JOB_NODE_GC = "node-gc"
+
+# The dense resource dimensions the TPU solver packs into a vector.
+# Order matters: it is the column order of node/ask tensors in nomad_tpu.ops.
+RESOURCE_DIMS = ("cpu", "memory_mb", "disk_mb", "iops")
+
+
+def generate_uuid() -> str:
+    """Random UUID (reference: nomad/structs/funcs.go:126-139)."""
+    return str(uuid.uuid4())
+
+
+# ---------------------------------------------------------------------------
+# Errors
+# ---------------------------------------------------------------------------
+
+
+class ValidationError(Exception):
+    """Aggregated validation failure (reference uses go-multierror)."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = errors
+        super().__init__("; ".join(errors))
+
+
+# ---------------------------------------------------------------------------
+# Resources & network
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NetworkResource:
+    """Network ask/offer (reference: nomad/structs/structs.go:625-703)."""
+
+    device: str = ""
+    cidr: str = ""
+    ip: str = ""
+    mbits: int = 0
+    reserved_ports: List[int] = field(default_factory=list)
+    dynamic_ports: List[str] = field(default_factory=list)
+
+    def copy(self) -> "NetworkResource":
+        new = _copy.copy(self)
+        new.reserved_ports = list(self.reserved_ports)
+        new.dynamic_ports = list(self.dynamic_ports)
+        return new
+
+    def add(self, delta: "NetworkResource") -> None:
+        if delta.reserved_ports:
+            self.reserved_ports.extend(delta.reserved_ports)
+        self.mbits += delta.mbits
+        self.dynamic_ports.extend(delta.dynamic_ports)
+
+    def map_dynamic_ports(self) -> Dict[str, int]:
+        """Label -> assigned port for dynamic ports; the offer process appends
+        assigned dynamic ports to reserved_ports (structs.go:659-696)."""
+        ports = self.reserved_ports[len(self.reserved_ports) - len(self.dynamic_ports):]
+        return {label: ports[i] for i, label in enumerate(self.dynamic_ports)}
+
+    def list_static_ports(self) -> List[int]:
+        return self.reserved_ports[: len(self.reserved_ports) - len(self.dynamic_ports)]
+
+
+@dataclass
+class Resources:
+    """Schedulable resources (reference: nomad/structs/structs.go:547-621)."""
+
+    cpu: int = 0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    iops: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+
+    def copy(self) -> "Resources":
+        new = _copy.copy(self)
+        new.networks = [n.copy() for n in self.networks]
+        return new
+
+    def net_index(self, n: NetworkResource) -> int:
+        for idx, net in enumerate(self.networks):
+            if net.device == n.device:
+                return idx
+        return -1
+
+    def superset(self, other: "Resources") -> Tuple[bool, str]:
+        """Dimension-wise >= check, network handled by NetworkIndex
+        (structs.go:577-594)."""
+        if self.cpu < other.cpu:
+            return False, "cpu exhausted"
+        if self.memory_mb < other.memory_mb:
+            return False, "memory exhausted"
+        if self.disk_mb < other.disk_mb:
+            return False, "disk exhausted"
+        if self.iops < other.iops:
+            return False, "iops exhausted"
+        return True, ""
+
+    def add(self, delta: Optional["Resources"]) -> None:
+        if delta is None:
+            return
+        self.cpu += delta.cpu
+        self.memory_mb += delta.memory_mb
+        self.disk_mb += delta.disk_mb
+        self.iops += delta.iops
+        for n in delta.networks:
+            idx = self.net_index(n)
+            if idx == -1:
+                self.networks.append(n.copy())
+            else:
+                self.networks[idx].add(n)
+
+    def as_vector(self) -> Tuple[int, int, int, int]:
+        """Dense vector in RESOURCE_DIMS order for the TPU solver."""
+        return (self.cpu, self.memory_mb, self.disk_mb, self.iops)
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+
+def should_drain_node(status: str) -> bool:
+    """Whether a node status forces migrations (structs.go:423-434)."""
+    if status in (NODE_STATUS_INIT, NODE_STATUS_READY):
+        return False
+    if status == NODE_STATUS_DOWN:
+        return True
+    raise ValueError(f"unhandled node status {status}")
+
+
+def valid_node_status(status: str) -> bool:
+    return status in (NODE_STATUS_INIT, NODE_STATUS_READY, NODE_STATUS_DOWN)
+
+
+@dataclass
+class Node:
+    """A schedulable client node (reference: nomad/structs/structs.go:447-543)."""
+
+    id: str = ""
+    datacenter: str = ""
+    name: str = ""
+    attributes: Dict[str, str] = field(default_factory=dict)
+    resources: Optional[Resources] = None
+    reserved: Optional[Resources] = None
+    links: Dict[str, str] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+    node_class: str = ""
+    drain: bool = False
+    status: str = ""
+    status_description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def terminal_status(self) -> bool:
+        return self.status == NODE_STATUS_DOWN
+
+    def copy(self) -> "Node":
+        new = _copy.copy(self)
+        new.attributes = dict(self.attributes)
+        new.links = dict(self.links)
+        new.meta = dict(self.meta)
+        new.resources = self.resources.copy() if self.resources else None
+        new.reserved = self.reserved.copy() if self.reserved else None
+        return new
+
+    def stub(self) -> Dict[str, Any]:
+        """Summarized view for list endpoints (structs.go:516-529)."""
+        return {
+            "id": self.id,
+            "datacenter": self.datacenter,
+            "name": self.name,
+            "node_class": self.node_class,
+            "drain": self.drain,
+            "status": self.status,
+            "status_description": self.status_description,
+            "create_index": self.create_index,
+            "modify_index": self.modify_index,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Job / TaskGroup / Task / Constraint
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class UpdateStrategy:
+    """Rolling update control (reference: structs.go:897-908).
+    ``stagger`` is in seconds (the reference uses time.Duration)."""
+
+    stagger: float = 0.0
+    max_parallel: int = 0
+
+    def rolling(self) -> bool:
+        return self.stagger > 0 and self.max_parallel > 0
+
+
+@dataclass
+class RestartPolicy:
+    """Client-side task restart policy (reference: structs.go:912-935).
+    Durations are seconds."""
+
+    attempts: int = 0
+    interval: float = 0.0
+    delay: float = 0.0
+
+    def validate(self) -> None:
+        if self.attempts * self.delay > self.interval:
+            raise ValidationError(
+                [
+                    f"can't restart task group {self.attempts} times in an interval "
+                    f"of {self.interval}s with a delay of {self.delay}s"
+                ]
+            )
+
+
+DEFAULT_SERVICE_RESTART_POLICY = RestartPolicy(attempts=2, interval=600.0, delay=15.0)
+DEFAULT_BATCH_RESTART_POLICY = RestartPolicy(attempts=15, interval=7 * 24 * 3600.0, delay=15.0)
+
+
+def new_restart_policy(job_type: str) -> Optional[RestartPolicy]:
+    if job_type in (JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM):
+        return _copy.copy(DEFAULT_SERVICE_RESTART_POLICY)
+    if job_type == JOB_TYPE_BATCH:
+        return _copy.copy(DEFAULT_BATCH_RESTART_POLICY)
+    return None
+
+
+@dataclass
+class Constraint:
+    """Placement restriction (reference: structs.go:1077-1112)."""
+
+    l_target: str = ""
+    r_target: str = ""
+    operand: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.l_target} {self.operand} {self.r_target}"
+
+    def validate(self) -> None:
+        errors: List[str] = []
+        if not self.operand:
+            errors.append("missing constraint operand")
+        if self.operand == CONSTRAINT_REGEX:
+            try:
+                re.compile(self.r_target)
+            except re.error as e:
+                errors.append(f"regular expression failed to compile: {e}")
+        elif self.operand == CONSTRAINT_VERSION:
+            from nomad_tpu.version import parse_constraints
+
+            try:
+                parse_constraints(self.r_target)
+            except ValueError as e:
+                errors.append(f"version constraint is invalid: {e}")
+        if errors:
+            raise ValidationError(errors)
+
+
+@dataclass
+class Task:
+    """A single schedulable process (reference: structs.go:1027-1075)."""
+
+    name: str = ""
+    driver: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    constraints: List[Constraint] = field(default_factory=list)
+    resources: Optional[Resources] = None
+    meta: Dict[str, str] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        errors: List[str] = []
+        if not self.name:
+            errors.append("missing task name")
+        if not self.driver:
+            errors.append("missing task driver")
+        if self.resources is None:
+            errors.append("missing task resources")
+        for idx, constr in enumerate(self.constraints):
+            try:
+                constr.validate()
+            except ValidationError as e:
+                errors.append(f"constraint {idx + 1} validation failed: {e}")
+        if errors:
+            raise ValidationError(errors)
+
+
+@dataclass
+class TaskGroup:
+    """Atomic unit of placement (reference: structs.go:940-1024)."""
+
+    name: str = ""
+    count: int = 1
+    constraints: List[Constraint] = field(default_factory=list)
+    restart_policy: Optional[RestartPolicy] = None
+    tasks: List[Task] = field(default_factory=list)
+    meta: Dict[str, str] = field(default_factory=dict)
+
+    def lookup_task(self, name: str) -> Optional[Task]:
+        for t in self.tasks:
+            if t.name == name:
+                return t
+        return None
+
+    def validate(self) -> None:
+        errors: List[str] = []
+        if not self.name:
+            errors.append("missing task group name")
+        if self.count <= 0:
+            errors.append("task group count must be positive")
+        if not self.tasks:
+            errors.append("missing tasks for task group")
+        for idx, constr in enumerate(self.constraints):
+            try:
+                constr.validate()
+            except ValidationError as e:
+                errors.append(f"constraint {idx + 1} validation failed: {e}")
+        if self.restart_policy is not None:
+            try:
+                self.restart_policy.validate()
+            except ValidationError as e:
+                errors.append(str(e))
+        else:
+            errors.append(f"task group {self.name} should have a restart policy")
+        seen: Dict[str, int] = {}
+        for idx, task in enumerate(self.tasks):
+            if not task.name:
+                errors.append(f"task {idx + 1} missing name")
+            elif task.name in seen:
+                errors.append(
+                    f"task {idx + 1} redefines '{task.name}' from task {seen[task.name] + 1}"
+                )
+            else:
+                seen[task.name] = idx
+        for idx, task in enumerate(self.tasks):
+            try:
+                task.validate()
+            except ValidationError as e:
+                errors.append(f"task {idx + 1} validation failed: {e}")
+        if errors:
+            raise ValidationError(errors)
+
+
+@dataclass
+class Job:
+    """Scope of a scheduling request (reference: structs.go:742-894)."""
+
+    region: str = ""
+    id: str = ""
+    name: str = ""
+    type: str = ""
+    priority: int = JOB_DEFAULT_PRIORITY
+    all_at_once: bool = False
+    datacenters: List[str] = field(default_factory=list)
+    constraints: List[Constraint] = field(default_factory=list)
+    task_groups: List[TaskGroup] = field(default_factory=list)
+    update: UpdateStrategy = field(default_factory=UpdateStrategy)
+    meta: Dict[str, str] = field(default_factory=dict)
+    status: str = ""
+    status_description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
+        for tg in self.task_groups:
+            if tg.name == name:
+                return tg
+        return None
+
+    def validate(self) -> None:
+        errors: List[str] = []
+        if not self.region:
+            errors.append("missing job region")
+        if not self.id:
+            errors.append("missing job ID")
+        elif " " in self.id:
+            errors.append("job ID contains a space")
+        if not self.name:
+            errors.append("missing job name")
+        if not self.type:
+            errors.append("missing job type")
+        if self.priority < JOB_MIN_PRIORITY or self.priority > JOB_MAX_PRIORITY:
+            errors.append(
+                f"job priority must be between [{JOB_MIN_PRIORITY}, {JOB_MAX_PRIORITY}]"
+            )
+        if not self.datacenters:
+            errors.append("missing job datacenters")
+        if not self.task_groups:
+            errors.append("missing job task groups")
+        for idx, constr in enumerate(self.constraints):
+            try:
+                constr.validate()
+            except ValidationError as e:
+                errors.append(f"constraint {idx + 1} validation failed: {e}")
+        seen: Dict[str, int] = {}
+        for idx, tg in enumerate(self.task_groups):
+            if not tg.name:
+                errors.append(f"job task group {idx + 1} missing name")
+            elif tg.name in seen:
+                errors.append(
+                    f"job task group {idx + 1} redefines '{tg.name}' from group {seen[tg.name] + 1}"
+                )
+            else:
+                seen[tg.name] = idx
+            if self.type == JOB_TYPE_SYSTEM and tg.count != 1:
+                errors.append(
+                    f"job task group {idx + 1} has count {tg.count}; "
+                    "only count of 1 is supported with system scheduler"
+                )
+        for idx, tg in enumerate(self.task_groups):
+            try:
+                tg.validate()
+            except ValidationError as e:
+                errors.append(f"task group {idx + 1} validation failed: {e}")
+        if errors:
+            raise ValidationError(errors)
+
+    def stub(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "type": self.type,
+            "priority": self.priority,
+            "status": self.status,
+            "status_description": self.status_description,
+            "create_index": self.create_index,
+            "modify_index": self.modify_index,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Allocation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AllocMetric:
+    """Per-placement scheduling observability (reference: structs.go:1227-1307)."""
+
+    nodes_evaluated: int = 0
+    nodes_filtered: int = 0
+    class_filtered: Dict[str, int] = field(default_factory=dict)
+    constraint_filtered: Dict[str, int] = field(default_factory=dict)
+    nodes_exhausted: int = 0
+    class_exhausted: Dict[str, int] = field(default_factory=dict)
+    dimension_exhausted: Dict[str, int] = field(default_factory=dict)
+    scores: Dict[str, float] = field(default_factory=dict)
+    allocation_time: float = 0.0  # seconds
+    coalesced_failures: int = 0
+
+    def evaluate_node(self, n: int = 1) -> None:
+        self.nodes_evaluated += n
+
+    def filter_node(self, node: Optional[Node], constraint: str, n: int = 1) -> None:
+        self.nodes_filtered += n
+        if node is not None and node.node_class:
+            self.class_filtered[node.node_class] = (
+                self.class_filtered.get(node.node_class, 0) + n
+            )
+        if constraint:
+            self.constraint_filtered[constraint] = (
+                self.constraint_filtered.get(constraint, 0) + n
+            )
+
+    def exhausted_node(self, node: Optional[Node], dimension: str, n: int = 1) -> None:
+        self.nodes_exhausted += n
+        if node is not None and node.node_class:
+            self.class_exhausted[node.node_class] = (
+                self.class_exhausted.get(node.node_class, 0) + n
+            )
+        if dimension:
+            self.dimension_exhausted[dimension] = (
+                self.dimension_exhausted.get(dimension, 0) + n
+            )
+
+    def score_node(self, node: Node, name: str, score: float) -> None:
+        self.scores[f"{node.id}.{name}"] = score
+
+
+@dataclass
+class Allocation:
+    """Placement of a task group on a node (reference: structs.go:1129-1222)."""
+
+    id: str = ""
+    eval_id: str = ""
+    name: str = ""
+    node_id: str = ""
+    job_id: str = ""
+    job: Optional[Job] = None
+    task_group: str = ""
+    resources: Optional[Resources] = None
+    task_resources: Dict[str, Resources] = field(default_factory=dict)
+    metrics: Optional[AllocMetric] = None
+    desired_status: str = ""
+    desired_description: str = ""
+    client_status: str = ""
+    client_description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def terminal_status(self) -> bool:
+        """Based on desired status, like the reference (structs.go:1179-1188)."""
+        return self.desired_status in (
+            ALLOC_DESIRED_STATUS_STOP,
+            ALLOC_DESIRED_STATUS_EVICT,
+            ALLOC_DESIRED_STATUS_FAILED,
+        )
+
+    def copy(self) -> "Allocation":
+        """Shallow copy mirroring Go's ``*newAlloc = *alloc``."""
+        return _copy.copy(self)
+
+    def stub(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "eval_id": self.eval_id,
+            "name": self.name,
+            "node_id": self.node_id,
+            "job_id": self.job_id,
+            "task_group": self.task_group,
+            "desired_status": self.desired_status,
+            "desired_description": self.desired_description,
+            "client_status": self.client_status,
+            "client_description": self.client_description,
+            "create_index": self.create_index,
+            "modify_index": self.modify_index,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Evaluation / Plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Evaluation:
+    """Unit of scheduler work (reference: structs.go:1341-1457)."""
+
+    id: str = ""
+    priority: int = 0
+    type: str = ""
+    triggered_by: str = ""
+    job_id: str = ""
+    job_modify_index: int = 0
+    node_id: str = ""
+    node_modify_index: int = 0
+    status: str = ""
+    status_description: str = ""
+    wait: float = 0.0  # seconds
+    next_eval: str = ""
+    previous_eval: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def terminal_status(self) -> bool:
+        return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED)
+
+    def copy(self) -> "Evaluation":
+        return _copy.copy(self)
+
+    def should_enqueue(self) -> bool:
+        if self.status == EVAL_STATUS_PENDING:
+            return True
+        if self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED):
+            return False
+        raise ValueError(f"unhandled evaluation ({self.id}) status {self.status}")
+
+    def make_plan(self, job: Optional[Job]) -> "Plan":
+        plan = Plan(
+            eval_id=self.id,
+            priority=self.priority,
+            node_update={},
+            node_allocation={},
+        )
+        if job is not None:
+            plan.all_at_once = job.all_at_once
+        return plan
+
+    def next_rolling_eval(self, wait: float) -> "Evaluation":
+        return Evaluation(
+            id=generate_uuid(),
+            priority=self.priority,
+            type=self.type,
+            triggered_by=EVAL_TRIGGER_ROLLING_UPDATE,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait=wait,
+            previous_eval=self.id,
+        )
+
+
+@dataclass
+class Plan:
+    """Commit plan for task allocations (reference: structs.go:1462-1532)."""
+
+    eval_id: str = ""
+    eval_token: str = ""
+    priority: int = 0
+    all_at_once: bool = False
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    failed_allocs: List[Allocation] = field(default_factory=list)
+
+    def append_update(self, alloc: Allocation, status: str, desc: str) -> None:
+        new_alloc = alloc.copy()
+        new_alloc.desired_status = status
+        new_alloc.desired_description = desc
+        self.node_update.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def pop_update(self, alloc: Allocation) -> None:
+        existing = self.node_update.get(alloc.node_id, [])
+        if existing and existing[-1].id == alloc.id:
+            existing.pop()
+            if not existing:
+                self.node_update.pop(alloc.node_id, None)
+
+    def append_alloc(self, alloc: Allocation) -> None:
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def append_failed(self, alloc: Allocation) -> None:
+        self.failed_allocs.append(alloc)
+
+    def is_noop(self) -> bool:
+        return (
+            not self.node_update
+            and not self.node_allocation
+            and not self.failed_allocs
+        )
+
+
+@dataclass
+class PlanResult:
+    """Result of a plan submitted to the leader (reference: structs.go:1534-1575)."""
+
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    failed_allocs: List[Allocation] = field(default_factory=list)
+    refresh_index: int = 0
+    alloc_index: int = 0
+
+    def is_noop(self) -> bool:
+        return (
+            not self.node_update
+            and not self.node_allocation
+            and not self.failed_allocs
+        )
+
+    def full_commit(self, plan: Plan) -> Tuple[bool, int, int]:
+        expected = 0
+        actual = 0
+        for node_id, alloc_list in plan.node_allocation.items():
+            expected += len(alloc_list)
+            actual += len(self.node_allocation.get(node_id, []))
+        return actual == expected, expected, actual
+
+
+# ---------------------------------------------------------------------------
+# Fit & score functions (reference: nomad/structs/funcs.go)
+# ---------------------------------------------------------------------------
+
+
+def remove_allocs(allocs: List[Allocation], remove: List[Allocation]) -> List[Allocation]:
+    """Remove allocs with matching IDs (funcs.go:9-29). Non-destructive."""
+    remove_set = {a.id for a in remove}
+    return [a for a in allocs if a.id not in remove_set]
+
+
+def filter_terminal_allocs(allocs: List[Allocation]) -> List[Allocation]:
+    """Drop terminal-state allocations (funcs.go:31-42). Non-destructive."""
+    return [a for a in allocs if not a.terminal_status()]
+
+
+def allocs_fit(
+    node: Node,
+    allocs: List[Allocation],
+    net_idx: Optional["NetworkIndex"] = None,
+) -> Tuple[bool, str, Resources]:
+    """Check if a set of allocations fits on a node: resource superset +
+    port-collision + bandwidth overcommit (funcs.go:44-87).
+
+    Returns (fit, exhausted_dimension, used_resources).
+    """
+    from nomad_tpu.network import NetworkIndex
+
+    used = Resources()
+    if node.reserved is not None:
+        used.add(node.reserved)
+    for alloc in allocs:
+        used.add(alloc.resources)
+
+    ok, dimension = node.resources.superset(used)
+    if not ok:
+        return False, dimension, used
+
+    if net_idx is None:
+        net_idx = NetworkIndex()
+        if net_idx.set_node(node) or net_idx.add_allocs(allocs):
+            return False, "reserved port collision", used
+
+    if net_idx.overcommitted():
+        return False, "bandwidth exceeded", used
+
+    return True, "", used
+
+
+def score_fit(node: Node, util: Resources) -> float:
+    """Google "BestFit v3" bin-packing score (funcs.go:89-124).
+
+    0 at empty node, 18 at perfect fit; higher is better. The TPU solver
+    computes exactly this in nomad_tpu.ops.fit.score_fit_kernel, so the two
+    paths are numerically comparable.
+    """
+    node_cpu = float(node.resources.cpu)
+    node_mem = float(node.resources.memory_mb)
+    if node.reserved is not None:
+        node_cpu -= float(node.reserved.cpu)
+        node_mem -= float(node.reserved.memory_mb)
+
+    # A fully-reserved dimension has no schedulable capacity; treat as
+    # -inf free so 10**x underflows to 0 and the score clamps, matching
+    # Go's Inf-tolerant division + math.Pow instead of raising.
+    free_pct_cpu = 1.0 - (float(util.cpu) / node_cpu) if node_cpu > 0 else float("-inf")
+    free_pct_ram = (
+        1.0 - (float(util.memory_mb) / node_mem) if node_mem > 0 else float("-inf")
+    )
+    total = 10.0**free_pct_cpu + 10.0**free_pct_ram
+    score = 20.0 - total
+    return min(18.0, max(0.0, score))

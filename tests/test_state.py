@@ -1,0 +1,179 @@
+"""State store tests, mirroring the reference's coverage
+(/root/reference/nomad/state/state_store_test.go: CRUD, indexes, snapshots,
+watch-fire assertions, restore)."""
+
+import threading
+
+from nomad_tpu import mock, structs
+from nomad_tpu.state import StateStore
+from nomad_tpu.state.store import (
+    item_alloc_node,
+    item_node,
+    item_table,
+)
+
+
+def test_node_crud():
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(1000, node)
+
+    out = store.node_by_id(node.id)
+    assert out is node
+    assert out.create_index == 1000
+    assert out.modify_index == 1000
+    assert store.get_index("nodes") == 1000
+
+    store.update_node_status(1001, node.id, structs.NODE_STATUS_DOWN)
+    out = store.node_by_id(node.id)
+    assert out.status == structs.NODE_STATUS_DOWN
+    assert out.create_index == 1000
+    assert out.modify_index == 1001
+
+    store.update_node_drain(1002, node.id, True)
+    assert store.node_by_id(node.id).drain
+
+    store.delete_node(1003, node.id)
+    assert store.node_by_id(node.id) is None
+    assert store.get_index("nodes") == 1003
+
+
+def test_job_crud():
+    store = StateStore()
+    job = mock.job()
+    store.upsert_job(1000, job)
+    assert store.job_by_id(job.id) is job
+    assert job.create_index == 1000
+
+    # Re-upsert preserves create index
+    job2 = mock.job()
+    job2.id = job.id
+    store.upsert_job(1001, job2)
+    assert store.job_by_id(job.id).create_index == 1000
+    assert store.job_by_id(job.id).modify_index == 1001
+
+    sysjob = mock.system_job()
+    store.upsert_job(1002, sysjob)
+    assert [j.id for j in store.jobs_by_scheduler("system")] == [sysjob.id]
+    assert len(store.jobs()) == 2
+
+    store.delete_job(1003, job.id)
+    assert store.job_by_id(job.id) is None
+
+
+def test_eval_and_alloc_indexes():
+    store = StateStore()
+    ev = mock.evaluation()
+    store.upsert_evals(1000, [ev])
+    assert store.eval_by_id(ev.id) is ev
+    assert [e.id for e in store.evals_by_job(ev.job_id)] == [ev.id]
+
+    alloc = mock.alloc()
+    alloc.eval_id = ev.id
+    store.upsert_allocs(1001, [alloc])
+    assert store.alloc_by_id(alloc.id) is alloc
+    assert [a.id for a in store.allocs_by_job(alloc.job_id)] == [alloc.id]
+    assert [a.id for a in store.allocs_by_node(alloc.node_id)] == [alloc.id]
+    assert [a.id for a in store.allocs_by_eval(ev.id)] == [alloc.id]
+
+    # GC both
+    store.delete_eval(1002, [ev.id], [alloc.id])
+    assert store.eval_by_id(ev.id) is None
+    assert store.alloc_by_id(alloc.id) is None
+    assert store.allocs_by_job(alloc.job_id) == []
+
+
+def test_update_alloc_from_client():
+    store = StateStore()
+    alloc = mock.alloc()
+    store.upsert_allocs(1000, [alloc])
+
+    update = alloc.copy()
+    update.client_status = structs.ALLOC_CLIENT_STATUS_RUNNING
+    # Client must not be able to change desired status
+    update.desired_status = structs.ALLOC_DESIRED_STATUS_EVICT
+    store.update_alloc_from_client(1001, update)
+
+    out = store.alloc_by_id(alloc.id)
+    assert out.client_status == structs.ALLOC_CLIENT_STATUS_RUNNING
+    assert out.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+    assert out.modify_index == 1001
+
+
+def test_snapshot_isolation():
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(1000, node)
+
+    snap = store.snapshot()
+    assert snap.node_by_id(node.id) is not None
+
+    node2 = mock.node()
+    store.upsert_node(1001, node2)
+    # Snapshot does not see the new node
+    assert snap.node_by_id(node2.id) is None
+    assert len(snap.nodes()) == 1
+    assert len(store.nodes()) == 2
+    assert snap.get_index("nodes") == 1000
+
+    # Optimistic writes on the snapshot do not leak to the store
+    alloc = mock.alloc()
+    snap.upsert_allocs(1002, [alloc])
+    assert snap.alloc_by_id(alloc.id) is not None
+    assert store.alloc_by_id(alloc.id) is None
+
+
+def test_watch_fires():
+    store = StateStore()
+    node = mock.node()
+
+    event = threading.Event()
+    store.watch.watch([item_table("nodes")], event)
+    store.upsert_node(1000, node)
+    assert event.wait(1.0)
+
+    # Per-item watch
+    event2 = threading.Event()
+    store.watch.watch([item_node(node.id)], event2)
+    store.update_node_status(1001, node.id, structs.NODE_STATUS_DOWN)
+    assert event2.wait(1.0)
+
+    # alloc_node watch fires for allocs placed on that node
+    event3 = threading.Event()
+    alloc = mock.alloc()
+    store.watch.watch([item_alloc_node(alloc.node_id)], event3)
+    store.upsert_allocs(1002, [alloc])
+    assert event3.wait(1.0)
+
+    # stop_watch deregisters
+    event4 = threading.Event()
+    store.watch.watch([item_table("jobs")], event4)
+    store.watch.stop_watch([item_table("jobs")], event4)
+    store.upsert_job(1003, mock.job())
+    assert not event4.wait(0.05)
+
+
+def test_restore():
+    store = StateStore()
+    restore = store.restore()
+    node = mock.node()
+    node.modify_index = 50
+    job = mock.job()
+    job.modify_index = 60
+    ev = mock.evaluation()
+    ev.modify_index = 70
+    alloc = mock.alloc()
+    alloc.modify_index = 80
+    restore.node_restore(node)
+    restore.job_restore(job)
+    restore.eval_restore(ev)
+    restore.alloc_restore(alloc)
+    restore.index_restore("nodes", 50)
+    restore.commit()
+
+    assert store.node_by_id(node.id) is node
+    assert store.job_by_id(job.id) is job
+    assert store.eval_by_id(ev.id) is ev
+    assert store.alloc_by_id(alloc.id) is alloc
+    assert [a.id for a in store.allocs_by_node(alloc.node_id)] == [alloc.id]
+    assert store.get_index("nodes") == 50

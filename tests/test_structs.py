@@ -1,0 +1,232 @@
+"""Data model tests, mirroring the reference's structs tests
+(/root/reference/nomad/structs/structs_test.go, funcs_test.go,
+network_test.go)."""
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.network import NetworkIndex
+from nomad_tpu.structs import (
+    Allocation,
+    Constraint,
+    Job,
+    NetworkResource,
+    Node,
+    Plan,
+    PlanResult,
+    Resources,
+    ValidationError,
+    allocs_fit,
+    filter_terminal_allocs,
+    remove_allocs,
+    score_fit,
+)
+from nomad_tpu.version import check_version_constraint
+
+
+def test_job_validate():
+    j = Job()
+    with pytest.raises(ValidationError) as exc:
+        j.validate()
+    msg = str(exc.value)
+    for expected in ("missing job region", "missing job ID", "missing job name",
+                     "missing job type", "missing job datacenters",
+                     "missing job task groups"):
+        assert expected in msg
+
+    j = mock.job()
+    j.validate()  # must not raise
+
+
+def test_resources_superset():
+    base = Resources(cpu=1000, memory_mb=512, disk_mb=1000, iops=100)
+    ok, _ = base.superset(Resources(cpu=1000, memory_mb=512, disk_mb=1000, iops=100))
+    assert ok
+    ok, dim = base.superset(Resources(cpu=1001))
+    assert not ok and dim == "cpu exhausted"
+    ok, dim = base.superset(Resources(memory_mb=513))
+    assert not ok and dim == "memory exhausted"
+    ok, dim = base.superset(Resources(disk_mb=1001))
+    assert not ok and dim == "disk exhausted"
+    ok, dim = base.superset(Resources(iops=101))
+    assert not ok and dim == "iops exhausted"
+
+
+def test_resources_add():
+    r1 = Resources(
+        cpu=2000, memory_mb=2048, disk_mb=10000, iops=100,
+        networks=[NetworkResource(cidr="10.0.0.0/8", mbits=100, reserved_ports=[22])],
+    )
+    r2 = Resources(
+        cpu=1000, memory_mb=1024, disk_mb=5000, iops=50,
+        networks=[NetworkResource(ip="10.0.0.1", mbits=50, reserved_ports=[80])],
+    )
+    r1.add(r2)
+    assert r1.cpu == 3000
+    assert r1.memory_mb == 3072
+    assert r1.disk_mb == 15000
+    assert r1.iops == 150
+    # Same (empty) device -> merged
+    assert len(r1.networks) == 1
+    assert r1.networks[0].mbits == 150
+    assert r1.networks[0].reserved_ports == [22, 80]
+
+
+def test_allocs_fit():
+    node = Node(
+        id="n1",
+        resources=Resources(
+            cpu=2000, memory_mb=2048, disk_mb=10000, iops=100,
+            networks=[NetworkResource(device="eth0", cidr="10.0.0.0/8", mbits=100)],
+        ),
+        reserved=Resources(
+            cpu=1000, memory_mb=1024, disk_mb=5000, iops=50,
+            networks=[NetworkResource(device="eth0", ip="10.0.0.1",
+                                      mbits=50, reserved_ports=[80])],
+        ),
+    )
+    a1 = Allocation(
+        id="a1",
+        resources=Resources(
+            cpu=1000, memory_mb=1024, disk_mb=5000, iops=50,
+            networks=[NetworkResource(device="eth0", ip="10.0.0.1",
+                                      mbits=50, reserved_ports=[8000])],
+        ),
+    )
+    fit, dim, used = allocs_fit(node, [a1])
+    assert fit, dim
+    assert used.cpu == 2000
+    assert used.memory_mb == 2048
+
+    # Double the alloc: should be exhausted
+    fit, dim, used = allocs_fit(node, [a1, a1])
+    assert not fit
+    assert dim == "cpu exhausted"
+    assert used.cpu == 3000
+
+
+def test_score_fit():
+    node = Node(
+        resources=Resources(cpu=4096, memory_mb=8192),
+        reserved=Resources(cpu=2048, memory_mb=4096),
+    )
+    # Perfect fit -> 18 (reference: funcs_test.go:184-192)
+    assert score_fit(node, Resources(cpu=2048, memory_mb=4096)) == pytest.approx(18.0)
+    # Worst fit -> 0 (funcs_test.go:194-202)
+    assert score_fit(node, Resources(cpu=0, memory_mb=0)) == pytest.approx(0.0)
+    # Mid-case (funcs_test.go:204-212)
+    score = score_fit(node, Resources(cpu=1024, memory_mb=2048))
+    assert 10.0 < score < 16.0
+    assert score == pytest.approx(20.0 - 2 * (10 ** 0.5))
+
+
+def test_filter_and_remove_allocs():
+    a1 = Allocation(id="1", desired_status=structs.ALLOC_DESIRED_STATUS_RUN)
+    a2 = Allocation(id="2", desired_status=structs.ALLOC_DESIRED_STATUS_STOP)
+    a3 = Allocation(id="3", desired_status=structs.ALLOC_DESIRED_STATUS_EVICT)
+    a4 = Allocation(id="4", desired_status=structs.ALLOC_DESIRED_STATUS_FAILED)
+    assert filter_terminal_allocs([a1, a2, a3, a4]) == [a1]
+    assert remove_allocs([a1, a2, a3], [a2]) == [a1, a3]
+
+
+def test_plan_helpers():
+    plan = Plan(node_update={}, node_allocation={})
+    alloc = mock.alloc()
+    plan.append_update(alloc, structs.ALLOC_DESIRED_STATUS_STOP, "test")
+    assert len(plan.node_update[alloc.node_id]) == 1
+    assert plan.node_update[alloc.node_id][0].desired_status == "stop"
+    # Original untouched
+    assert alloc.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+    plan.pop_update(alloc)
+    assert alloc.node_id not in plan.node_update
+    assert plan.is_noop()
+
+    plan.append_alloc(alloc)
+    assert not plan.is_noop()
+
+    result = PlanResult(node_allocation={alloc.node_id: [alloc]})
+    full, expected, actual = result.full_commit(plan)
+    assert full and expected == 1 and actual == 1
+
+    result2 = PlanResult()
+    full, expected, actual = result2.full_commit(plan)
+    assert not full and expected == 1 and actual == 0
+
+
+def test_network_index():
+    node = mock.node()
+    idx = NetworkIndex()
+    assert not idx.set_node(node)
+    assert idx.avail_bandwidth["eth0"] == 1000
+    assert idx.used_bandwidth["eth0"] == 1
+    assert 22 in idx.used_ports["192.168.0.100"]
+
+    # Assign a network with a dynamic port
+    ask = NetworkResource(mbits=50, dynamic_ports=["http"])
+    offer, err = idx.assign_network(ask)
+    assert offer is not None, err
+    assert offer.ip == "192.168.0.100"
+    assert len(offer.reserved_ports) == 1
+    port = offer.reserved_ports[0]
+    assert 20000 <= port < 60000
+    assert offer.map_dynamic_ports() == {"http": port}
+
+    # Bandwidth exceeded
+    big = NetworkResource(mbits=10000)
+    offer, err = idx.assign_network(big)
+    assert offer is None
+    assert err == "bandwidth exceeded"
+
+    # Reserved port collision
+    taken = NetworkResource(mbits=1, reserved_ports=[22])
+    offer, err = idx.assign_network(taken)
+    assert offer is None
+    assert err == "reserved port collision"
+
+
+def test_network_overcommitted():
+    idx = NetworkIndex()
+    idx.avail_bandwidth["eth0"] = 100
+    idx.used_bandwidth["eth0"] = 50
+    assert not idx.overcommitted()
+    idx.used_bandwidth["eth0"] = 150
+    assert idx.overcommitted()
+
+
+def test_version_constraints():
+    assert check_version_constraint("1.2.3", ">= 1.0")
+    assert check_version_constraint("1.2.3", ">= 1.0, < 2.0")
+    assert not check_version_constraint("2.1.0", ">= 1.0, < 2.0")
+    assert check_version_constraint("1.2.3", "~> 1.2")
+    assert not check_version_constraint("1.3.0", "~> 1.2.0")
+    assert check_version_constraint("1.2.9", "~> 1.2.0")
+    assert check_version_constraint("0.1.0", "= 0.1.0")
+    assert not check_version_constraint("0.1.1", "= 0.1.0")
+    assert check_version_constraint("0.1.1", "!= 0.1.0")
+    # Parse failures -> False
+    assert not check_version_constraint("banana", ">= 1.0")
+    assert not check_version_constraint("1.0", "banana")
+
+
+def test_eval_make_plan_and_rolling():
+    ev = mock.evaluation()
+    job = mock.job()
+    plan = ev.make_plan(job)
+    assert plan.eval_id == ev.id
+    assert plan.priority == ev.priority
+    assert plan.all_at_once == job.all_at_once
+
+    rolling = ev.next_rolling_eval(30.0)
+    assert rolling.previous_eval == ev.id
+    assert rolling.wait == 30.0
+    assert rolling.triggered_by == structs.EVAL_TRIGGER_ROLLING_UPDATE
+    assert rolling.job_id == ev.job_id
+    assert rolling.id != ev.id
+
+
+def test_should_drain_node():
+    assert not structs.should_drain_node(structs.NODE_STATUS_INIT)
+    assert not structs.should_drain_node(structs.NODE_STATUS_READY)
+    assert structs.should_drain_node(structs.NODE_STATUS_DOWN)
+    with pytest.raises(ValueError):
+        structs.should_drain_node("bogus")
