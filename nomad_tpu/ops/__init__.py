@@ -1,0 +1,7 @@
+"""TPU compute kernels: dense constraint-mask + argmax bin-pack.
+
+This package is the device-side reformulation of the reference's per-node
+iterator chain (/root/reference/scheduler/feasible.go, rank.go, select.go):
+feasibility becomes boolean mask tensors, ranking becomes a fused fit+score
+kernel over the node axis, and selection becomes masked argmax / top-k.
+"""

@@ -1,0 +1,199 @@
+"""Greedy bin-pack solvers over the node axis.
+
+Two device paths, both jitted with bucketed shapes to avoid recompilation
+storms (SURVEY.md §7 "Hard parts: dynamic shapes"):
+
+- ``solve_greedy``: lax.scan of k masked-argmax placements, preserving the
+  reference's one-at-a-time Select semantics (/root/reference/scheduler/
+  stack.go:131-159): each step recomputes fit + BestFit score + anti-affinity
+  penalty against the utilization carried from earlier placements.
+
+- ``solve_round``: one fused dispatch that places up to r tasks in a single
+  round, one per node, ordered by score. In the anti-affinity regime (penalty
+  10/5 dominates the per-placement BestFit delta, stack.go:10-19) greedy
+  provably round-robins across fitting nodes, so repeated rounds reproduce
+  greedy's outcome at a fraction of the dispatches — this is what makes
+  100k-task evals a handful of device calls instead of 100k.
+
+The node axis is shardable: see nomad_tpu.parallel.mesh for the pjit
+wrapping used on multi-chip meshes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nomad_tpu.ops.fit import NEG_INF, score_fit
+
+
+def bucket(n: int, floor: int = 8) -> int:
+    """Next power-of-two bucket for padding jit shapes."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
+def _greedy_step_state(
+    total, sched_cap, used, job_count, tg_count, bw_avail, bw_used,
+    eligible, ask, bw_ask, penalty, job_distinct, tg_distinct,
+):
+    """Compute (score, fit) for one placement given current utilization.
+
+    job_distinct/tg_distinct mirror the two distinct_hosts scopes of
+    ProposedAllocConstraintIterator (feasible.go:218-247): a job-level
+    constraint rejects any same-job alloc, a tg-level one rejects only
+    same-job+same-tg collisions.
+    """
+    used_plus = used + ask[None, :]
+    fit = jnp.all(used_plus <= total, axis=-1)
+    fit = fit & ((bw_used + bw_ask) <= bw_avail)
+    fit = fit & eligible
+    if job_distinct:
+        fit = fit & (job_count == 0)
+    if tg_distinct:
+        fit = fit & (tg_count == 0)
+    score = score_fit(sched_cap, used_plus[:, :2].astype(jnp.float32))
+    score = score - penalty * job_count.astype(jnp.float32)
+    score = jnp.where(fit, score, NEG_INF)
+    return score, fit
+
+
+@partial(jax.jit, static_argnames=("k", "job_distinct", "tg_distinct"))
+def solve_greedy(
+    total: jnp.ndarray,       # [N, D] int32 node totals
+    sched_cap: jnp.ndarray,   # [N, 2] float32 schedulable cpu/mem
+    used0: jnp.ndarray,       # [N, D] int32 utilization incl. reserved
+    job_count0: jnp.ndarray,  # [N] int32 proposed same-job allocs
+    tg_count0: jnp.ndarray,   # [N] int32 proposed same-job+tg allocs
+    bw_avail: jnp.ndarray,    # [N] int32 NIC bandwidth
+    bw_used0: jnp.ndarray,    # [N] int32 used bandwidth
+    eligible: jnp.ndarray,    # [N] bool feasibility mask
+    ask: jnp.ndarray,         # [D] int32 task-group resource ask
+    bw_ask: jnp.ndarray,      # [] int32 task-group bandwidth ask
+    active: jnp.ndarray,      # [k] bool - False entries are shape padding
+    penalty: jnp.ndarray,     # [] float32 anti-affinity penalty
+    k: int,
+    job_distinct: bool,
+    tg_distinct: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Place k copies of one ask sequentially; returns (node_idx[k], ok[k],
+    score[k]). Exact greedy semantics of the reference's Select loop."""
+    n = total.shape[0]
+    arange = jnp.arange(n)
+
+    def step(carry, is_active):
+        used, job_count, tg_count, bw_used = carry
+        score, _fit = _greedy_step_state(
+            total, sched_cap, used, job_count, tg_count, bw_avail, bw_used,
+            eligible, ask, bw_ask, penalty, job_distinct, tg_distinct,
+        )
+        idx = jnp.argmax(score)
+        ok = (score[idx] > NEG_INF) & is_active
+        onehot = (arange == idx) & ok
+        used = used + onehot[:, None] * ask[None, :]
+        job_count = job_count + onehot
+        tg_count = tg_count + onehot
+        bw_used = bw_used + onehot * bw_ask
+        return (used, job_count, tg_count, bw_used), (idx, ok, score[idx])
+
+    _, (idxs, oks, scores) = lax.scan(
+        step, (used0, job_count0, tg_count0, bw_used0), active
+    )
+    return idxs, oks, scores
+
+
+@partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
+def solve_round(
+    total: jnp.ndarray,
+    sched_cap: jnp.ndarray,
+    used0: jnp.ndarray,
+    job_count0: jnp.ndarray,
+    tg_count0: jnp.ndarray,
+    bw_avail: jnp.ndarray,
+    bw_used0: jnp.ndarray,
+    eligible: jnp.ndarray,
+    ask: jnp.ndarray,
+    bw_ask: jnp.ndarray,
+    remaining: jnp.ndarray,   # [] int32 tasks still to place
+    penalty: jnp.ndarray,
+    job_distinct: bool,
+    tg_distinct: bool,
+):
+    """One round: place min(remaining, #fitting-nodes) tasks, at most one per
+    node, on the best-scoring nodes. Returns (selected[N] bool, new state...).
+    """
+    score, fit = _greedy_step_state(
+        total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+        eligible, ask, bw_ask, penalty, job_distinct, tg_distinct,
+    )
+    n = total.shape[0]
+    # Rank of each node among fitting nodes by descending score.
+    order = jnp.argsort(-score)  # best first; -inf (unfit) sink to the end
+    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    selected = fit & (rank < remaining)
+    n_placed = selected.sum()
+
+    used = used0 + selected[:, None] * ask[None, :]
+    job_count = job_count0 + selected
+    tg_count = tg_count0 + selected
+    bw_used = bw_used0 + selected * bw_ask
+    return selected, n_placed, used, job_count, tg_count, bw_used
+
+
+def solve_many(
+    total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+    eligible, ask, bw_ask, count: int, penalty: float,
+    job_distinct: bool = False, tg_distinct: bool = False,
+    exact_threshold: int = 128,
+):
+    """Place ``count`` copies of one ask. Dispatches the exact scan for small
+    counts and the round solver for large ones.
+
+    Returns (node_indices: list[int], ok: list[bool]) of length count, in
+    placement order.
+    """
+    if count <= exact_threshold:
+        k = bucket(count)
+        active = jnp.arange(k) < count
+        idxs, oks, _scores = solve_greedy(
+            total, sched_cap, used0, job_count0, tg_count0, bw_avail,
+            bw_used0, eligible, ask, bw_ask, active,
+            jnp.float32(penalty), k, job_distinct, tg_distinct,
+        )
+        idxs = jax.device_get(idxs)[:count]
+        oks = jax.device_get(oks)[:count]
+        return list(map(int, idxs)), list(map(bool, oks))
+
+    # Round solver: each round places <=1 task per node, best nodes first.
+    placements: list[int] = []
+    used, job_count, tg_count, bw_used = used0, job_count0, tg_count0, bw_used0
+    remaining = count
+    while remaining > 0:
+        selected, n_placed, used, job_count, tg_count, bw_used = solve_round(
+            total, sched_cap, used, job_count, tg_count, bw_avail, bw_used,
+            eligible, ask, bw_ask, jnp.int32(remaining),
+            jnp.float32(penalty), job_distinct, tg_distinct,
+        )
+        n_placed = int(n_placed)
+        if n_placed == 0:
+            break
+        sel_idx = jnp.nonzero(selected, size=n_placed)[0]
+        placements.extend(map(int, jax.device_get(sel_idx)))
+        remaining -= n_placed
+        if job_distinct or tg_distinct:
+            # One round is all a distinct-hosts group can ever place.
+            break
+
+    oks = [True] * len(placements) + [False] * (count - len(placements))
+    # Unplaceable tail points nowhere.
+    placements.extend([-1] * (count - len(placements)))
+    return placements, oks

@@ -1,0 +1,49 @@
+"""Fused fit + score kernels.
+
+The dense equivalents of AllocsFit and ScoreFit
+(/root/reference/nomad/structs/funcs.go:44-124) over the node axis:
+
+- ``fit_mask``: ``all(used + ask <= total, axis=-1)`` — the Superset check
+  (structs.go:577-594) vectorized over N nodes.
+- ``score_fit``: Google "BestFit v3" — ``20 - 10^freeCpu - 10^freeMem``,
+  clamped to [0, 18], where free fractions are measured against schedulable
+  capacity (total - reserved) and utilization includes the node's reserved
+  resources, exactly as the scalar oracle does.
+
+All functions are shape-polymorphic pure jax; they are jit-composed by
+nomad_tpu.ops.binpack.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = -jnp.inf
+
+
+def score_fit(sched_capacity: jnp.ndarray, used: jnp.ndarray) -> jnp.ndarray:
+    """BestFit v3 score per node.
+
+    sched_capacity: [N, 2] float — schedulable (total - reserved) cpu, mem.
+    used:           [N, 2] float — utilization including reserved.
+    Returns [N] float scores in [0, 18]; higher = fuller = preferred.
+    """
+    safe_cap = jnp.maximum(sched_capacity, 1.0)
+    free = 1.0 - used / safe_cap
+    # Zero schedulable capacity -> -inf free -> 10**x underflows to 0,
+    # matching the scalar oracle's Inf-tolerant behavior.
+    free = jnp.where(sched_capacity > 0, free, NEG_INF)
+    total = jnp.power(10.0, free).sum(axis=-1)
+    return jnp.clip(20.0 - total, 0.0, 18.0)
+
+
+def fit_mask(
+    total: jnp.ndarray, used_plus_ask: jnp.ndarray
+) -> jnp.ndarray:
+    """Dimension-wise resource fit per node.
+
+    total:         [N, D] int — node total resources.
+    used_plus_ask: [N, D] int — proposed utilization incl. the new ask.
+    Returns [N] bool.
+    """
+    return jnp.all(used_plus_ask <= total, axis=-1)

@@ -1,0 +1,99 @@
+"""Scheduler package: pure placement logic behind a Factory registry.
+
+Mirrors the reference seam (/root/reference/scheduler/scheduler.go:13-87):
+schedulers are constructed by name from ``BUILTIN_SCHEDULERS``, receive an
+immutable ``State`` view and a ``Planner``, and process one Evaluation at a
+time. The TPU solver registers here as additional factories
+(``tpu-service``/``tpu-batch`` and the coalescing batch dispatcher), so the
+control plane dispatches evals to it without knowing about devices.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional, Protocol, Tuple
+
+from nomad_tpu.structs import Evaluation, Plan, PlanResult
+
+
+class SchedulerError(Exception):
+    pass
+
+
+class SetStatusError(SchedulerError):
+    """Processing failed and the eval should be moved to ``eval_status``
+    (reference: generic_sched.go:32-40)."""
+
+    def __init__(self, err: str, eval_status: str):
+        super().__init__(err)
+        self.eval_status = eval_status
+
+
+class State(Protocol):
+    """Immutable view of global state (reference: scheduler/scheduler.go:55-71)."""
+
+    def nodes(self): ...
+    def allocs_by_job(self, job_id: str): ...
+    def allocs_by_node(self, node_id: str): ...
+    def node_by_id(self, node_id: str): ...
+    def job_by_id(self, job_id: str): ...
+
+
+class Planner(Protocol):
+    """Plan submission interface (reference: scheduler/scheduler.go:74-87)."""
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[State]]: ...
+    def update_eval(self, ev: Evaluation) -> None: ...
+    def create_eval(self, ev: Evaluation) -> None: ...
+
+
+class Scheduler(Protocol):
+    def process(self, ev: Evaluation) -> None: ...
+
+
+Factory = Callable[[State, Planner, logging.Logger], Scheduler]
+
+BUILTIN_SCHEDULERS: Dict[str, Factory] = {}
+
+
+def register(name: str, factory: Factory) -> None:
+    BUILTIN_SCHEDULERS[name] = factory
+
+
+def new_scheduler(
+    name: str,
+    state: State,
+    planner: Planner,
+    logger: Optional[logging.Logger] = None,
+) -> Scheduler:
+    """Instantiate a scheduler by name (reference: scheduler.go:19-31)."""
+    factory = BUILTIN_SCHEDULERS.get(name)
+    if factory is None:
+        raise SchedulerError(f"unknown scheduler '{name}'")
+    return factory(state, planner, logger or logging.getLogger("nomad_tpu.sched"))
+
+
+def _register_builtins() -> None:
+    from nomad_tpu.scheduler.generic import new_batch_scheduler, new_service_scheduler
+    from nomad_tpu.scheduler.system import new_system_scheduler
+
+    register("service", new_service_scheduler)
+    register("batch", new_batch_scheduler)
+    register("system", new_system_scheduler)
+
+    # The TPU factories live behind a lazy import so the control plane can
+    # run host-only (e.g. on machines without jax).
+    def _lazy_tpu(variant: str) -> Factory:
+        def factory(state, planner, logger):
+            from nomad_tpu.tpu import solver
+
+            return solver.new_tpu_scheduler(variant, state, planner, logger)
+
+        return factory
+
+    register("tpu-service", _lazy_tpu("service"))
+    register("tpu-batch", _lazy_tpu("batch"))
+    register("tpu-system", _lazy_tpu("system"))
+
+
+_register_builtins()

@@ -1,0 +1,62 @@
+"""Per-evaluation placement context.
+
+Reference: /root/reference/scheduler/context.go:11-126. The key method is
+``proposed_allocs``: the optimistic per-node view every ranking decision is
+made against — existing allocs, minus terminal, minus planned evictions,
+plus planned placements.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Pattern
+
+from nomad_tpu.structs import (
+    Allocation,
+    AllocMetric,
+    Plan,
+    filter_terminal_allocs,
+    remove_allocs,
+)
+
+
+class EvalContext:
+    """Context for one evaluation (reference: context.go:59-126)."""
+
+    def __init__(self, state, plan: Plan, logger: Optional[logging.Logger] = None):
+        self._state = state
+        self._plan = plan
+        self._logger = logger or logging.getLogger("nomad_tpu.sched")
+        self._metrics = AllocMetric()
+        self.regexp_cache: Dict[str, Pattern] = {}
+        self.constraint_cache: Dict[str, object] = {}
+
+    @property
+    def state(self):
+        return self._state
+
+    def set_state(self, state) -> None:
+        self._state = state
+
+    @property
+    def plan(self) -> Plan:
+        return self._plan
+
+    @property
+    def logger(self) -> logging.Logger:
+        return self._logger
+
+    def metrics(self) -> AllocMetric:
+        return self._metrics
+
+    def reset(self) -> None:
+        """Invoked after each placement (context.go:99-101)."""
+        self._metrics = AllocMetric()
+
+    def proposed_allocs(self, node_id: str) -> List[Allocation]:
+        """Existing allocs − terminal − planned evictions + planned
+        placements (context.go:103-126)."""
+        existing = filter_terminal_allocs(self._state.allocs_by_node(node_id))
+        update = self._plan.node_update.get(node_id, [])
+        proposed = remove_allocs(existing, update) if update else existing
+        return proposed + self._plan.node_allocation.get(node_id, [])

@@ -1,0 +1,7 @@
+"""TPU placement solver: the device-backed implementation of the Stack seam.
+
+``nomad_tpu.tpu.mirror`` tensorizes node state; ``nomad_tpu.tpu.solver``
+implements the Stack protocol (set_nodes/set_job/select) plus the batched
+``select_many`` entry the TPU schedulers use to place a whole task-group
+count in a handful of device dispatches.
+"""

@@ -1,0 +1,148 @@
+"""Node state mirror: dense tensors for the solver.
+
+The TPU analog of the reference's per-node iterator inputs: node resources
+become an ``[N, 4]`` matrix (RESOURCE_DIMS order), bandwidth a vector, and
+feasibility predicates become boolean masks (SURVEY.md §7 "State mirror" /
+"Feasibility = boolean mask tensors").
+
+Masks for the common constraint operands are evaluated host-side over the
+node table (they are string ops; regex/version stay host-side by design,
+reference feasible.go:405-479) and shipped to the device as the ``eligible``
+input of the solve. The node axis is padded to power-of-two buckets so jit
+caches stay warm across varying cluster sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from nomad_tpu.ops.binpack import bucket
+from nomad_tpu.scheduler.feasible import (
+    _parse_bool,
+    check_constraint,
+    resolve_constraint_target,
+)
+from nomad_tpu.structs import Constraint, Node, Resources
+
+
+def _res_vec(r: Optional[Resources]) -> np.ndarray:
+    if r is None:
+        return np.zeros(4, dtype=np.int32)
+    return np.array(r.as_vector(), dtype=np.int32)
+
+
+def _task_bw(task_resources: Dict[str, Resources]) -> int:
+    total = 0
+    for res in task_resources.values():
+        if res.networks:
+            total += res.networks[0].mbits
+    return total
+
+
+class NodeMirror:
+    """Dense mirror of a node set, padded to a shape bucket."""
+
+    def __init__(self, nodes: List[Node]):
+        self.nodes = nodes
+        self.n = len(nodes)
+        self.padded = bucket(max(self.n, 1))
+        self.index = {node.id: i for i, node in enumerate(nodes)}
+
+        total = np.zeros((self.padded, 4), dtype=np.int32)
+        reserved = np.zeros((self.padded, 4), dtype=np.int32)
+        bw_avail = np.zeros(self.padded, dtype=np.int32)
+        bw_reserved = np.zeros(self.padded, dtype=np.int32)
+        for i, node in enumerate(nodes):
+            total[i] = _res_vec(node.resources)
+            reserved[i] = _res_vec(node.reserved)
+            if node.resources is not None:
+                # Coarse bandwidth feasibility models the first NIC, the
+                # common shape; exact port assignment is a host post-pass.
+                bw_avail[i] = sum(
+                    net.mbits for net in node.resources.networks if net.device
+                )
+            if node.reserved is not None:
+                bw_reserved[i] = sum(net.mbits for net in node.reserved.networks)
+
+        self.total = jnp.asarray(total)
+        self.reserved_np = reserved
+        sched = (total - reserved)[:, :2].astype(np.float32)
+        self.sched_cap = jnp.asarray(sched)
+        self.bw_avail = jnp.asarray(bw_avail)
+        self.bw_reserved = bw_reserved
+        self.base_mask = np.zeros(self.padded, dtype=bool)
+        self.base_mask[: self.n] = True
+
+        self._driver_mask_cache: Dict[frozenset, np.ndarray] = {}
+        self._constraint_mask_cache: Dict[Tuple, np.ndarray] = {}
+
+    # -- eligibility masks -------------------------------------------------
+
+    def driver_mask(self, drivers: Set[str]) -> np.ndarray:
+        """Vectorized DriverIterator (reference: feasible.go:127-151)."""
+        key = frozenset(drivers)
+        cached = self._driver_mask_cache.get(key)
+        if cached is not None:
+            return cached
+        mask = self.base_mask.copy()
+        for i, node in enumerate(self.nodes):
+            for driver in drivers:
+                value = node.attributes.get(f"driver.{driver}")
+                enabled = _parse_bool(value) if value is not None else None
+                if not enabled:
+                    mask[i] = False
+                    break
+        self._driver_mask_cache[key] = mask
+        return mask
+
+    def constraint_mask(self, ctx, constraints: List[Constraint]) -> np.ndarray:
+        """Vectorized ConstraintIterator (reference: feasible.go:295-317).
+
+        Evaluated host-side over the node table; results are cached per
+        constraint tuple for the lifetime of the mirror.
+        """
+        key = tuple((c.l_target, c.operand, c.r_target) for c in constraints)
+        cached = self._constraint_mask_cache.get(key)
+        if cached is not None:
+            return cached
+        mask = self.base_mask.copy()
+        for c in constraints:
+            for i, node in enumerate(self.nodes):
+                if not mask[i]:
+                    continue
+                l_val, l_ok = resolve_constraint_target(c.l_target, node)
+                r_val, r_ok = resolve_constraint_target(c.r_target, node)
+                if not l_ok or not r_ok or not check_constraint(
+                    ctx, c.operand, l_val, r_val
+                ):
+                    mask[i] = False
+        self._constraint_mask_cache[key] = mask
+        return mask
+
+    # -- utilization tensors ----------------------------------------------
+
+    def build_usage(self, ctx, job_id: str, tg_name: str):
+        """Build (used, job_count, tg_count, bw_used) from the eval context's
+        optimistic proposed-alloc view (reference: context.go:103-126 feeding
+        rank.go:170-221)."""
+        used = self.reserved_np.copy()
+        bw_used = self.bw_reserved.copy()
+        job_count = np.zeros(self.padded, dtype=np.int32)
+        tg_count = np.zeros(self.padded, dtype=np.int32)
+        for i, node in enumerate(self.nodes):
+            for alloc in ctx.proposed_allocs(node.id):
+                used[i] += _res_vec(alloc.resources)
+                bw_used[i] += _task_bw(alloc.task_resources)
+                if alloc.job_id == job_id:
+                    job_count[i] += 1
+                    if alloc.task_group == tg_name:
+                        tg_count[i] += 1
+        return (
+            jnp.asarray(used),
+            jnp.asarray(job_count),
+            jnp.asarray(tg_count),
+            jnp.asarray(bw_used),
+        )

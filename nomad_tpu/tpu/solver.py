@@ -1,0 +1,413 @@
+"""TPU placement solver: Stack-protocol implementation + batched schedulers.
+
+``TPUStack`` is a drop-in for the reference's GenericStack/SystemStack seam
+(/root/reference/scheduler/stack.go:24-33): set_nodes/set_job/select. Instead
+of walking a chained iterator per candidate node, it tensorizes the node set
+(nomad_tpu.tpu.mirror) and solves placement as a dense constraint-mask +
+argmax bin-pack on device (nomad_tpu.ops.binpack).
+
+Differences from the host oracle, by design:
+- The host GenericStack ranks only a random ~log2(n) subset of feasible
+  nodes (power-of-two-choices, stack.go:94-121); the dense solve scores
+  every node at no extra cost, so placement quality is >= host.
+- Network *port* assignment stays a host post-pass on the selected node
+  (sparse + sequential, network.go:136-194); only dense bandwidth
+  feasibility rides the device solve.
+
+``TPUGenericScheduler``/``TPUSystemScheduler`` reuse the host schedulers'
+diff/update/plan logic wholesale and replace the per-placement Select loop
+with one batched ``select_many`` per task group — one to a handful of device
+dispatches per evaluation regardless of count.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from nomad_tpu.network import NetworkIndex
+from nomad_tpu.ops.binpack import solve_many
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import _has_distinct_hosts
+from nomad_tpu.scheduler.generic import GenericScheduler
+from nomad_tpu.scheduler.rank import RankedNode
+from nomad_tpu.scheduler.stack import (
+    BATCH_JOB_ANTI_AFFINITY_PENALTY,
+    SERVICE_JOB_ANTI_AFFINITY_PENALTY,
+)
+from nomad_tpu.scheduler.system import SystemScheduler
+from nomad_tpu.scheduler.util import (
+    AllocTuple,
+    ready_nodes_in_dcs,
+    task_group_constraints,
+)
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_DESIRED_STATUS_FAILED,
+    ALLOC_DESIRED_STATUS_RUN,
+    Allocation,
+    Job,
+    Node,
+    Resources,
+    TaskGroup,
+    generate_uuid,
+)
+from nomad_tpu.tpu.mirror import NodeMirror
+
+
+class _Placement:
+    """One successful placement out of a batched solve."""
+
+    __slots__ = ("node", "task_resources", "score")
+
+    def __init__(self, node: Node, task_resources: Dict[str, Resources], score: float):
+        self.node = node
+        self.task_resources = task_resources
+        self.score = score
+
+
+class _SolveInputs:
+    """Device inputs for one task-group solve, assembled by TPUStack.prepare."""
+
+    __slots__ = (
+        "mask", "used", "job_count", "tg_count", "bw_used",
+        "ask", "ask_np", "bw_ask", "bw_ask_val", "job_distinct", "tg_distinct",
+    )
+
+    def __init__(self, mask, used, job_count, tg_count, bw_used, ask, ask_np,
+                 bw_ask, bw_ask_val, job_distinct, tg_distinct):
+        self.mask = mask
+        self.used = used
+        self.job_count = job_count
+        self.tg_count = tg_count
+        self.bw_used = bw_used
+        self.ask = ask
+        self.ask_np = ask_np
+        self.bw_ask = bw_ask
+        self.bw_ask_val = bw_ask_val
+        self.job_distinct = job_distinct
+        self.tg_distinct = tg_distinct
+
+
+class TPUStack:
+    """Dense-solve Stack (service/batch/system variants)."""
+
+    def __init__(self, ctx: EvalContext, batch: bool = False, system: bool = False):
+        self.ctx = ctx
+        self.batch = batch
+        self.system = system
+        if system:
+            self.penalty = 0.0
+        else:
+            self.penalty = (
+                BATCH_JOB_ANTI_AFFINITY_PENALTY
+                if batch
+                else SERVICE_JOB_ANTI_AFFINITY_PENALTY
+            )
+        self.job: Optional[Job] = None
+        self.mirror: Optional[NodeMirror] = None
+
+    def set_nodes(self, nodes: List[Node]) -> None:
+        # No shuffle needed: the solve is a global argmax, not a sampled scan.
+        self.mirror = NodeMirror(nodes)
+
+    def set_job(self, job: Job) -> None:
+        self.job = job
+
+    # -- core batched solve ------------------------------------------------
+
+    def select_many(self, tg: TaskGroup, count: int) -> Tuple[List[Optional[_Placement]], Resources]:
+        """Place ``count`` copies of a task group in one batched device solve.
+
+        Returns (placements, size): ``placements[i]`` is None when no node
+        was found for the i-th copy.
+        """
+        start = time.perf_counter()
+        tg_constr = task_group_constraints(tg)
+        prep = self.prepare(tg, tg_constr)
+        if prep is None:
+            self.ctx.metrics().allocation_time = time.perf_counter() - start
+            return [None] * count, tg_constr.size
+
+        idxs, oks = solve_many(
+            self.mirror.total, self.mirror.sched_cap, prep.used,
+            prep.job_count, prep.tg_count, self.mirror.bw_avail, prep.bw_used,
+            prep.mask, prep.ask, prep.bw_ask, count, self.penalty,
+            job_distinct=prep.job_distinct, tg_distinct=prep.tg_distinct,
+        )
+
+        placements = self._offer_networks(tg, idxs, oks)
+        self.ctx.metrics().allocation_time = time.perf_counter() - start
+        return placements, tg_constr.size
+
+    def prepare(self, tg: TaskGroup, tg_constr) -> Optional["_SolveInputs"]:
+        """Assemble the device inputs for one task group: eligibility mask,
+        utilization tensors, ask vectors, distinct-hosts scopes. Shared by
+        select_many and the batched system scheduler. Returns None when the
+        node set is empty."""
+        mirror = self.mirror
+        metrics = self.ctx.metrics()
+        if mirror is None or mirror.n == 0:
+            return None
+
+        # Eligibility: drivers + job & tg constraints, all as masks.
+        mask = mirror.driver_mask(tg_constr.drivers)
+        if self.job is not None and self.job.constraints:
+            mask = mask & mirror.constraint_mask(self.ctx, self.job.constraints)
+        if tg_constr.constraints:
+            mask = mask & mirror.constraint_mask(self.ctx, tg_constr.constraints)
+
+        metrics.evaluate_node(mirror.n)
+        n_filtered = int(mirror.n - mask[: mirror.n].sum())
+        if n_filtered:
+            metrics.filter_node(None, "constraint-mask", n_filtered)
+
+        job_distinct = False
+        tg_distinct = _has_distinct_hosts(tg.constraints)
+        if self.job is not None:
+            job_distinct = _has_distinct_hosts(self.job.constraints)
+
+        job_id = self.job.id if self.job is not None else ""
+        used, job_count, tg_count, bw_used = mirror.build_usage(
+            self.ctx, job_id, tg.name
+        )
+        ask_np = np.array(tg_constr.size.as_vector(), dtype=np.int32)
+        bw_ask_val = sum(
+            t.resources.networks[0].mbits
+            for t in tg.tasks
+            if t.resources and t.resources.networks
+        )
+        return _SolveInputs(
+            mask=jnp.asarray(mask), used=used, job_count=job_count,
+            tg_count=tg_count, bw_used=bw_used, ask=jnp.asarray(ask_np),
+            ask_np=ask_np, bw_ask=jnp.int32(bw_ask_val), bw_ask_val=bw_ask_val,
+            job_distinct=job_distinct, tg_distinct=tg_distinct,
+        )
+
+    def _offer_networks(
+        self, tg: TaskGroup, idxs: List[int], oks: List[bool]
+    ) -> List[Optional[_Placement]]:
+        """Host post-pass: assign IPs + ports on each selected node, tracking
+        offers made earlier in this batch (mirrors rank.go:179-211)."""
+        mirror = self.mirror
+        metrics = self.ctx.metrics()
+        net_indexes: Dict[int, NetworkIndex] = {}
+        placements: List[Optional[_Placement]] = []
+
+        for idx, ok in zip(idxs, oks):
+            if not ok or idx < 0 or idx >= mirror.n:
+                placements.append(None)
+                continue
+            node = mirror.nodes[idx]
+
+            net_idx = net_indexes.get(idx)
+            if net_idx is None:
+                net_idx = NetworkIndex()
+                net_idx.set_node(node)
+                net_idx.add_allocs(self.ctx.proposed_allocs(node.id))
+                net_indexes[idx] = net_idx
+
+            task_resources: Dict[str, Resources] = {}
+            failed = False
+            for task in tg.tasks:
+                res = task.resources.copy()
+                if res.networks:
+                    offer, err = net_idx.assign_network(res.networks[0])
+                    if offer is None:
+                        metrics.exhausted_node(node, f"network: {err}")
+                        failed = True
+                        break
+                    net_idx.add_reserved(offer)
+                    res.networks = [offer]
+                task_resources[task.name] = res
+            if failed:
+                placements.append(None)
+                continue
+            placements.append(_Placement(node, task_resources, 0.0))
+        return placements
+
+    # -- Stack protocol ----------------------------------------------------
+
+    def select(self, tg: TaskGroup) -> Tuple[Optional[RankedNode], Resources]:
+        """Single-placement Stack entry, used by inplace_update and host-style
+        callers."""
+        self.ctx.reset()
+        placements, size = self.select_many(tg, 1)
+        placement = placements[0]
+        if placement is None:
+            return None, size
+        option = RankedNode(placement.node)
+        option.score = placement.score
+        option.task_resources = placement.task_resources
+        for task in tg.tasks:
+            if task.name not in option.task_resources:
+                option.task_resources[task.name] = task.resources
+        return option, size
+
+
+class TPUGenericScheduler(GenericScheduler):
+    """GenericScheduler with the dense batched solve
+    (factory names: tpu-service / tpu-batch)."""
+
+    def make_stack(self, ctx: EvalContext) -> TPUStack:
+        return TPUStack(ctx, batch=self.batch)
+
+    def compute_placements(self, place: List[AllocTuple]) -> None:
+        """Batched replacement of generic_sched.go:245-298: one solve per
+        task group instead of one Select per missing alloc."""
+        nodes = ready_nodes_in_dcs(self.state, self.job.datacenters)
+        self.stack.set_nodes(nodes)
+
+        # Group the missing allocs by task group, preserving order.
+        groups: Dict[int, Tuple[TaskGroup, List[AllocTuple]]] = {}
+        for missing in place:
+            key = id(missing.task_group)
+            groups.setdefault(key, (missing.task_group, []))[1].append(missing)
+
+        for tg, missing_list in groups.values():
+            self.ctx.reset()
+            placements, size = self.stack.select_many(tg, len(missing_list))
+            failed_alloc: Optional[Allocation] = None
+
+            for missing, placement in zip(missing_list, placements):
+                if placement is None and failed_alloc is not None:
+                    failed_alloc.metrics.coalesced_failures += 1
+                    continue
+
+                alloc = Allocation(
+                    id=generate_uuid(),
+                    eval_id=self.eval.id,
+                    name=missing.name,
+                    job_id=self.job.id,
+                    job=self.job,
+                    task_group=tg.name,
+                    resources=size,
+                    metrics=self.ctx.metrics(),
+                )
+                if placement is not None:
+                    alloc.node_id = placement.node.id
+                    alloc.task_resources = placement.task_resources
+                    alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
+                    alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+                    self.plan.append_alloc(alloc)
+                else:
+                    alloc.desired_status = ALLOC_DESIRED_STATUS_FAILED
+                    alloc.desired_description = "failed to find a node for placement"
+                    alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+                    self.plan.append_failed(alloc)
+                    failed_alloc = alloc
+
+
+class TPUSystemScheduler(SystemScheduler):
+    """SystemScheduler with a vectorized per-node fit: all pinned placements
+    of a task group are checked in one dispatch (factory: tpu-system)."""
+
+    def make_stack(self, ctx: EvalContext) -> TPUStack:
+        return TPUStack(ctx, system=True)
+
+    def compute_placements(self, place: List[AllocTuple]) -> None:
+        node_by_id = {node.id: node for node in self.nodes}
+        self.stack.set_nodes(self.nodes)
+        mirror = self.stack.mirror
+
+        groups: Dict[int, Tuple[TaskGroup, List[AllocTuple]]] = {}
+        for missing in place:
+            key = id(missing.task_group)
+            groups.setdefault(key, (missing.task_group, []))[1].append(missing)
+
+        from nomad_tpu.ops.binpack import _greedy_step_state
+        from nomad_tpu.scheduler import SchedulerError
+
+        for tg, missing_list in groups.values():
+            self.ctx.reset()
+            tg_constr = task_group_constraints(tg)
+            metrics = self.ctx.metrics()
+            prep = self.stack.prepare(tg, tg_constr)
+            if prep is None:
+                continue
+
+            # One dispatch: fit + score for every node at once.
+            _score, fit = _greedy_step_state(
+                mirror.total, mirror.sched_cap, prep.used, prep.job_count,
+                prep.tg_count, mirror.bw_avail, prep.bw_used, prep.mask,
+                prep.ask, prep.bw_ask, jnp.float32(0.0),
+                prep.job_distinct, prep.tg_distinct,
+            )
+            fit_np = np.asarray(fit)
+            # Host-side in-group accounting: if a node receives more than one
+            # placement in this group, deduct earlier asks before re-checking
+            # (job validation enforces count==1 for system jobs, but the diff
+            # can still repeat nodes; never overcommit).
+            totals_np = np.asarray(mirror.total)
+            used_np = np.asarray(prep.used)
+            bw_avail_np = np.asarray(mirror.bw_avail)
+            bw_used_np = np.asarray(prep.bw_used)
+            placed_on: Dict[int, int] = {}
+
+            failed_alloc: Optional[Allocation] = None
+            for missing in missing_list:
+                node = node_by_id.get(missing.alloc.node_id)
+                if node is None:
+                    raise SchedulerError(
+                        f"could not find node {missing.alloc.node_id!r}"
+                    )
+                idx = mirror.index[node.id]
+                ok = bool(fit_np[idx])
+                if ok and placed_on.get(idx, 0) > 0:
+                    extra = placed_on[idx]
+                    ok = bool(
+                        np.all(
+                            used_np[idx] + (extra + 1) * prep.ask_np
+                            <= totals_np[idx]
+                        )
+                        and bw_used_np[idx] + (extra + 1) * prep.bw_ask_val
+                        <= bw_avail_np[idx]
+                    )
+                placement = None
+                if ok:
+                    placement = self.stack._offer_networks(tg, [idx], [True])[0]
+                if placement is not None:
+                    placed_on[idx] = placed_on.get(idx, 0) + 1
+
+                if placement is None and failed_alloc is not None:
+                    failed_alloc.metrics.coalesced_failures += 1
+                    continue
+
+                alloc = Allocation(
+                    id=generate_uuid(),
+                    eval_id=self.eval.id,
+                    name=missing.name,
+                    job_id=self.job.id,
+                    job=self.job,
+                    task_group=tg.name,
+                    resources=tg_constr.size,
+                    metrics=metrics,
+                )
+                if placement is not None:
+                    alloc.node_id = placement.node.id
+                    alloc.task_resources = placement.task_resources
+                    alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
+                    alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+                    self.plan.append_alloc(alloc)
+                else:
+                    metrics.exhausted_node(node, "resources")
+                    alloc.desired_status = ALLOC_DESIRED_STATUS_FAILED
+                    alloc.desired_description = "failed to find a node for placement"
+                    alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+                    self.plan.append_failed(alloc)
+                    failed_alloc = alloc
+
+
+def new_tpu_scheduler(variant: str, state, planner, logger: logging.Logger):
+    if variant == "service":
+        return TPUGenericScheduler(state, planner, logger, batch=False)
+    if variant == "batch":
+        return TPUGenericScheduler(state, planner, logger, batch=True)
+    if variant == "system":
+        return TPUSystemScheduler(state, planner, logger)
+    raise ValueError(f"unknown TPU scheduler variant {variant!r}")

@@ -1,0 +1,116 @@
+"""Scheduler testing harness.
+
+Port of the reference harness (/root/reference/scheduler/scheduler_test.go:
+32-176): a real in-memory StateStore plus a Planner that records plans and
+applies them directly to state; ``RejectPlan`` forces the refresh/retry path.
+This is the correctness oracle rig shared by the host solver tests and the
+TPU solver differential tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from nomad_tpu.scheduler import Factory, new_scheduler
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Allocation, Evaluation, Plan, PlanResult
+
+logger = logging.getLogger("nomad_tpu.test")
+
+
+class RejectPlan:
+    """Always rejects the plan and forces a state refresh
+    (reference: scheduler_test.go:13-30)."""
+
+    def __init__(self, harness: "Harness"):
+        self.harness = harness
+
+    def submit_plan(self, plan: Plan):
+        result = PlanResult()
+        result.refresh_index = self.harness.next_index()
+        return result, self.harness.state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        pass
+
+    def create_eval(self, ev: Evaluation) -> None:
+        pass
+
+
+class Harness:
+    """Lightweight scheduler harness (reference: scheduler_test.go:32-158)."""
+
+    def __init__(self) -> None:
+        self.state = StateStore()
+        self.planner = None  # custom planner override
+        self._plan_lock = threading.Lock()
+        self.plans: List[Plan] = []
+        self.evals: List[Evaluation] = []
+        self.create_evals: List[Evaluation] = []
+        self._next_index = 1
+        self._index_lock = threading.Lock()
+
+    # -- Planner interface -------------------------------------------------
+
+    def submit_plan(self, plan: Plan):
+        with self._plan_lock:
+            self.plans.append(plan)
+            if self.planner is not None:
+                return self.planner.submit_plan(plan)
+
+            index = self.next_index()
+            result = PlanResult(
+                node_update=plan.node_update,
+                node_allocation=plan.node_allocation,
+                alloc_index=index,
+            )
+
+            allocs: List[Allocation] = []
+            for update_list in plan.node_update.values():
+                allocs.extend(update_list)
+            for alloc_list in plan.node_allocation.values():
+                allocs.extend(alloc_list)
+            allocs.extend(plan.failed_allocs)
+
+            self.state.upsert_allocs(index, allocs)
+            return result, None
+
+    def update_eval(self, ev: Evaluation) -> None:
+        with self._plan_lock:
+            self.evals.append(ev)
+            if self.planner is not None:
+                self.planner.update_eval(ev)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        with self._plan_lock:
+            self.create_evals.append(ev)
+            if self.planner is not None:
+                self.planner.create_eval(ev)
+
+    # -- helpers -----------------------------------------------------------
+
+    def next_index(self) -> int:
+        with self._index_lock:
+            idx = self._next_index
+            self._next_index += 1
+            return idx
+
+    def snapshot(self):
+        return self.state.snapshot()
+
+    def process(self, factory_name: str, ev: Evaluation) -> None:
+        sched = new_scheduler(factory_name, self.snapshot(), self, logger)
+        sched.process(ev)
+
+    def assert_eval_status(self, status: str) -> None:
+        assert len(self.evals) == 1, f"bad evals: {self.evals}"
+        assert self.evals[0].status == status, f"bad: {self.evals[0]}"
+
+
+def flatten(node_map) -> List[Allocation]:
+    out: List[Allocation] = []
+    for alloc_list in node_map.values():
+        out.extend(alloc_list)
+    return out

@@ -1,0 +1,349 @@
+"""Ported service/batch scheduler tests
+(/root/reference/scheduler/generic_sched_test.go).
+
+Parametrized over the host factory and (once registered) the TPU factory so
+both solvers are held to the same oracle.
+"""
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.structs import Evaluation, UpdateStrategy, generate_uuid
+
+from sched_harness import Harness, RejectPlan, flatten
+
+SERVICE_FACTORIES = ["service", "tpu-service"]
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_job_register(factory):
+    """reference: generic_sched_test.go:12-64"""
+    h = Harness()
+    for _ in range(10):
+        h.state.upsert_node(h.next_index(), mock.node())
+
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    planned = flatten(plan.node_allocation)
+    assert len(planned) == 10, plan
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 10
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_job_register_alloc_fail(factory):
+    """reference: generic_sched_test.go:66-114"""
+    h = Harness()
+    # no nodes
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert len(plan.failed_allocs) == 1
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 1
+    assert out[0].metrics.coalesced_failures == 9
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_job_modify(factory):
+    """reference: generic_sched_test.go:116-212"""
+    h = Harness()
+    nodes = [mock.node() for _ in range(10)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = []
+    for i in range(10):
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.job_id = job.id
+        alloc.node_id = nodes[i].id
+        alloc.name = f"my-job.web[{i}]"
+        allocs.append(alloc)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    # Terminal allocs should be ignored
+    terminal = []
+    for i in range(5):
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.job_id = job.id
+        alloc.node_id = nodes[i].id
+        alloc.name = f"my-job.web[{i}]"
+        alloc.desired_status = structs.ALLOC_DESIRED_STATUS_FAILED
+        terminal.append(alloc)
+    h.state.upsert_allocs(h.next_index(), terminal)
+
+    # Update so it cannot be done in place
+    job2 = mock.job()
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].config["command"] = "/bin/other"
+    h.state.upsert_job(h.next_index(), job2)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    update = flatten(plan.node_update)
+    assert len(update) == len(allocs), plan
+    planned = flatten(plan.node_allocation)
+    assert len(planned) == 10
+
+    out = structs.filter_terminal_allocs(h.state.allocs_by_job(job.id))
+    assert len(out) == 10
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_job_modify_rolling(factory):
+    """reference: generic_sched_test.go:214-313"""
+    h = Harness()
+    nodes = [mock.node() for _ in range(10)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = []
+    for i in range(10):
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.job_id = job.id
+        alloc.node_id = nodes[i].id
+        alloc.name = f"my-job.web[{i}]"
+        allocs.append(alloc)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    job2 = mock.job()
+    job2.id = job.id
+    job2.update = UpdateStrategy(stagger=30.0, max_parallel=5)
+    job2.task_groups[0].tasks[0].config["command"] = "/bin/other"
+    h.state.upsert_job(h.next_index(), job2)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    update = flatten(plan.node_update)
+    assert len(update) == job2.update.max_parallel
+    planned = flatten(plan.node_allocation)
+    assert len(planned) == job2.update.max_parallel
+
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+    # Follow-up rolling eval chain
+    ev_update = h.evals[0]
+    assert ev_update.next_eval
+    assert len(h.create_evals) > 0
+    create = h.create_evals[0]
+    assert ev_update.next_eval == create.id
+    assert create.previous_eval == ev_update.id
+    assert create.triggered_by == structs.EVAL_TRIGGER_ROLLING_UPDATE
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_job_modify_in_place(factory):
+    """reference: generic_sched_test.go:315-407"""
+    h = Harness()
+    nodes = [mock.node() for _ in range(10)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = []
+    for i in range(10):
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.job_id = job.id
+        alloc.node_id = nodes[i].id
+        alloc.name = f"my-job.web[{i}]"
+        allocs.append(alloc)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    job2 = mock.job()
+    job2.id = job.id
+    h.state.upsert_job(h.next_index(), job2)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert flatten(plan.node_update) == []
+    planned = flatten(plan.node_allocation)
+    assert len(planned) == 10
+    for p in planned:
+        assert p.job is h.state.job_by_id(job.id) or p.job.modify_index == job2.modify_index
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 10
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+    # Networks must not change on in-place update
+    for alloc in out:
+        for resources in alloc.task_resources.values():
+            assert resources.networks[0].reserved_ports[0] == 5000, alloc
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_job_deregister(factory):
+    """reference: generic_sched_test.go:409-460"""
+    h = Harness()
+    job = mock.job()
+    allocs = []
+    for _ in range(10):
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.job_id = job.id
+        allocs.append(alloc)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_JOB_DEREGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert len(plan.node_update.get("foo", [])) == len(allocs)
+
+    out = structs.filter_terminal_allocs(h.state.allocs_by_job(job.id))
+    assert out == []
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_node_drain(factory):
+    """reference: generic_sched_test.go:462-537"""
+    h = Harness()
+    drain_node = mock.node()
+    drain_node.drain = True
+    h.state.upsert_node(h.next_index(), drain_node)
+
+    for _ in range(10):
+        h.state.upsert_node(h.next_index(), mock.node())
+
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = []
+    for i in range(10):
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.job_id = job.id
+        alloc.node_id = drain_node.id
+        alloc.name = f"my-job.web[{i}]"
+        allocs.append(alloc)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_NODE_UPDATE,
+        job_id=job.id,
+        node_id=drain_node.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert len(plan.node_update[drain_node.id]) == len(allocs)
+    planned = flatten(plan.node_allocation)
+    assert len(planned) == 10
+
+    out = structs.filter_terminal_allocs(h.state.allocs_by_job(job.id))
+    assert len(out) == 10
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_retry_limit(factory):
+    """reference: generic_sched_test.go:539-583"""
+    h = Harness()
+    h.planner = RejectPlan(h)
+
+    for _ in range(10):
+        h.state.upsert_node(h.next_index(), mock.node())
+
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) > 0
+    out = h.state.allocs_by_job(job.id)
+    assert out == []
+    h.assert_eval_status(structs.EVAL_STATUS_FAILED)
+
+
+@pytest.mark.parametrize("factory", SERVICE_FACTORIES)
+def test_bad_trigger(factory):
+    """Unknown trigger reason fails the eval (generic_sched.go:90-98)."""
+    h = Harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by="bogus-trigger",
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+    h.assert_eval_status(structs.EVAL_STATUS_FAILED)

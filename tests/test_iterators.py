@@ -1,0 +1,448 @@
+"""Ported iterator tests (/root/reference/scheduler/feasible_test.go,
+rank_test.go, select_test.go, context_test.go)."""
+
+import logging
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import (
+    ConstraintIterator,
+    DriverIterator,
+    ProposedAllocConstraintIterator,
+    StaticIterator,
+    check_constraint,
+    check_lexical_order,
+    new_random_iterator,
+    resolve_constraint_target,
+)
+from nomad_tpu.scheduler.rank import (
+    BinPackIterator,
+    FeasibleRankIterator,
+    JobAntiAffinityIterator,
+    RankedNode,
+    StaticRankIterator,
+)
+from nomad_tpu.scheduler.select_iter import LimitIterator, MaxScoreIterator
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    Allocation,
+    Constraint,
+    Node,
+    Plan,
+    Resources,
+    Task,
+    generate_uuid,
+)
+
+logger = logging.getLogger("test")
+
+
+def make_context():
+    """Equivalent of testContext (context_test.go:12-26)."""
+    state = StateStore()
+    plan = Plan(node_update={}, node_allocation={})
+    ctx = EvalContext(state, plan, logger)
+    return state, ctx
+
+
+def collect_feasible(iterator):
+    out = []
+    while True:
+        nxt = iterator.next()
+        if nxt is None:
+            return out
+        out.append(nxt)
+
+
+def test_static_iterator_reset():
+    """feasible_test.go:11-40"""
+    _, ctx = make_context()
+    nodes = [mock.node() for _ in range(3)]
+    static = StaticIterator(ctx, nodes)
+
+    for i in range(len(nodes) * 3):
+        if i % 3 == 0:
+            static.reset()
+        assert static.next() is not None
+    static.reset()
+    assert len(collect_feasible(static)) == 3
+
+
+def test_static_iterator_set_nodes():
+    """feasible_test.go:42-57"""
+    _, ctx = make_context()
+    static = StaticIterator(ctx, [mock.node() for _ in range(3)])
+    new_nodes = [mock.node()]
+    static.set_nodes(new_nodes)
+    assert collect_feasible(static) == new_nodes
+
+
+def test_random_iterator():
+    """feasible_test.go:59-77"""
+    _, ctx = make_context()
+    nodes = [mock.node() for _ in range(10)]
+    rand = new_random_iterator(ctx, nodes[:])
+    out = collect_feasible(rand)
+    assert len(out) == 10
+    assert {n.id for n in out} == {n.id for n in nodes}
+
+
+def test_driver_iterator():
+    """feasible_test.go:79-107"""
+    _, ctx = make_context()
+    nodes = [mock.node() for _ in range(4)]
+    nodes[1].attributes["driver.exec"] = "0"
+    nodes[2].attributes["driver.exec"] = "true"
+    nodes[3].attributes["driver.exec"] = "False"
+
+    static = StaticIterator(ctx, nodes)
+    driver = DriverIterator(ctx, static, {"exec"})
+    out = collect_feasible(driver)
+    assert [n.id for n in out] == [nodes[0].id, nodes[2].id]
+
+
+def test_constraint_iterator():
+    """feasible_test.go:109-142"""
+    _, ctx = make_context()
+    nodes = [mock.node() for _ in range(3)]
+    nodes[0].attributes["kernel.name"] = "freebsd"
+    nodes[1].datacenter = "dc2"
+
+    static = StaticIterator(ctx, nodes)
+    constraints = [
+        Constraint(l_target="$node.datacenter", r_target="dc1", operand="="),
+        Constraint(l_target="$attr.kernel.name", r_target="linux", operand="="),
+    ]
+    it = ConstraintIterator(ctx, static, constraints)
+    out = collect_feasible(it)
+    assert [n.id for n in out] == [nodes[2].id]
+
+
+def test_resolve_constraint_target():
+    """feasible_test.go:144-209"""
+    node = mock.node()
+    assert resolve_constraint_target("$node.id", node) == (node.id, True)
+    assert resolve_constraint_target("$node.datacenter", node) == (node.datacenter, True)
+    assert resolve_constraint_target("$node.name", node) == (node.name, True)
+    assert resolve_constraint_target("$attr.kernel.name", node) == ("linux", True)
+    assert resolve_constraint_target("$meta.pci-dss", node) == ("true", True)
+    assert resolve_constraint_target("literal", node) == ("literal", True)
+    assert resolve_constraint_target("$attr.rand", node)[1] is False
+    assert resolve_constraint_target("$meta.rand", node)[1] is False
+    assert resolve_constraint_target("$bogus.kernel", node)[1] is False
+
+
+def test_check_constraint():
+    """feasible_test.go:211-271"""
+    _, ctx = make_context()
+    cases = [
+        ("=", "foo", "foo", True),
+        ("is", "foo", "foo", True),
+        ("==", "foo", "foo", True),
+        ("!=", "foo", "foo", False),
+        ("!=", "foo", "bar", True),
+        ("not", "foo", "bar", True),
+        (structs.CONSTRAINT_VERSION, "1.2.3", "~> 1.0", True),
+        (structs.CONSTRAINT_REGEX, "foobarbaz", "[\\w]+", True),
+        ("<", "foo", "bar", False),
+        (structs.CONSTRAINT_DISTINCT_HOSTS, "", "", True),
+    ]
+    for op, l, r, want in cases:
+        assert check_constraint(ctx, op, l, r) is want, (op, l, r)
+
+
+def test_check_lexical_order():
+    """feasible_test.go:273-311"""
+    assert check_lexical_order("<", "a", "b")
+    assert not check_lexical_order("<", "b", "a")
+    assert check_lexical_order("<=", "a", "a")
+    assert check_lexical_order(">", "b", "a")
+    assert check_lexical_order(">=", "b", "b")
+    assert not check_lexical_order(">", "a", "b")
+
+
+def test_proposed_alloc_constraint_job_distinct_hosts():
+    """feasible_test.go:383-419"""
+    _, ctx = make_context()
+    nodes = [mock.node(), mock.node()]
+    static = StaticIterator(ctx, nodes)
+    it = ProposedAllocConstraintIterator(ctx, static)
+
+    job = mock.job()
+    job.constraints.append(Constraint(operand=structs.CONSTRAINT_DISTINCT_HOSTS))
+    it.set_job(job)
+    it.set_task_group(job.task_groups[0])
+
+    out = collect_feasible(it)
+    assert len(out) == 2
+
+
+def test_proposed_alloc_constraint_job_distinct_hosts_infeasible():
+    """feasible_test.go:421-475"""
+    _, ctx = make_context()
+    nodes = [mock.node(), mock.node()]
+    static = StaticIterator(ctx, nodes)
+    it = ProposedAllocConstraintIterator(ctx, static)
+
+    job = mock.job()
+    job.constraints.append(Constraint(operand=structs.CONSTRAINT_DISTINCT_HOSTS))
+    tg = job.task_groups[0]
+
+    # Place proposed allocs of this job on both nodes
+    plan = ctx.plan
+    plan.node_allocation[nodes[0].id] = [
+        Allocation(id=generate_uuid(), job_id=job.id, task_group=tg.name)
+    ]
+    plan.node_allocation[nodes[1].id] = [
+        Allocation(id=generate_uuid(), job_id=job.id, task_group=tg.name)
+    ]
+
+    it.set_job(job)
+    it.set_task_group(tg)
+    assert collect_feasible(it) == []
+
+
+def test_proposed_alloc_constraint_tg_distinct_hosts():
+    """feasible_test.go:507-566"""
+    _, ctx = make_context()
+    nodes = [mock.node(), mock.node()]
+    static = StaticIterator(ctx, nodes)
+    it = ProposedAllocConstraintIterator(ctx, static)
+
+    tg1 = mock.job().task_groups[0]
+    tg1.name = "example"
+    tg1.constraints = [Constraint(operand=structs.CONSTRAINT_DISTINCT_HOSTS)]
+    job = mock.job()
+    job.id = "foo"
+    job.task_groups = [tg1]
+
+    # tg collision on node 0 only
+    plan = ctx.plan
+    plan.node_allocation[nodes[0].id] = [
+        Allocation(id=generate_uuid(), job_id=job.id, task_group=tg1.name)
+    ]
+
+    it.set_job(job)
+    it.set_task_group(tg1)
+    out = collect_feasible(it)
+    assert [n.id for n in out] == [nodes[1].id]
+
+
+def collect_ranked(iterator):
+    out = []
+    while True:
+        nxt = iterator.next()
+        if nxt is None:
+            return out
+        out.append(nxt)
+
+
+def test_feasible_rank_iterator():
+    """rank_test.go:10-24"""
+    _, ctx = make_context()
+    nodes = [mock.node() for _ in range(10)]
+    static = StaticIterator(ctx, nodes)
+    feasible = FeasibleRankIterator(ctx, static)
+    assert len(collect_ranked(feasible)) == 10
+
+
+def test_binpack_no_existing_alloc():
+    """rank_test.go:26-96"""
+    _, ctx = make_context()
+    nodes = [
+        RankedNode(Node(  # perfect fit
+            resources=Resources(cpu=2048, memory_mb=2048),
+            reserved=Resources(cpu=1024, memory_mb=1024),
+        )),
+        RankedNode(Node(  # overloaded
+            resources=Resources(cpu=1024, memory_mb=1024),
+            reserved=Resources(cpu=512, memory_mb=512),
+        )),
+        RankedNode(Node(  # 50% fit
+            resources=Resources(cpu=4096, memory_mb=4096),
+            reserved=Resources(cpu=1024, memory_mb=1024),
+        )),
+    ]
+    static = StaticRankIterator(ctx, nodes)
+    task = Task(name="web", resources=Resources(cpu=1024, memory_mb=1024))
+    binp = BinPackIterator(ctx, static, False, 0)
+    binp.set_tasks([task])
+
+    out = collect_ranked(binp)
+    assert len(out) == 2
+    assert out[0] is nodes[0] and out[1] is nodes[2]
+    assert out[0].score == 18
+    assert 10 < out[1].score < 16
+
+
+def test_binpack_planned_alloc():
+    """rank_test.go:98-167"""
+    _, ctx = make_context()
+    nodes = [
+        RankedNode(Node(id=generate_uuid(), resources=Resources(cpu=2048, memory_mb=2048))),
+        RankedNode(Node(id=generate_uuid(), resources=Resources(cpu=2048, memory_mb=2048))),
+    ]
+    static = StaticRankIterator(ctx, nodes)
+
+    plan = ctx.plan
+    plan.node_allocation[nodes[0].node.id] = [
+        Allocation(resources=Resources(cpu=2048, memory_mb=2048))
+    ]
+    plan.node_allocation[nodes[1].node.id] = [
+        Allocation(resources=Resources(cpu=1024, memory_mb=1024))
+    ]
+
+    task = Task(name="web", resources=Resources(cpu=1024, memory_mb=1024))
+    binp = BinPackIterator(ctx, static, False, 0)
+    binp.set_tasks([task])
+
+    out = collect_ranked(binp)
+    assert len(out) == 1
+    assert out[0] is nodes[1]
+    assert out[0].score == 18
+
+
+def test_binpack_existing_alloc():
+    """rank_test.go:169-241"""
+    state, ctx = make_context()
+    nodes = [
+        RankedNode(Node(id=generate_uuid(), resources=Resources(cpu=2048, memory_mb=2048))),
+        RankedNode(Node(id=generate_uuid(), resources=Resources(cpu=2048, memory_mb=2048))),
+    ]
+    static = StaticRankIterator(ctx, nodes)
+
+    alloc1 = Allocation(
+        id=generate_uuid(), eval_id=generate_uuid(), node_id=nodes[0].node.id,
+        job_id=generate_uuid(), resources=Resources(cpu=2048, memory_mb=2048),
+        desired_status=structs.ALLOC_DESIRED_STATUS_RUN,
+    )
+    alloc2 = Allocation(
+        id=generate_uuid(), eval_id=generate_uuid(), node_id=nodes[1].node.id,
+        job_id=generate_uuid(), resources=Resources(cpu=1024, memory_mb=1024),
+        desired_status=structs.ALLOC_DESIRED_STATUS_RUN,
+    )
+    state.upsert_allocs(1000, [alloc1, alloc2])
+
+    task = Task(name="web", resources=Resources(cpu=1024, memory_mb=1024))
+    binp = BinPackIterator(ctx, static, False, 0)
+    binp.set_tasks([task])
+
+    out = collect_ranked(binp)
+    assert len(out) == 1
+    assert out[0] is nodes[1]
+    assert out[0].score == 18
+
+
+def test_binpack_existing_alloc_planned_evict():
+    """rank_test.go:243-322"""
+    state, ctx = make_context()
+    nodes = [
+        RankedNode(Node(id=generate_uuid(), resources=Resources(cpu=2048, memory_mb=2048))),
+        RankedNode(Node(id=generate_uuid(), resources=Resources(cpu=2048, memory_mb=2048))),
+    ]
+    static = StaticRankIterator(ctx, nodes)
+
+    alloc1 = Allocation(
+        id=generate_uuid(), eval_id=generate_uuid(), node_id=nodes[0].node.id,
+        job_id=generate_uuid(), resources=Resources(cpu=2048, memory_mb=2048),
+        desired_status=structs.ALLOC_DESIRED_STATUS_RUN,
+    )
+    alloc2 = Allocation(
+        id=generate_uuid(), eval_id=generate_uuid(), node_id=nodes[1].node.id,
+        job_id=generate_uuid(), resources=Resources(cpu=1024, memory_mb=1024),
+        desired_status=structs.ALLOC_DESIRED_STATUS_RUN,
+    )
+    state.upsert_allocs(1000, [alloc1, alloc2])
+
+    # Plan evicts alloc1
+    ctx.plan.node_update[nodes[0].node.id] = [alloc1]
+
+    task = Task(name="web", resources=Resources(cpu=1024, memory_mb=1024))
+    binp = BinPackIterator(ctx, static, False, 0)
+    binp.set_tasks([task])
+
+    out = collect_ranked(binp)
+    assert len(out) == 2
+    assert out[0] is nodes[0] and out[1] is nodes[1]
+    assert 10 < out[0].score < 16
+    assert out[1].score == 18
+
+
+def test_job_anti_affinity_planned_alloc():
+    """rank_test.go:324-377"""
+    _, ctx = make_context()
+    nodes = [
+        RankedNode(Node(id=generate_uuid())),
+        RankedNode(Node(id=generate_uuid())),
+    ]
+    static = StaticRankIterator(ctx, nodes)
+
+    ctx.plan.node_allocation[nodes[0].node.id] = [
+        Allocation(job_id="foo"),
+        Allocation(job_id="foo"),
+    ]
+    ctx.plan.node_allocation[nodes[1].node.id] = [Allocation(job_id="bar")]
+
+    it = JobAntiAffinityIterator(ctx, static, 5.0, "foo")
+    out = collect_ranked(it)
+    assert len(out) == 2
+    assert out[0] is nodes[0]
+    assert out[0].score == -10.0
+    assert out[1] is nodes[1]
+    assert out[1].score == 0.0
+
+
+def test_limit_iterator():
+    """select_test.go:9-51"""
+    _, ctx = make_context()
+    nodes = [RankedNode(mock.node()) for _ in range(3)]
+    static = StaticRankIterator(ctx, nodes)
+    limit = LimitIterator(ctx, static, 1)
+    out = collect_ranked(limit)
+    assert out == [nodes[0]]
+
+    limit.reset()
+    limit.set_limit(2)
+    out = collect_ranked(limit)
+    assert len(out) == 2
+
+
+def test_max_score_iterator():
+    """select_test.go:53-94"""
+    _, ctx = make_context()
+    nodes = [RankedNode(mock.node()) for _ in range(3)]
+    nodes[0].score = 1
+    nodes[1].score = 2
+    nodes[2].score = 0
+    static = StaticRankIterator(ctx, nodes)
+    max_it = MaxScoreIterator(ctx, static)
+    out = collect_ranked(max_it)
+    assert out == [nodes[1]]
+
+
+def test_eval_context_proposed_allocs():
+    """context_test.go:28-107: existing - terminal - evictions + placements"""
+    state, ctx = make_context()
+    node = mock.node()
+
+    running = Allocation(
+        id=generate_uuid(), node_id=node.id, job_id="j1",
+        desired_status=structs.ALLOC_DESIRED_STATUS_RUN,
+    )
+    terminal = Allocation(
+        id=generate_uuid(), node_id=node.id, job_id="j1",
+        desired_status=structs.ALLOC_DESIRED_STATUS_STOP,
+    )
+    evicted = Allocation(
+        id=generate_uuid(), node_id=node.id, job_id="j2",
+        desired_status=structs.ALLOC_DESIRED_STATUS_RUN,
+    )
+    state.upsert_allocs(1000, [running, terminal, evicted])
+
+    ctx.plan.node_update[node.id] = [evicted]
+    placed = Allocation(id=generate_uuid(), node_id=node.id, job_id="j3")
+    ctx.plan.node_allocation[node.id] = [placed]
+
+    proposed = ctx.proposed_allocs(node.id)
+    ids = {a.id for a in proposed}
+    assert ids == {running.id, placed.id}

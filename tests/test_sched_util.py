@@ -1,0 +1,355 @@
+"""Ported scheduler util tests (/root/reference/scheduler/util_test.go)."""
+
+import logging
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler import SetStatusError
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.stack import GenericStack
+from nomad_tpu.scheduler.util import (
+    AllocTuple,
+    DiffResult,
+    diff_allocs,
+    diff_system_allocs,
+    evict_and_place,
+    inplace_update,
+    materialize_task_groups,
+    ready_nodes_in_dcs,
+    retry_max,
+    set_status,
+    tainted_nodes,
+    task_group_constraints,
+    tasks_updated,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Plan,
+    Resources,
+    generate_uuid,
+)
+
+logger = logging.getLogger("test")
+
+
+def test_materialize_task_groups():
+    """util_test.go:15-32"""
+    job = mock.job()
+    index = materialize_task_groups(job)
+    assert len(index) == 10
+    for i in range(10):
+        name = f"my-job.web[{i}]"
+        assert name in index
+        assert index[name] is job.task_groups[0]
+    assert materialize_task_groups(None) == {}
+
+
+def test_diff_allocs():
+    """util_test.go:34-111"""
+    job = mock.job()
+    required = materialize_task_groups(job)
+
+    # Previous job version for update detection
+    old_job = mock.job()
+    old_job.id = job.id
+    old_job.modify_index = job.modify_index - 1
+
+    tainted = {"dead": True, "zip": False}
+
+    allocs = [
+        # Update (stale job)
+        Allocation(id=generate_uuid(), node_id="zip", name="my-job.web[0]", job=old_job),
+        # Ignore (current job)
+        Allocation(id=generate_uuid(), node_id="zip", name="my-job.web[1]", job=job),
+        # Stop (not required)
+        Allocation(id=generate_uuid(), node_id="zip", name="my-job.web[12]", job=job),
+        # Migrate (tainted node)
+        Allocation(id=generate_uuid(), node_id="dead", name="my-job.web[2]", job=old_job),
+    ]
+
+    diff = diff_allocs(job, tainted, required, allocs)
+    assert len(diff.update) == 1 and diff.update[0].alloc is allocs[0]
+    assert len(diff.ignore) == 1 and diff.ignore[0].alloc is allocs[1]
+    assert len(diff.stop) == 1 and diff.stop[0].alloc is allocs[2]
+    assert len(diff.migrate) == 1 and diff.migrate[0].alloc is allocs[3]
+    assert len(diff.place) == 7
+
+
+def test_diff_system_allocs():
+    """util_test.go:113-185"""
+    job = mock.system_job()
+
+    old_job = mock.system_job()
+    old_job.id = job.id
+    old_job.modify_index = job.modify_index - 1
+
+    nodes = [structs.Node(id="foo"), structs.Node(id="bar"), structs.Node(id="baz")]
+    tainted = {"dead": True, "baz": False}
+
+    allocs = [
+        # Update (stale)
+        Allocation(id=generate_uuid(), node_id="foo", name="my-job.web[0]", job=old_job),
+        # Ignore (current)
+        Allocation(id=generate_uuid(), node_id="bar", name="my-job.web[0]", job=job),
+        # Stop (tainted node -> system stops, not migrates)
+        Allocation(id=generate_uuid(), node_id="dead", name="my-job.web[0]", job=old_job),
+    ]
+
+    diff = diff_system_allocs(job, nodes, tainted, allocs)
+    assert len(diff.update) == 1 and diff.update[0].alloc is allocs[0]
+    assert len(diff.ignore) == 1 and diff.ignore[0].alloc is allocs[1]
+    assert len(diff.stop) == 1 and diff.stop[0].alloc is allocs[2]
+    assert diff.migrate == []
+    # Place on baz (no alloc there yet)
+    assert len(diff.place) == 1
+    assert diff.place[0].alloc.node_id == "baz"
+
+
+def test_ready_nodes_in_dcs():
+    """util_test.go:187-218"""
+    state = StateStore()
+    node1 = mock.node()
+    node2 = mock.node()
+    node2.datacenter = "dc2"
+    node3 = mock.node()
+    node3.datacenter = "dc2"
+    node3.status = structs.NODE_STATUS_DOWN
+    node4 = mock.node()
+    node4.drain = True
+
+    for i, n in enumerate([node1, node2, node3, node4]):
+        state.upsert_node(1000 + i, n)
+
+    nodes = ready_nodes_in_dcs(state, ["dc1", "dc2"])
+    ids = {n.id for n in nodes}
+    assert ids == {node1.id, node2.id}
+
+
+def test_retry_max():
+    """util_test.go:220-246"""
+    calls = [0]
+
+    def bad():
+        calls[0] += 1
+        return False
+
+    with pytest.raises(SetStatusError) as exc:
+        retry_max(3, bad)
+    assert calls[0] == 3
+    assert exc.value.eval_status == structs.EVAL_STATUS_FAILED
+
+    calls[0] = 0
+
+    def good():
+        calls[0] += 1
+        return True
+
+    retry_max(3, good)
+    assert calls[0] == 1
+
+
+def test_tainted_nodes():
+    """util_test.go:248-288"""
+    state = StateStore()
+    node1 = mock.node()
+    node2 = mock.node()
+    node2.drain = True
+    node3 = mock.node()
+    node3.status = structs.NODE_STATUS_DOWN
+    for i, n in enumerate([node1, node2, node3]):
+        state.upsert_node(1000 + i, n)
+
+    allocs = [
+        Allocation(id=generate_uuid(), node_id=node1.id),
+        Allocation(id=generate_uuid(), node_id=node2.id),
+        Allocation(id=generate_uuid(), node_id=node3.id),
+        Allocation(id=generate_uuid(), node_id="missing"),
+    ]
+    tainted = tainted_nodes(state, allocs)
+    assert len(tainted) == 4
+    assert not tainted[node1.id]
+    assert tainted[node2.id]
+    assert tainted[node3.id]
+    assert tainted["missing"]
+
+
+def test_tasks_updated():
+    """util_test.go:313-356"""
+    j1 = mock.job()
+    j2 = mock.job()
+    assert not tasks_updated(j1.task_groups[0], j2.task_groups[0])
+
+    j2b = mock.job()
+    j2b.task_groups[0].tasks[0].config["command"] = "/bin/other"
+    assert tasks_updated(j1.task_groups[0], j2b.task_groups[0])
+
+    j3 = mock.job()
+    j3.task_groups[0].tasks[0].driver = "foobar"
+    assert tasks_updated(j1.task_groups[0], j3.task_groups[0])
+
+    j4 = mock.job()
+    j4.task_groups[0].tasks.append(mock.job().task_groups[0].tasks[0].__class__(name="extra", driver="exec"))
+    assert tasks_updated(j1.task_groups[0], j4.task_groups[0])
+
+    j5 = mock.job()
+    j5.task_groups[0].tasks[0].env["NEW"] = "1"
+    assert tasks_updated(j1.task_groups[0], j5.task_groups[0])
+
+    j6 = mock.job()
+    j6.task_groups[0].tasks[0].resources.networks[0].dynamic_ports = ["http", "https"]
+    assert tasks_updated(j1.task_groups[0], j6.task_groups[0])
+
+
+def _evict_ctx():
+    state = StateStore()
+    plan = Plan(node_update={}, node_allocation={})
+    return EvalContext(state, plan, logger)
+
+
+def _tuples(n):
+    return [
+        AllocTuple(
+            name=f"a[{i}]",
+            task_group=None,
+            alloc=Allocation(id=generate_uuid(), node_id=f"n{i}"),
+        )
+        for i in range(n)
+    ]
+
+
+def test_evict_and_place_limit_less_than_allocs():
+    """util_test.go:358-380"""
+    ctx = _evict_ctx()
+    allocs = _tuples(4)
+    diff = DiffResult()
+    limit = [2]
+    assert evict_and_place(ctx, diff, allocs, "", limit)
+    assert limit[0] == 0
+    assert len(diff.place) == 2
+    assert len(ctx.plan.node_update) == 2
+
+
+def test_evict_and_place_limit_equal_to_allocs():
+    """util_test.go:382-404"""
+    ctx = _evict_ctx()
+    allocs = _tuples(2)
+    diff = DiffResult()
+    limit = [2]
+    assert not evict_and_place(ctx, diff, allocs, "", limit)
+    assert limit[0] == 0
+    assert len(diff.place) == 2
+
+
+def test_evict_and_place_limit_greater_than_allocs():
+    """util_test.go:578-600"""
+    ctx = _evict_ctx()
+    allocs = _tuples(2)
+    diff = DiffResult()
+    limit = [4]
+    assert not evict_and_place(ctx, diff, allocs, "", limit)
+    assert limit[0] == 2
+    assert len(diff.place) == 2
+
+
+class _RecordingPlanner:
+    def __init__(self):
+        self.evals = []
+
+    def update_eval(self, ev):
+        self.evals.append(ev)
+
+
+def test_set_status():
+    """util_test.go:406-439"""
+    planner = _RecordingPlanner()
+    ev = mock.evaluation()
+    set_status(logger, planner, ev, None, structs.EVAL_STATUS_COMPLETE, "")
+    assert len(planner.evals) == 1
+    assert planner.evals[0].status == structs.EVAL_STATUS_COMPLETE
+    assert planner.evals[0] is not ev  # must be a copy
+
+    planner2 = _RecordingPlanner()
+    next_eval = mock.evaluation()
+    set_status(logger, planner2, ev, next_eval, structs.EVAL_STATUS_FAILED, "oops")
+    out = planner2.evals[0]
+    assert out.status == structs.EVAL_STATUS_FAILED
+    assert out.status_description == "oops"
+    assert out.next_eval == next_eval.id
+
+
+def _inplace_fixture(change=None):
+    state = StateStore()
+    node = mock.node()
+    state.upsert_node(900, node)
+
+    job = mock.job()
+    alloc = mock.alloc()
+    alloc.job = job
+    alloc.job_id = job.id
+    alloc.node_id = node.id
+    alloc.name = "my-job.web[0]"
+    state.upsert_allocs(1000, [alloc])
+
+    job2 = mock.job()
+    job2.id = job.id
+    if change:
+        change(job2)
+
+    ev = Evaluation(id=generate_uuid(), priority=50, job_id=job.id)
+    plan = ev.make_plan(job2)
+    ctx = EvalContext(state, plan, logger)
+    stack = GenericStack(False, ctx)
+    stack.set_job(job2)
+    updates = [AllocTuple(name=alloc.name, task_group=job2.task_groups[0], alloc=alloc)]
+    return ctx, ev, job2, stack, updates
+
+
+def test_inplace_update_changed_task_group():
+    """util_test.go:441-485: destructive change cannot be in-place."""
+    ctx, ev, job2, stack, updates = _inplace_fixture(
+        change=lambda j: j.task_groups[0].tasks[0].config.update(command="/bin/other")
+    )
+    remaining = inplace_update(ctx, ev, job2, stack, updates)
+    assert len(remaining) == 1
+    assert ctx.plan.node_allocation == {}
+
+
+def test_inplace_update_no_match():
+    """util_test.go:487-530: resources exceed the node -> no in-place."""
+
+    def grow(j):
+        j.task_groups[0].tasks[0].resources = Resources(cpu=1 << 20, memory_mb=1 << 20)
+
+    ctx, ev, job2, stack, updates = _inplace_fixture(change=grow)
+    remaining = inplace_update(ctx, ev, job2, stack, updates)
+    assert len(remaining) == 1
+    assert ctx.plan.node_allocation == {}
+
+
+def test_inplace_update_success():
+    """util_test.go:532-576"""
+    ctx, ev, job2, stack, updates = _inplace_fixture()
+    remaining = inplace_update(ctx, ev, job2, stack, updates)
+    assert remaining == []
+    # The plan has the updated alloc, evictions popped
+    assert len(ctx.plan.node_allocation) == 1
+    assert ctx.plan.node_update == {}
+    placed = list(ctx.plan.node_allocation.values())[0][0]
+    assert placed.eval_id == ev.id
+    assert placed.job is job2
+
+
+def test_task_group_constraints():
+    """util_test.go:602-650"""
+    job = mock.job()
+    tg = job.task_groups[0]
+    tup = task_group_constraints(tg)
+    assert tup.drivers == {"exec"}
+    assert tup.size.cpu == 500
+    assert tup.size.memory_mb == 256
+    assert len(tup.constraints) == len(tg.constraints) + sum(
+        len(t.constraints) for t in tg.tasks
+    )

@@ -1,0 +1,309 @@
+"""Ported system scheduler tests
+(/root/reference/scheduler/system_sched_test.go), parametrized over host and
+TPU factories."""
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.structs import Evaluation, UpdateStrategy, generate_uuid
+
+from sched_harness import Harness, RejectPlan, flatten
+
+SYSTEM_FACTORIES = ["system", "tpu-system"]
+
+
+def _seed_nodes(h, n=10):
+    nodes = []
+    for _ in range(n):
+        node = mock.node()
+        nodes.append(node)
+        h.state.upsert_node(h.next_index(), node)
+    return nodes
+
+
+def _alloc_on(job, node_id, name="my-job.web[0]"):
+    alloc = mock.alloc()
+    alloc.job = job
+    alloc.job_id = job.id
+    alloc.node_id = node_id
+    alloc.name = name
+    return alloc
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_job_register(factory):
+    """reference: system_sched_test.go:11-63"""
+    h = Harness()
+    _seed_nodes(h)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    planned = flatten(h.plans[0].node_allocation)
+    assert len(planned) == 10
+    assert len(h.state.allocs_by_job(job.id)) == 10
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_job_register_add_node(factory):
+    """reference: system_sched_test.go:65-150"""
+    h = Harness()
+    nodes = _seed_nodes(h)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = [_alloc_on(job, node.id) for node in nodes]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    new_node = mock.node()
+    h.state.upsert_node(h.next_index(), new_node)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_NODE_UPDATE,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert flatten(plan.node_update) == []
+    planned = flatten(plan.node_allocation)
+    assert len(planned) == 1
+    assert new_node.id in plan.node_allocation
+
+    out = structs.filter_terminal_allocs(h.state.allocs_by_job(job.id))
+    assert len(out) == 11
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_job_register_alloc_fail(factory):
+    """reference: system_sched_test.go:152-180 — no nodes is a no-op."""
+    h = Harness()
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert h.plans == []
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_job_modify(factory):
+    """reference: system_sched_test.go:182-278"""
+    h = Harness()
+    nodes = _seed_nodes(h)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = [_alloc_on(job, node.id) for node in nodes]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    terminal = []
+    for i in range(5):
+        alloc = _alloc_on(job, nodes[i].id)
+        alloc.desired_status = structs.ALLOC_DESIRED_STATUS_FAILED
+        terminal.append(alloc)
+    h.state.upsert_allocs(h.next_index(), terminal)
+
+    job2 = mock.system_job()
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].config["command"] = "/bin/other"
+    h.state.upsert_job(h.next_index(), job2)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert len(flatten(plan.node_update)) == len(allocs)
+    assert len(flatten(plan.node_allocation)) == 10
+
+    out = structs.filter_terminal_allocs(h.state.allocs_by_job(job.id))
+    assert len(out) == 10
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_job_modify_rolling(factory):
+    """reference: system_sched_test.go:280-379"""
+    h = Harness()
+    nodes = _seed_nodes(h)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = [_alloc_on(job, node.id) for node in nodes]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    job2 = mock.system_job()
+    job2.id = job.id
+    job2.update = UpdateStrategy(stagger=30.0, max_parallel=5)
+    job2.task_groups[0].tasks[0].config["command"] = "/bin/other"
+    h.state.upsert_job(h.next_index(), job2)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert len(flatten(plan.node_update)) == job2.update.max_parallel
+    assert len(flatten(plan.node_allocation)) == job2.update.max_parallel
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+    ev_update = h.evals[0]
+    assert ev_update.next_eval
+    assert h.create_evals
+    create = h.create_evals[0]
+    assert ev_update.next_eval == create.id
+    assert create.previous_eval == ev_update.id
+    assert create.triggered_by == structs.EVAL_TRIGGER_ROLLING_UPDATE
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_job_modify_in_place(factory):
+    """reference: system_sched_test.go:381-473"""
+    h = Harness()
+    nodes = _seed_nodes(h)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = [_alloc_on(job, node.id) for node in nodes]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    job2 = mock.system_job()
+    job2.id = job.id
+    h.state.upsert_job(h.next_index(), job2)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert flatten(plan.node_update) == []
+    planned = flatten(plan.node_allocation)
+    assert len(planned) == 10
+    for p in planned:
+        assert p.job.modify_index == job2.modify_index
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 10
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+    for alloc in out:
+        for resources in alloc.task_resources.values():
+            assert resources.networks[0].reserved_ports[0] == 5000
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_job_deregister(factory):
+    """reference: system_sched_test.go:475-538"""
+    h = Harness()
+    nodes = _seed_nodes(h)
+    job = mock.system_job()
+
+    allocs = [_alloc_on(job, node.id) for node in nodes]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_JOB_DEREGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    for node in nodes:
+        assert len(plan.node_update[node.id]) == 1
+
+    out = structs.filter_terminal_allocs(h.state.allocs_by_job(job.id))
+    assert out == []
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_node_drain(factory):
+    """reference: system_sched_test.go:540-605"""
+    h = Harness()
+    node = mock.node()
+    node.drain = True
+    h.state.upsert_node(h.next_index(), node)
+
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    alloc = _alloc_on(job, node.id)
+    h.state.upsert_allocs(h.next_index(), [alloc])
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=50,
+        triggered_by=structs.EVAL_TRIGGER_NODE_UPDATE,
+        job_id=job.id,
+        node_id=node.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert len(plan.node_update[node.id]) == 1
+    planned = flatten(plan.node_update)
+    assert len(planned) == 1
+    assert planned[0].desired_status == structs.ALLOC_DESIRED_STATUS_STOP
+    h.assert_eval_status(structs.EVAL_STATUS_COMPLETE)
+
+
+@pytest.mark.parametrize("factory", SYSTEM_FACTORIES)
+def test_system_retry_limit(factory):
+    """reference: system_sched_test.go:607-651"""
+    h = Harness()
+    h.planner = RejectPlan(h)
+    _seed_nodes(h)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    h.process(factory, ev)
+
+    assert len(h.plans) > 0
+    assert h.state.allocs_by_job(job.id) == []
+    h.assert_eval_status(structs.EVAL_STATUS_FAILED)

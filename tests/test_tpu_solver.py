@@ -1,0 +1,157 @@
+"""TPU solver-specific tests: regressions and host/TPU differential checks."""
+
+import random
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.structs import (
+    Allocation,
+    Constraint,
+    Evaluation,
+    Resources,
+    generate_uuid,
+)
+
+from sched_harness import Harness, flatten
+
+
+def _eval_for(job):
+    return Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+
+
+def test_job_level_distinct_hosts_spans_task_groups():
+    """Job-level distinct_hosts must reject same-job allocs from *other* task
+    groups (feasible.go:237-242). Regression for the dense solve collapsing
+    both scopes into the tg check."""
+    h = Harness()
+    for _ in range(4):
+        h.state.upsert_node(h.next_index(), mock.node())
+
+    job = mock.job()
+    job.constraints.append(Constraint(operand=structs.CONSTRAINT_DISTINCT_HOSTS))
+    # Two task groups, count 2 each -> 4 placements, all on distinct hosts
+    import copy
+
+    tg2 = copy.deepcopy(job.task_groups[0])
+    tg2.name = "api"
+    job.task_groups[0].count = 2
+    tg2.count = 2
+    job.task_groups.append(tg2)
+    h.state.upsert_job(h.next_index(), job)
+
+    h.process("tpu-service", _eval_for(job))
+
+    planned = flatten(h.plans[0].node_allocation)
+    assert len(planned) == 4
+    nodes_used = [a.node_id for a in planned]
+    assert len(set(nodes_used)) == 4, f"job distinct_hosts violated: {nodes_used}"
+
+
+def test_tpu_system_no_overcommit_same_node():
+    """The batched system solve must not overcommit a node when several
+    placements of one group are pinned to it."""
+    import logging
+
+    from nomad_tpu.scheduler.context import EvalContext
+    from nomad_tpu.scheduler.util import AllocTuple
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.tpu.solver import TPUSystemScheduler
+
+    state = StateStore()
+    node = mock.node()
+    node.resources = Resources(cpu=1100, memory_mb=1024, disk_mb=50000, iops=100)
+    node.reserved = None
+    state.upsert_node(1, node)
+
+    job = mock.system_job()
+    job.task_groups[0].tasks[0].resources = Resources(cpu=500, memory_mb=256)
+    state.upsert_job(2, job)
+
+    class _Sink:
+        def submit_plan(self, plan):
+            raise AssertionError("not used")
+
+        def update_eval(self, ev):
+            pass
+
+        def create_eval(self, ev):
+            pass
+
+    sched = TPUSystemScheduler(state.snapshot(), _Sink(), logging.getLogger("t"))
+    sched.eval = _eval_for(job)
+    sched.job = job
+    sched.nodes = [node]
+    sched.plan = sched.eval.make_plan(job)
+    sched.ctx = EvalContext(sched.state, sched.plan, sched.logger)
+    sched.stack = sched.make_stack(sched.ctx)
+    sched.stack.set_job(job)
+
+    # Three copies pinned to the same node; only 2x500 cpu fits in 1100.
+    tg = job.task_groups[0]
+    place = [
+        AllocTuple(name=f"my-job.web[{i}]", task_group=tg,
+                   alloc=Allocation(node_id=node.id))
+        for i in range(3)
+    ]
+    sched.compute_placements(place)
+
+    placed = flatten(sched.plan.node_allocation)
+    assert len(placed) == 2, f"overcommitted: {len(placed)} placed"
+    total_cpu = sum(a.resources.cpu for a in placed)
+    assert total_cpu <= 1100
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_differential_host_vs_tpu(seed):
+    """Fuzz: same cluster + job through both solvers must place the same
+    number of allocs with valid packing (node identity may differ: the host
+    samples ~log2(n) candidates, the TPU solves globally)."""
+    rng = random.Random(seed)
+    results = {}
+    node_specs = [
+        (rng.choice([1000, 2000, 4000]), rng.choice([1024, 4096, 8192]))
+        for _ in range(12)
+    ]
+    count = rng.randint(5, 25)
+    cpu_ask = rng.choice([100, 300, 500])
+    mem_ask = rng.choice([64, 256, 512])
+
+    for factory in ("service", "tpu-service"):
+        h = Harness()
+        nodes = []
+        for cpu, mem in node_specs:
+            node = mock.node()
+            node.resources = Resources(
+                cpu=cpu, memory_mb=mem, disk_mb=100 * 1024, iops=150,
+                networks=node.resources.networks,
+            )
+            node.reserved = None
+            nodes.append(node)
+            h.state.upsert_node(h.next_index(), node)
+
+        job = mock.job()
+        job.task_groups[0].count = count
+        job.task_groups[0].tasks[0].resources = Resources(
+            cpu=cpu_ask, memory_mb=mem_ask
+        )
+        h.state.upsert_job(h.next_index(), job)
+        h.process(factory, _eval_for(job))
+
+        planned = flatten(h.plans[0].node_allocation)
+        # Validate packing: per-node sums within capacity
+        per_node = {}
+        for a in planned:
+            per_node[a.node_id] = per_node.get(a.node_id, 0) + a.resources.cpu
+        caps = {n.id: n.resources.cpu for n in nodes}
+        for node_id, used in per_node.items():
+            assert used <= caps[node_id], f"{factory} overcommitted {node_id}"
+        results[factory] = len(planned)
+
+    # The TPU global solve must place at least as many as the sampled host.
+    assert results["tpu-service"] >= results["service"], results
