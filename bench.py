@@ -1,0 +1,199 @@
+"""Benchmark: the BASELINE.json north-star configuration.
+
+Config 3 of BASELINE.md: a 10k-node cluster and a single batch job with
+100k task groups (driver + datacenter constraints), placed by the TPU
+dense-solve scheduler. The reference publishes no numbers (BASELINE.md);
+the driver-defined target is p50 < 200ms for the placement solve, i.e.
+500k placements/sec.
+
+Measured phases per evaluation:
+- solve: TPUStack.select_many end-to-end — eligibility masks, usage
+  tensorization, the device round-solve, and placement extraction. This is
+  the reformulated Stack.Select loop (the north-star metric).
+- e2e:   the full TPUGenericScheduler.process, including Python-side diff,
+  100k Allocation-object materialization and plan/state apply (the part a
+  native runtime will take over in later rounds).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+N_NODES = 10_000
+N_TASKS = 100_000
+RUNS = 5
+TARGET_PLACEMENTS_PER_SEC = N_TASKS / 0.2  # 100k tasks in 200ms p50
+
+
+def build_cluster():
+    from nomad_tpu import structs
+    from nomad_tpu.structs import (
+        Constraint,
+        Job,
+        Node,
+        Resources,
+        RestartPolicy,
+        Task,
+        TaskGroup,
+        generate_uuid,
+    )
+
+    nodes = []
+    for i in range(N_NODES):
+        nodes.append(
+            Node(
+                id=f"node-{i:05d}",
+                datacenter="dc1" if i % 2 == 0 else "dc2",
+                name=f"n{i}",
+                attributes={"kernel.name": "linux", "driver.exec": "1"},
+                resources=Resources(
+                    cpu=4000, memory_mb=8192, disk_mb=100 * 1024, iops=150
+                ),
+                status=structs.NODE_STATUS_READY,
+            )
+        )
+
+    job = Job(
+        region="global",
+        id=generate_uuid(),
+        name="bench-batch",
+        type=structs.JOB_TYPE_BATCH,
+        priority=50,
+        datacenters=["dc1"],  # datacenter constraint: half the cluster
+        constraints=[
+            Constraint(l_target="$attr.kernel.name", r_target="linux", operand="=")
+        ],
+        task_groups=[
+            TaskGroup(
+                name="work",
+                count=N_TASKS,
+                restart_policy=RestartPolicy(attempts=1, interval=600.0, delay=5.0),
+                tasks=[
+                    Task(
+                        name="work",
+                        driver="exec",
+                        resources=Resources(cpu=100, memory_mb=128),
+                    )
+                ],
+            )
+        ],
+    )
+    return nodes, job
+
+
+class _TimingStack:
+    """Wraps TPUStack.select_many to capture the solve wall time."""
+
+    solve_times = []
+
+    @classmethod
+    def install(cls):
+        from nomad_tpu.tpu.solver import TPUStack
+
+        orig = TPUStack.select_many
+
+        def timed(self, tg, count):
+            start = time.perf_counter()
+            out = orig(self, tg, count)
+            cls.solve_times.append(time.perf_counter() - start)
+            return out
+
+        TPUStack.select_many = timed
+
+
+def run_once(nodes, job):
+    import logging
+
+    from nomad_tpu import structs
+    from nomad_tpu.scheduler import new_scheduler
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import Evaluation, PlanResult, generate_uuid
+
+    state = StateStore()
+    for i, node in enumerate(nodes):
+        state.upsert_node(i + 1, node)
+    state.upsert_job(N_NODES + 1, job)
+
+    class _Planner:
+        plan = None
+
+        def submit_plan(self, plan):
+            _Planner.plan = plan
+            result = PlanResult(
+                node_update=plan.node_update,
+                node_allocation=plan.node_allocation,
+                alloc_index=N_NODES + 2,
+            )
+            return result, None
+
+        def update_eval(self, ev):
+            pass
+
+        def create_eval(self, ev):
+            pass
+
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        type=job.type,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+    )
+    sched = new_scheduler(
+        "tpu-batch", state.snapshot(), _Planner(), logging.getLogger("bench")
+    )
+    start = time.perf_counter()
+    sched.process(ev)
+    e2e = time.perf_counter() - start
+
+    plan = _Planner.plan
+    placed = sum(len(v) for v in plan.node_allocation.values())
+    return e2e, placed
+
+
+def main():
+    import jax
+
+    nodes, job = build_cluster()
+    _TimingStack.install()
+
+    # Warmup: compile caches for the shape buckets
+    run_once(nodes, job)
+    _TimingStack.solve_times.clear()
+
+    e2e_times = []
+    placed = 0
+    for _ in range(RUNS):
+        e2e, placed = run_once(nodes, job)
+        e2e_times.append(e2e)
+
+    solve_p50 = statistics.median(_TimingStack.solve_times)
+    e2e_p50 = statistics.median(e2e_times)
+    placements_per_sec = placed / solve_p50
+
+    print(
+        json.dumps(
+            {
+                "metric": "placements_per_sec@10k_nodes_x_100k_tasks",
+                "value": round(placements_per_sec, 1),
+                "unit": "placements/s",
+                "vs_baseline": round(
+                    placements_per_sec / TARGET_PLACEMENTS_PER_SEC, 3
+                ),
+                "solve_ms_p50": round(solve_p50 * 1000, 2),
+                "e2e_eval_ms_p50": round(e2e_p50 * 1000, 2),
+                "placed": placed,
+                "n_nodes": N_NODES,
+                "n_tasks": N_TASKS,
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -149,6 +149,62 @@ def solve_round(
     return selected, n_placed, used, job_count, tg_count, bw_used
 
 
+@partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
+def solve_rounds_fused(
+    total: jnp.ndarray,
+    sched_cap: jnp.ndarray,
+    used0: jnp.ndarray,
+    job_count0: jnp.ndarray,
+    tg_count0: jnp.ndarray,
+    bw_avail: jnp.ndarray,
+    bw_used0: jnp.ndarray,
+    eligible: jnp.ndarray,
+    ask: jnp.ndarray,
+    bw_ask: jnp.ndarray,
+    count: jnp.ndarray,       # [] int32 total tasks to place
+    penalty: jnp.ndarray,
+    job_distinct: bool,
+    tg_distinct: bool,
+):
+    """All rounds in one dispatch via lax.while_loop: returns per-node
+    placement counts [N]. One device round-trip regardless of count — the
+    transfer-latency killer for 100k-task evals."""
+    n = total.shape[0]
+
+    def cond(carry):
+        _used, _jc, _tc, _bw, remaining, _counts, progressed = carry
+        return (remaining > 0) & progressed
+
+    def body(carry):
+        used, job_count, tg_count, bw_used, remaining, counts, _ = carry
+        score, fit = _greedy_step_state(
+            total, sched_cap, used, job_count, tg_count, bw_avail, bw_used,
+            eligible, ask, bw_ask, penalty, job_distinct, tg_distinct,
+        )
+        order = jnp.argsort(-score)
+        rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(
+            jnp.arange(n, dtype=jnp.int32)
+        )
+        selected = fit & (rank < remaining)
+        n_placed = selected.sum().astype(jnp.int32)
+        used = used + selected[:, None] * ask[None, :]
+        job_count = job_count + selected
+        tg_count = tg_count + selected
+        bw_used = bw_used + selected * bw_ask
+        counts = counts + selected.astype(jnp.int32)
+        return (
+            used, job_count, tg_count, bw_used,
+            remaining - n_placed, counts, n_placed > 0,
+        )
+
+    init = (
+        used0, job_count0, tg_count0, bw_used0, count,
+        jnp.zeros(n, dtype=jnp.int32), jnp.bool_(True),
+    )
+    _u, _jc, _tc, _bw, remaining, counts, _p = lax.while_loop(cond, body, init)
+    return counts, remaining
+
+
 def solve_many(
     total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
     eligible, ask, bw_ask, count: int, penalty: float,
@@ -156,11 +212,16 @@ def solve_many(
     exact_threshold: int = 128,
 ):
     """Place ``count`` copies of one ask. Dispatches the exact scan for small
-    counts and the round solver for large ones.
+    counts and the fused round solver for large ones.
 
-    Returns (node_indices: list[int], ok: list[bool]) of length count, in
-    placement order.
+    Returns (node_indices, ok) numpy arrays of length count. The exact path
+    is in true greedy placement order; the fused path reconstructs from
+    per-node counts, so indices come grouped by node — copies of one ask are
+    interchangeable, so callers must not rely on ordering. Unplaceable tail
+    is idx -1 / ok False.
     """
+    import numpy as np
+
     if count <= exact_threshold:
         k = bucket(count)
         active = jnp.arange(k) < count
@@ -169,31 +230,22 @@ def solve_many(
             bw_used0, eligible, ask, bw_ask, active,
             jnp.float32(penalty), k, job_distinct, tg_distinct,
         )
-        idxs = jax.device_get(idxs)[:count]
-        oks = jax.device_get(oks)[:count]
-        return list(map(int, idxs)), list(map(bool, oks))
+        idxs, oks = jax.device_get((idxs, oks))
+        return idxs[:count], oks[:count]
 
-    # Round solver: each round places <=1 task per node, best nodes first.
-    placements: list[int] = []
-    used, job_count, tg_count, bw_used = used0, job_count0, tg_count0, bw_used0
-    remaining = count
-    while remaining > 0:
-        selected, n_placed, used, job_count, tg_count, bw_used = solve_round(
-            total, sched_cap, used, job_count, tg_count, bw_avail, bw_used,
-            eligible, ask, bw_ask, jnp.int32(remaining),
-            jnp.float32(penalty), job_distinct, tg_distinct,
-        )
-        n_placed = int(n_placed)
-        if n_placed == 0:
-            break
-        sel_idx = jnp.nonzero(selected, size=n_placed)[0]
-        placements.extend(map(int, jax.device_get(sel_idx)))
-        remaining -= n_placed
-        if job_distinct or tg_distinct:
-            # One round is all a distinct-hosts group can ever place.
-            break
-
-    oks = [True] * len(placements) + [False] * (count - len(placements))
-    # Unplaceable tail points nowhere.
-    placements.extend([-1] * (count - len(placements)))
-    return placements, oks
+    # Fused round solver: one dispatch + one transfer for the whole batch.
+    # distinct_hosts needs no special-casing: the fit mask excludes nodes
+    # whose job/tg counts grew, so the loop drains and exits on no-progress.
+    counts, _remaining = solve_rounds_fused(
+        total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+        eligible, ask, bw_ask, jnp.int32(count), jnp.float32(penalty),
+        job_distinct, tg_distinct,
+    )
+    counts = np.asarray(jax.device_get(counts))
+    idxs = np.repeat(np.arange(counts.shape[0], dtype=np.int64), counts)
+    n_placed = idxs.shape[0]
+    out_idx = np.full(count, -1, dtype=np.int64)
+    out_idx[:n_placed] = idxs[:count]
+    oks = np.zeros(count, dtype=bool)
+    oks[: min(n_placed, count)] = True
+    return out_idx, oks

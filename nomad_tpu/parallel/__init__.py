@@ -1,0 +1,13 @@
+"""Device-mesh parallelism for the placement solver.
+
+The scale axis of the reference is cluster size (SURVEY.md §5 "Long-context
+…"): nodes × task groups. Here that axis becomes tensor shape, sharded over
+a ``jax.sharding.Mesh``:
+
+- the **node axis** shards across chips over ICI (the model-parallel analog)
+- the **eval-batch axis** shards coalesced evaluations (the data-parallel
+  analog) — optimistically-concurrent scheduling as one batched dispatch
+
+XLA inserts the cross-shard collectives (the argmax reduction over the node
+axis) from sharding annotations; nothing is hand-scheduled.
+"""

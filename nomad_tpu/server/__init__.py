@@ -1,0 +1,11 @@
+"""Server control plane: the plumbing around the scheduler.
+
+Mirrors the reference's server core (/root/reference/nomad/, SURVEY.md §2.1):
+eval broker (at-least-once queue), plan queue + plan applier (the single
+serialization point), workers (scheduler threads), FSM (replicated state
+machine), heartbeats, and the leader lifecycle.
+"""
+
+from nomad_tpu.server.server import Server, ServerConfig
+
+__all__ = ["Server", "ServerConfig"]

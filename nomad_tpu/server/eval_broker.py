@@ -1,0 +1,396 @@
+"""Eval broker: leader-only, in-memory, at-least-once evaluation queue.
+
+Fresh implementation with the semantics of the reference broker
+(/root/reference/nomad/eval_broker.go:33-633):
+
+- priority queues per scheduler type; highest priority dequeued first,
+  ties broken by create index (eval_broker.go:597-605)
+- per-job serialization: one outstanding eval per JobID, later ones block
+  (eval_broker.go:173-183)
+- unack tracking with Nack timers; missing Ack within nack_timeout
+  redelivers (eval_broker.go:318-328)
+- delivery limit: after N deliveries the eval lands in the ``_failed``
+  queue for the leader to reap (eval_broker.go:19, 489-495)
+- wait/time-delay evals for rolling updates (eval_broker.go:143-151)
+- blocking Dequeue with timeout (eval_broker.go:214-246)
+
+Additionally, ``dequeue_batch`` implements the TPU north-star extension
+(SURVEY.md §7 "Batched evals"): drain up to B compatible ready evals in one
+call so the worker can coalesce them into a single device dispatch.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import Evaluation, generate_uuid
+
+FAILED_QUEUE = "_failed"
+
+
+class BrokerError(Exception):
+    pass
+
+
+ERR_NOT_OUTSTANDING = "evaluation is not outstanding"
+ERR_TOKEN_MISMATCH = "evaluation token does not match"
+ERR_NACK_TIMEOUT_REACHED = "evaluation nack timeout reached"
+ERR_DISABLED = "eval broker disabled"
+
+
+@dataclass
+class SchedulerStats:
+    ready: int = 0
+    unacked: int = 0
+
+
+@dataclass
+class BrokerStats:
+    total_ready: int = 0
+    total_unacked: int = 0
+    total_blocked: int = 0
+    total_waiting: int = 0
+    by_scheduler: Dict[str, SchedulerStats] = field(default_factory=dict)
+
+    def sched(self, queue: str) -> SchedulerStats:
+        if queue not in self.by_scheduler:
+            self.by_scheduler[queue] = SchedulerStats()
+        return self.by_scheduler[queue]
+
+
+class _PriorityQueue:
+    """Max-priority heap of evaluations: highest priority first, then oldest
+    create index (eval_broker.go:597-605)."""
+
+    _counter = itertools.count()
+
+    def __init__(self) -> None:
+        self._heap: List[Tuple[int, int, int, Evaluation]] = []
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(
+            self._heap, (-ev.priority, ev.create_index, next(self._counter), ev)
+        )
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap)[3]
+
+    def peek(self) -> Optional[Evaluation]:
+        if not self._heap:
+            return None
+        return self._heap[0][3]
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+class _UnackEval:
+    __slots__ = ("eval", "token", "nack_timer")
+
+    def __init__(self, ev: Evaluation, token: str, nack_timer: threading.Timer):
+        self.eval = ev
+        self.token = token
+        self.nack_timer = nack_timer
+
+
+class EvalBroker:
+    """At-least-once evaluation broker (reference: eval_broker.go:43-111)."""
+
+    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3):
+        if nack_timeout < 0:
+            raise ValueError("timeout cannot be negative")
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self._enabled = False
+        self._lock = threading.Lock()
+        self._work_available = threading.Condition(self._lock)
+        self.stats = BrokerStats()
+
+        # eval ID -> delivery attempts
+        self._evals: Dict[str, int] = {}
+        # JobID -> outstanding eval ID (serialization)
+        self._job_evals: Dict[str, str] = {}
+        # JobID -> blocked evals
+        self._blocked: Dict[str, _PriorityQueue] = {}
+        # scheduler type -> ready evals
+        self._ready: Dict[str, _PriorityQueue] = {}
+        # eval ID -> unacked delivery
+        self._unack: Dict[str, _UnackEval] = {}
+        # eval ID -> wait timer
+        self._time_wait: Dict[str, threading.Timer] = {}
+
+    # -- enable/disable ----------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    # -- enqueue -----------------------------------------------------------
+
+    def enqueue(self, ev: Evaluation) -> None:
+        """eval_broker.go:131-155"""
+        with self._lock:
+            if ev.id in self._evals:
+                return
+            if self._enabled:
+                self._evals[ev.id] = 0
+
+            if ev.wait > 0:
+                timer = threading.Timer(ev.wait, self._enqueue_waiting, args=(ev,))
+                timer.daemon = True
+                timer.start()
+                self._time_wait[ev.id] = timer
+                self.stats.total_waiting += 1
+                return
+
+            self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_waiting(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._time_wait.pop(ev.id, None)
+            self.stats.total_waiting -= 1
+            self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        """eval_broker.go:166-212 (lock held)"""
+        if not self._enabled:
+            return
+
+        pending_eval = self._job_evals.get(ev.job_id, "")
+        if pending_eval == "":
+            self._job_evals[ev.job_id] = ev.id
+        elif pending_eval != ev.id:
+            blocked = self._blocked.setdefault(ev.job_id, _PriorityQueue())
+            blocked.push(ev)
+            self.stats.total_blocked += 1
+            return
+
+        ready = self._ready.setdefault(queue, _PriorityQueue())
+        ready.push(ev)
+        self.stats.total_ready += 1
+        self.stats.sched(queue).ready += 1
+        self._work_available.notify_all()
+
+    # -- dequeue -----------------------------------------------------------
+
+    def dequeue(
+        self, schedulers: List[str], timeout: Optional[float] = None
+    ) -> Tuple[Optional[Evaluation], str]:
+        """Blocking dequeue of the highest-priority ready eval for any of the
+        given scheduler types (eval_broker.go:214-246). Returns (None, "")
+        on timeout."""
+        deadline = None
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    raise BrokerError(ERR_DISABLED)
+                out = self._scan_for_schedulers(schedulers)
+                if out is not None:
+                    return out
+                if timeout is not None:
+                    import time as _time
+
+                    if deadline is None:
+                        deadline = _time.monotonic() + timeout
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return None, ""
+                    self._work_available.wait(remaining)
+                else:
+                    self._work_available.wait()
+
+    def dequeue_batch(
+        self,
+        schedulers: List[str],
+        max_batch: int,
+        timeout: Optional[float] = None,
+    ) -> List[Tuple[Evaluation, str]]:
+        """Coalescing dequeue: blocks for the first eval, then drains up to
+        ``max_batch - 1`` more ready evals without blocking. Every returned
+        eval has its own token + nack timer; each must be Ack'd/Nack'd
+        individually. Per-job serialization still holds (distinct jobs only).
+        """
+        first = self.dequeue(schedulers, timeout)
+        if first[0] is None:
+            return []
+        batch = [first]
+        with self._lock:
+            while len(batch) < max_batch:
+                out = self._scan_for_schedulers(schedulers)
+                if out is None:
+                    break
+                batch.append(out)
+        return batch
+
+    def _scan_for_schedulers(
+        self, schedulers: List[str]
+    ) -> Optional[Tuple[Evaluation, str]]:
+        """Pick the highest-priority eval across queues (lock held)
+        (eval_broker.go:248-304)."""
+        eligible: List[str] = []
+        eligible_priority = 0
+        for sched in schedulers:
+            pending = self._ready.get(sched)
+            if pending is None:
+                continue
+            ready = pending.peek()
+            if ready is None:
+                continue
+            if not eligible or ready.priority > eligible_priority:
+                eligible = [sched]
+                eligible_priority = ready.priority
+            elif eligible_priority == ready.priority:
+                eligible.append(sched)
+
+        if not eligible:
+            return None
+        sched = eligible[0] if len(eligible) == 1 else random.choice(eligible)
+        return self._dequeue_for_sched(sched)
+
+    def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
+        """eval_broker.go:306-341 (lock held)"""
+        ev = self._ready[sched].pop()
+        token = generate_uuid()
+
+        nack_timer = threading.Timer(
+            self.nack_timeout, self._nack_from_timer, args=(ev.id, token)
+        )
+        nack_timer.daemon = True
+        nack_timer.start()
+
+        self._unack[ev.id] = _UnackEval(ev, token, nack_timer)
+        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+
+        self.stats.total_ready -= 1
+        self.stats.total_unacked += 1
+        by_sched = self.stats.sched(sched)
+        by_sched.ready -= 1
+        by_sched.unacked += 1
+        return ev, token
+
+    def _nack_from_timer(self, eval_id: str, token: str) -> None:
+        try:
+            self.nack(eval_id, token)
+        except BrokerError:
+            pass
+
+    # -- outstanding/ack/nack ---------------------------------------------
+
+    def outstanding(self, eval_id: str) -> Tuple[str, bool]:
+        """eval_broker.go:384-394"""
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                return "", False
+            return unack.token, True
+
+    def outstanding_reset(self, eval_id: str, token: str) -> None:
+        """Reset the Nack timer if the token matches
+        (eval_broker.go:396-412); raises BrokerError otherwise."""
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise BrokerError(ERR_NOT_OUTSTANDING)
+            if unack.token != token:
+                raise BrokerError(ERR_TOKEN_MISMATCH)
+            unack.nack_timer.cancel()
+            new_timer = threading.Timer(
+                self.nack_timeout, self._nack_from_timer, args=(eval_id, token)
+            )
+            new_timer.daemon = True
+            new_timer.start()
+            unack.nack_timer = new_timer
+
+    def ack(self, eval_id: str, token: str) -> None:
+        """Positive acknowledgment; unblocks the next eval for the job
+        (eval_broker.go:414-462)."""
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise BrokerError("Evaluation ID not found")
+            if unack.token != token:
+                raise BrokerError("Token does not match for Evaluation ID")
+            job_id = unack.eval.job_id
+            unack.nack_timer.cancel()
+
+            self.stats.total_unacked -= 1
+            queue = unack.eval.type
+            if self._evals.get(eval_id, 0) >= self.delivery_limit:
+                queue = FAILED_QUEUE
+            self.stats.sched(queue).unacked -= 1
+
+            del self._unack[eval_id]
+            self._evals.pop(eval_id, None)
+            self._job_evals.pop(job_id, None)
+
+            blocked = self._blocked.get(job_id)
+            if blocked is not None and len(blocked) > 0:
+                ev = blocked.pop()
+                if len(blocked) == 0:
+                    del self._blocked[job_id]
+                self.stats.total_blocked -= 1
+                self._enqueue_locked(ev, ev.type)
+
+    def nack(self, eval_id: str, token: str) -> None:
+        """Negative acknowledgment: redeliver or fail
+        (eval_broker.go:464-497)."""
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise BrokerError("Evaluation ID not found")
+            if unack.token != token:
+                raise BrokerError("Token does not match for Evaluation ID")
+            unack.nack_timer.cancel()
+            del self._unack[eval_id]
+
+            self.stats.total_unacked -= 1
+            self.stats.sched(unack.eval.type).unacked -= 1
+
+            if self._evals.get(eval_id, 0) >= self.delivery_limit:
+                self._enqueue_locked(unack.eval, FAILED_QUEUE)
+            else:
+                self._enqueue_locked(unack.eval, unack.eval.type)
+
+    # -- flush/stats -------------------------------------------------------
+
+    def flush(self) -> None:
+        """eval_broker.go:499-532"""
+        with self._lock:
+            for unack in self._unack.values():
+                unack.nack_timer.cancel()
+            for timer in self._time_wait.values():
+                timer.cancel()
+            self.stats = BrokerStats()
+            self._evals = {}
+            self._job_evals = {}
+            self._blocked = {}
+            self._ready = {}
+            self._unack = {}
+            self._time_wait = {}
+            self._work_available.notify_all()
+
+    def snapshot_stats(self) -> BrokerStats:
+        with self._lock:
+            out = BrokerStats(
+                total_ready=self.stats.total_ready,
+                total_unacked=self.stats.total_unacked,
+                total_blocked=self.stats.total_blocked,
+                total_waiting=self.stats.total_waiting,
+            )
+            for sched, sub in self.stats.by_scheduler.items():
+                out.by_scheduler[sched] = SchedulerStats(sub.ready, sub.unacked)
+            return out

@@ -1,0 +1,170 @@
+"""Replicated state machine: applies log entries to the state store.
+
+Reference: /root/reference/nomad/fsm.go. Message types mirror
+fsm.go:116-144; applying an eval update enqueues pending evals into the
+broker (fsm.go:243-250). Snapshot/restore serializes the full state through
+StateRestore (fsm.go:299-593).
+
+``InProcRaft`` is the DevMode replication layer: synchronous apply with a
+monotonic index (the reference's testing posture, raft.NewInmemStore at
+server.go:420-427). The multi-server replicated log slots in behind the same
+``apply``/``applied_index`` interface.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Allocation, Evaluation, Job, Node
+
+
+class FSM:
+    """Applies replicated messages to a fresh StateStore
+    (reference: nomad/fsm.go:38-114)."""
+
+    def __init__(
+        self,
+        eval_broker=None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.state = StateStore()
+        self.eval_broker = eval_broker
+        self.logger = logger or logging.getLogger("nomad_tpu.fsm")
+        self._handlers: Dict[str, Callable[[int, dict], Any]] = {
+            "node_register": self._apply_node_register,
+            "node_deregister": self._apply_node_deregister,
+            "node_status_update": self._apply_node_status_update,
+            "node_drain_update": self._apply_node_drain_update,
+            "job_register": self._apply_job_register,
+            "job_deregister": self._apply_job_deregister,
+            "eval_update": self._apply_eval_update,
+            "eval_delete": self._apply_eval_delete,
+            "alloc_update": self._apply_alloc_update,
+            "alloc_client_update": self._apply_alloc_client_update,
+        }
+
+    def apply(self, index: int, msg_type: str, payload: dict) -> Any:
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            raise ValueError(f"failed to apply request: unknown type {msg_type!r}")
+        return handler(index, payload)
+
+    # -- handlers (fsm.go:146-297) ----------------------------------------
+
+    def _apply_node_register(self, index: int, payload: dict) -> None:
+        self.state.upsert_node(index, payload["node"])
+
+    def _apply_node_deregister(self, index: int, payload: dict) -> None:
+        self.state.delete_node(index, payload["node_id"])
+
+    def _apply_node_status_update(self, index: int, payload: dict) -> None:
+        self.state.update_node_status(index, payload["node_id"], payload["status"])
+
+    def _apply_node_drain_update(self, index: int, payload: dict) -> None:
+        self.state.update_node_drain(index, payload["node_id"], payload["drain"])
+
+    def _apply_job_register(self, index: int, payload: dict) -> None:
+        self.state.upsert_job(index, payload["job"])
+
+    def _apply_job_deregister(self, index: int, payload: dict) -> None:
+        self.state.delete_job(index, payload["job_id"])
+
+    def _apply_eval_update(self, index: int, payload: dict) -> None:
+        evals = payload["evals"]
+        self.state.upsert_evals(index, evals)
+        # On the leader, hand pending evals to the broker (fsm.go:243-250)
+        if self.eval_broker is not None:
+            for ev in evals:
+                if ev.should_enqueue():
+                    self.eval_broker.enqueue(ev)
+
+    def _apply_eval_delete(self, index: int, payload: dict) -> None:
+        self.state.delete_eval(index, payload["evals"], payload["allocs"])
+
+    def _apply_alloc_update(self, index: int, payload: dict) -> None:
+        self.state.upsert_allocs(index, payload["allocs"])
+
+    def _apply_alloc_client_update(self, index: int, payload: dict) -> None:
+        for alloc in payload["allocs"]:
+            self.state.update_alloc_from_client(index, alloc)
+
+    # -- snapshot/restore (fsm.go:299-593) ---------------------------------
+
+    def snapshot_bytes(self) -> bytes:
+        """Serialize the full FSM state. The reference streams msgpack with
+        type tags (fsm.go:414-593); we serialize table dumps (internal
+        format, not a wire protocol)."""
+        snap = self.state.snapshot()
+        payload = {
+            "nodes": snap.nodes(),
+            "jobs": snap.jobs(),
+            "evals": snap.evals(),
+            "allocs": snap.allocs(),
+            "indexes": {
+                t: snap.get_index(t) for t in ("nodes", "jobs", "evals", "allocs")
+            },
+        }
+        return pickle.dumps(payload)
+
+    def restore_bytes(self, data: bytes) -> None:
+        """Rebuild a fresh state store from a snapshot (fsm.go:313-410)."""
+        payload = pickle.loads(data)
+        self.state = StateStore()
+        restore = self.state.restore()
+        for node in payload["nodes"]:
+            restore.node_restore(node)
+        for job in payload["jobs"]:
+            restore.job_restore(job)
+        for ev in payload["evals"]:
+            restore.eval_restore(ev)
+        for alloc in payload["allocs"]:
+            restore.alloc_restore(alloc)
+        for table, index in payload["indexes"].items():
+            restore.index_restore(table, index)
+        restore.commit()
+
+
+class InProcRaft:
+    """Single-process replication layer: synchronous apply, monotonic index.
+
+    Interface contract shared with the future multi-server layer:
+    - apply(msg_type, payload) -> Future resolving to the log index
+    - applied_index property
+    """
+
+    def __init__(self, fsm: FSM):
+        self.fsm = fsm
+        self._lock = threading.Lock()
+        self._index = 0
+
+    @property
+    def applied_index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def apply(self, msg_type: str, payload: dict) -> Future:
+        """Apply under the lock, publishing the index only after the FSM has
+        executed the entry — readers of applied_index (worker wait_for_index)
+        must never observe an index whose entry is not yet visible, and
+        entries must hit the FSM in log order.
+
+        A failed apply still consumes its index: the log entry committed and
+        the FSM error is deterministic, matching replicated-raft semantics.
+        """
+        future: Future = Future()
+        with self._lock:
+            index = self._index + 1
+            try:
+                self.fsm.apply(index, msg_type, payload)
+            except Exception as e:
+                self._index = index
+                future.set_exception(e)
+            else:
+                self._index = index
+                future.set_result(index)
+        return future

@@ -1,0 +1,81 @@
+"""Leader-side node heartbeat TTL tracking.
+
+Reference: /root/reference/nomad/heartbeat.go. Each ready node gets a TTL
+timer; a missed heartbeat marks the node down, which fans out node-update
+evaluations (node_endpoint.go:459-551) so schedulers migrate its allocs.
+TTLs are rate-scaled so total heartbeats/sec stays bounded
+(heartbeat.go:52-54, util.go:123).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict
+
+from nomad_tpu.structs import NODE_STATUS_DOWN
+
+
+def rate_scaled_interval(rate: float, min_interval: float, count: int) -> float:
+    """Scale the heartbeat interval so ``count`` nodes produce at most
+    ``rate`` heartbeats/sec (reference: nomad/util.go:110-123)."""
+    interval = count / rate if rate > 0 else min_interval
+    return max(interval, min_interval)
+
+
+class HeartbeatManager:
+    def __init__(self, server):
+        self.server = server
+        self._lock = threading.Lock()
+        self._timers: Dict[str, threading.Timer] = {}
+
+    def reset_heartbeat_timer(self, node_id: str) -> float:
+        """(Re)arm the TTL timer for a node; returns the granted TTL
+        (heartbeat.go:13-54)."""
+        cfg = self.server.config
+        with self._lock:
+            existing = self._timers.pop(node_id, None)
+            if existing is not None:
+                existing.cancel()
+
+            ttl = rate_scaled_interval(
+                cfg.max_heartbeats_per_second, cfg.min_heartbeat_ttl,
+                len(self._timers),
+            )
+            ttl += random.uniform(0, ttl)  # jitter like the reference
+
+            timer = threading.Timer(ttl, self._invalidate_heartbeat, args=(node_id,))
+            timer.daemon = True
+            timer.start()
+            self._timers[node_id] = timer
+            return ttl
+
+    def _invalidate_heartbeat(self, node_id: str) -> None:
+        """Missed TTL: mark the node down (heartbeat.go:84-104)."""
+        with self._lock:
+            self._timers.pop(node_id, None)
+        self.server.logger.warning(
+            "heartbeat: node '%s' TTL expired, marking down", node_id
+        )
+        try:
+            self.server.node_update_status(node_id, NODE_STATUS_DOWN)
+        except Exception:
+            self.server.logger.exception(
+                "heartbeat: failed to update status for node %s", node_id
+            )
+
+    def clear_heartbeat_timer(self, node_id: str) -> None:
+        with self._lock:
+            timer = self._timers.pop(node_id, None)
+            if timer is not None:
+                timer.cancel()
+
+    def clear_all(self) -> None:
+        with self._lock:
+            for timer in self._timers.values():
+                timer.cancel()
+            self._timers.clear()
+
+    def num_timers(self) -> int:
+        with self._lock:
+            return len(self._timers)

@@ -1,0 +1,113 @@
+"""Plan queue: leader-only priority-FIFO queue of submitted plans.
+
+Reference: /root/reference/nomad/plan_queue.go. Each enqueue returns a
+future the submitting worker blocks on; the plan applier dequeues, verifies,
+applies, and responds through the future.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+from nomad_tpu.structs import Plan, PlanResult
+
+
+class PlanQueueError(Exception):
+    pass
+
+
+ERR_QUEUE_DISABLED = "plan queue is disabled"
+
+
+class PendingPlan:
+    """A submitted plan + its response future (plan_queue.go:50-69)."""
+
+    __slots__ = ("plan", "future")
+
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self.future: Future = Future()
+
+    def respond(self, result: Optional[PlanResult], err: Optional[Exception]) -> None:
+        if err is not None:
+            self.future.set_exception(err)
+        else:
+            self.future.set_result(result)
+
+    def wait(self, timeout: Optional[float] = None) -> PlanResult:
+        return self.future.result(timeout)
+
+
+class PlanQueue:
+    """Priority-FIFO plan queue, enabled only on the leader
+    (plan_queue.go:9-115)."""
+
+    _counter = itertools.count()
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._enabled = False
+        self._heap: List[Tuple[int, int, PendingPlan]] = []
+
+    @property
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    def enqueue(self, plan: Plan) -> PendingPlan:
+        """plan_queue.go:94-115"""
+        with self._lock:
+            if not self._enabled:
+                raise PlanQueueError(ERR_QUEUE_DISABLED)
+            pending = PendingPlan(plan)
+            heapq.heappush(
+                self._heap, (-plan.priority, next(self._counter), pending)
+            )
+            self._work.notify_all()
+            return pending
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        """Blocking dequeue; returns None on timeout or when disabled while
+        waiting (plan_queue.go:118-147)."""
+        import time as _time
+
+        deadline = None
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    return None
+                if self._heap:
+                    _, _, pending = heapq.heappop(self._heap)
+                    return pending
+                if timeout is not None:
+                    if deadline is None:
+                        deadline = _time.monotonic() + timeout
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._work.wait(remaining)
+                else:
+                    self._work.wait()
+
+    def flush(self) -> None:
+        """Cancel all pending plans (plan_queue.go:170-186)."""
+        with self._lock:
+            for _, _, pending in self._heap:
+                pending.respond(None, PlanQueueError("plan queue flushed"))
+            self._heap = []
+            self._work.notify_all()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)

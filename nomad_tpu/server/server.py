@@ -1,0 +1,429 @@
+"""The server: wires state, broker, plan pipeline, workers, heartbeats.
+
+Reference: /root/reference/nomad/server.go + the RPC endpoint files. This is
+the single-process ("DevMode") composition — replication is the synchronous
+InProcRaft (the reference's raft.NewInmemStore testing posture,
+server.go:420-427); the multi-server layer slots in behind the same
+apply/applied_index interface. Endpoint methods carry the semantics of the
+net/rpc endpoints (job_endpoint.go, node_endpoint.go, eval_endpoint.go,
+plan_endpoint.go) minus the wire format, which lives in nomad_tpu.api.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu import structs
+from nomad_tpu.server.core_sched import CoreScheduler
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.server.fsm import FSM, InProcRaft
+from nomad_tpu.server.heartbeat import HeartbeatManager
+from nomad_tpu.server.plan_apply import PlanApplier
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.server.timetable import TimeTable
+from nomad_tpu.server.worker import Worker
+from nomad_tpu.structs import (
+    CORE_JOB_EVAL_GC,
+    CORE_JOB_NODE_GC,
+    CORE_JOB_PRIORITY,
+    JOB_TYPE_CORE,
+    Evaluation,
+    Job,
+    Node,
+    Plan,
+    PlanResult,
+    generate_uuid,
+)
+
+
+@dataclass
+class ServerConfig:
+    """Server tunables (reference: nomad/config.go:46-236 defaults)."""
+
+    region: str = "global"
+    datacenter: str = "dc1"
+    node_name: str = "server-1"
+    num_schedulers: int = 2
+    enabled_schedulers: List[str] = field(
+        default_factory=lambda: [
+            structs.JOB_TYPE_SERVICE,
+            structs.JOB_TYPE_BATCH,
+            structs.JOB_TYPE_SYSTEM,
+            JOB_TYPE_CORE,
+        ]
+    )
+    # 'tpu' routes service/batch/system evals to the dense-solve factories;
+    # 'host' uses the scalar oracle.
+    scheduler_backend: str = "tpu"
+    eval_nack_timeout: float = 60.0
+    eval_delivery_limit: int = 3
+    eval_gc_interval: float = 300.0
+    eval_gc_threshold: float = 3600.0
+    node_gc_interval: float = 300.0
+    node_gc_threshold: float = 24 * 3600.0
+    min_heartbeat_ttl: float = 10.0
+    max_heartbeats_per_second: float = 50.0
+    failover_heartbeat_ttl: float = 300.0
+    periodic_dispatch: bool = False  # GC dispatch loop (leader.go:170-200)
+
+    def scheduler_factory(self, eval_type: str) -> str:
+        if self.scheduler_backend == "tpu" and eval_type in (
+            structs.JOB_TYPE_SERVICE,
+            structs.JOB_TYPE_BATCH,
+            structs.JOB_TYPE_SYSTEM,
+        ):
+            return f"tpu-{eval_type}"
+        return eval_type
+
+
+class Server:
+    """Single-process scheduling brain (reference: nomad/server.go:57-230,
+    leader lifecycle at nomad/leader.go:99-140)."""
+
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.config = config or ServerConfig()
+        self.logger = logger or logging.getLogger("nomad_tpu.server")
+
+        self.eval_broker = EvalBroker(
+            self.config.eval_nack_timeout, self.config.eval_delivery_limit
+        )
+        self.fsm = FSM(eval_broker=self.eval_broker, logger=self.logger)
+        self.raft = InProcRaft(self.fsm)
+        self.plan_queue = PlanQueue()
+        self.time_table = TimeTable()
+        self.heartbeat = HeartbeatManager(self)
+        self.plan_applier = PlanApplier(
+            self.plan_queue, self.eval_broker, self.raft, self.state_store,
+            self.logger,
+        )
+        self.workers: List[Worker] = []
+        self._periodic_stop = threading.Event()
+        self._started = False
+
+    @property
+    def state_store(self):
+        return self.fsm.state
+
+    # -- lifecycle (leader.go:99-140 establishLeadership) -------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self.plan_queue.set_enabled(True)
+        self.eval_broker.set_enabled(True)
+        self.plan_applier.start()
+        self.restore_eval_broker()
+        for i in range(self.config.num_schedulers):
+            worker = Worker(self, i)
+            worker.start()
+            self.workers.append(worker)
+        if self.config.periodic_dispatch:
+            t = threading.Thread(
+                target=self._periodic_dispatcher, daemon=True,
+                name="periodic-gc",
+            )
+            t.start()
+
+    def shutdown(self) -> None:
+        self._periodic_stop.set()
+        for worker in self.workers:
+            worker.stop()
+        self.plan_applier.stop()
+        self.plan_queue.set_enabled(False)
+        self.eval_broker.set_enabled(False)
+        self.heartbeat.clear_all()
+
+    def restore_eval_broker(self) -> None:
+        """Re-enqueue non-terminal evals after (re)gaining leadership
+        (leader.go:142-168)."""
+        for ev in self.state_store.evals():
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+
+    def _periodic_dispatcher(self) -> None:
+        """Dispatch GC core evals periodically (leader.go:170-200)."""
+        import time as _time
+
+        last_eval_gc = last_node_gc = _time.monotonic()
+        while not self._periodic_stop.wait(1.0):
+            now = _time.monotonic()
+            self.time_table.witness(self.raft.applied_index)
+            if now - last_eval_gc >= self.config.eval_gc_interval:
+                self._dispatch_core_job(CORE_JOB_EVAL_GC)
+                last_eval_gc = now
+            if now - last_node_gc >= self.config.node_gc_interval:
+                self._dispatch_core_job(CORE_JOB_NODE_GC)
+                last_node_gc = now
+
+    def _dispatch_core_job(self, job_id: str) -> None:
+        ev = Evaluation(
+            id=generate_uuid(),
+            priority=CORE_JOB_PRIORITY,
+            type=JOB_TYPE_CORE,
+            triggered_by=structs.EVAL_TRIGGER_SCHEDULED,
+            job_id=job_id,
+            status=structs.EVAL_STATUS_PENDING,
+        )
+        self.eval_broker.enqueue(ev)
+
+    # -- Job endpoint (job_endpoint.go) -------------------------------------
+
+    def job_register(self, job: Job) -> Tuple[str, int]:
+        """Register/update a job and create its evaluation
+        (job_endpoint.go:18-72). Returns (eval_id, index)."""
+        job.validate()
+        if job.type == JOB_TYPE_CORE:
+            raise ValueError("job type cannot be core")
+        index = self.raft.apply("job_register", {"job": job}).result()
+
+        ev = Evaluation(
+            id=generate_uuid(),
+            priority=job.priority,
+            type=job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            job_modify_index=index,
+            status=structs.EVAL_STATUS_PENDING,
+        )
+        eval_index = self.raft.apply("eval_update", {"evals": [ev]}).result()
+        return ev.id, eval_index
+
+    def job_evaluate(self, job_id: str) -> Tuple[str, int]:
+        """Force re-evaluation (job_endpoint.go:75-128)."""
+        job = self.state_store.job_by_id(job_id)
+        if job is None:
+            raise KeyError("job not found")
+        ev = Evaluation(
+            id=generate_uuid(),
+            priority=job.priority,
+            type=job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            job_modify_index=job.modify_index,
+            status=structs.EVAL_STATUS_PENDING,
+        )
+        index = self.raft.apply("eval_update", {"evals": [ev]}).result()
+        return ev.id, index
+
+    def job_deregister(self, job_id: str) -> Tuple[str, int]:
+        """Remove a job and evaluate the teardown
+        (job_endpoint.go:130-183)."""
+        job = self.state_store.job_by_id(job_id)
+        index = self.raft.apply("job_deregister", {"job_id": job_id}).result()
+
+        priority = job.priority if job else structs.JOB_DEFAULT_PRIORITY
+        jtype = job.type if job else structs.JOB_TYPE_SERVICE
+        ev = Evaluation(
+            id=generate_uuid(),
+            priority=priority,
+            type=jtype,
+            triggered_by=structs.EVAL_TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+            job_modify_index=index,
+            status=structs.EVAL_STATUS_PENDING,
+        )
+        eval_index = self.raft.apply("eval_update", {"evals": [ev]}).result()
+        return ev.id, eval_index
+
+    # -- Node endpoint (node_endpoint.go) ------------------------------------
+
+    def node_register(self, node: Node) -> Dict:
+        """node_endpoint.go:18-80"""
+        if not node.id:
+            raise ValueError("missing node ID for client registration")
+        if not node.datacenter:
+            raise ValueError("missing datacenter for client registration")
+        if not node.name:
+            raise ValueError("missing node name for client registration")
+        if not node.status:
+            node.status = structs.NODE_STATUS_INIT
+        if not structs.valid_node_status(node.status):
+            raise ValueError("invalid status for node")
+
+        index = self.raft.apply("node_register", {"node": node}).result()
+
+        reply: Dict = {"node_modify_index": index, "index": index, "eval_ids": []}
+        if structs.should_drain_node(node.status):
+            reply["eval_ids"], reply["eval_create_index"] = self.create_node_evals(
+                node.id, index
+            )
+        if not node.terminal_status():
+            reply["heartbeat_ttl"] = self.heartbeat.reset_heartbeat_timer(node.id)
+        return reply
+
+    def node_deregister(self, node_id: str) -> Dict:
+        """node_endpoint.go:82-117"""
+        index = self.raft.apply("node_deregister", {"node_id": node_id}).result()
+        self.heartbeat.clear_heartbeat_timer(node_id)
+        eval_ids, eval_index = self.create_node_evals(node_id, index)
+        return {
+            "eval_ids": eval_ids,
+            "eval_create_index": eval_index,
+            "node_modify_index": index,
+            "index": index,
+        }
+
+    def node_update_status(self, node_id: str, status: str) -> Dict:
+        """node_endpoint.go:119-184"""
+        if not structs.valid_node_status(status):
+            raise ValueError("invalid status for node")
+        node = self.state_store.node_by_id(node_id)
+        if node is None:
+            raise KeyError("node not found")
+
+        index = node.modify_index
+        if node.status != status:
+            index = self.raft.apply(
+                "node_status_update", {"node_id": node_id, "status": status}
+            ).result()
+
+        reply: Dict = {"node_modify_index": index, "index": index, "eval_ids": []}
+        transition_to_ready = (
+            node.status in (structs.NODE_STATUS_INIT, structs.NODE_STATUS_DOWN)
+            and status == structs.NODE_STATUS_READY
+        )
+        if structs.should_drain_node(status) or transition_to_ready:
+            reply["eval_ids"], reply["eval_create_index"] = self.create_node_evals(
+                node_id, index
+            )
+        if status != structs.NODE_STATUS_DOWN:
+            reply["heartbeat_ttl"] = self.heartbeat.reset_heartbeat_timer(node_id)
+        return reply
+
+    def node_update_drain(self, node_id: str, drain: bool) -> Dict:
+        """node_endpoint.go:187-238"""
+        node = self.state_store.node_by_id(node_id)
+        if node is None:
+            raise KeyError("node not found")
+        index = node.modify_index
+        if node.drain != drain:
+            index = self.raft.apply(
+                "node_drain_update", {"node_id": node_id, "drain": drain}
+            ).result()
+        reply: Dict = {"node_modify_index": index, "index": index, "eval_ids": []}
+        if drain:
+            reply["eval_ids"], reply["eval_create_index"] = self.create_node_evals(
+                node_id, index
+            )
+        return reply
+
+    def node_evaluate(self, node_id: str) -> Dict:
+        """Force re-evaluation of a node (node_endpoint.go:240-280)."""
+        node = self.state_store.node_by_id(node_id)
+        if node is None:
+            raise KeyError("node not found")
+        eval_ids, eval_index = self.create_node_evals(node_id, node.modify_index)
+        return {"eval_ids": eval_ids, "eval_create_index": eval_index,
+                "index": eval_index}
+
+    def node_heartbeat(self, node_id: str) -> float:
+        """Client TTL renewal via Node.UpdateStatus(ready) in the reference;
+        exposed directly for the client loop."""
+        return self.node_update_status(node_id, structs.NODE_STATUS_READY).get(
+            "heartbeat_ttl", 0.0
+        )
+
+    def update_allocs_from_client(self, allocs: List) -> int:
+        """node_endpoint.go:385-457 (Node.UpdateAlloc)"""
+        return self.raft.apply("alloc_client_update", {"allocs": allocs}).result()
+
+    def create_node_evals(self, node_id: str, node_index: int) -> Tuple[List[str], int]:
+        """Fan out node-update evals: one per job with allocs on the node,
+        plus every system job (node_endpoint.go:459-551)."""
+        snap = self.state_store.snapshot()
+        allocs = snap.allocs_by_node(node_id)
+        sys_jobs = snap.jobs_by_scheduler(structs.JOB_TYPE_SYSTEM)
+
+        if not allocs and not sys_jobs:
+            return [], 0
+
+        evals: List[Evaluation] = []
+        job_ids = set()
+        for alloc in allocs:
+            if alloc.job_id in job_ids or alloc.job is None:
+                continue
+            job_ids.add(alloc.job_id)
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    priority=alloc.job.priority,
+                    type=alloc.job.type,
+                    triggered_by=structs.EVAL_TRIGGER_NODE_UPDATE,
+                    job_id=alloc.job_id,
+                    node_id=node_id,
+                    node_modify_index=node_index,
+                    status=structs.EVAL_STATUS_PENDING,
+                )
+            )
+        for job in sys_jobs:
+            if job.id in job_ids:
+                continue
+            job_ids.add(job.id)
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=structs.EVAL_TRIGGER_NODE_UPDATE,
+                    job_id=job.id,
+                    node_id=node_id,
+                    node_modify_index=node_index,
+                    status=structs.EVAL_STATUS_PENDING,
+                )
+            )
+
+        index = self.raft.apply("eval_update", {"evals": evals}).result()
+        return [e.id for e in evals], index
+
+    # -- Eval endpoint (eval_endpoint.go) ------------------------------------
+
+    def eval_dequeue(self, schedulers: List[str], timeout: float):
+        return self.eval_broker.dequeue(schedulers, timeout)
+
+    def eval_ack(self, eval_id: str, token: str) -> None:
+        self.eval_broker.ack(eval_id, token)
+
+    def eval_nack(self, eval_id: str, token: str) -> None:
+        self.eval_broker.nack(eval_id, token)
+
+    def eval_reap(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
+        return self.raft.apply(
+            "eval_delete", {"evals": eval_ids, "allocs": alloc_ids}
+        ).result()
+
+    # -- Plan endpoint (plan_endpoint.go:16-38) ------------------------------
+
+    def plan_submit(self, plan: Plan) -> PlanResult:
+        pending = self.plan_queue.enqueue(plan)
+        return pending.wait()
+
+    # -- convenience --------------------------------------------------------
+
+    def wait_for_eval(self, eval_id: str, timeout: float = 10.0) -> Evaluation:
+        """Poll until the eval reaches a terminal status (the CLI monitor's
+        polling loop, command/monitor.go)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            ev = self.state_store.eval_by_id(eval_id)
+            if ev is not None and ev.terminal_status():
+                return ev
+            _time.sleep(0.01)
+        raise TimeoutError(f"eval {eval_id} did not complete")
+
+    def stats(self) -> Dict:
+        broker = self.eval_broker.snapshot_stats()
+        return {
+            "applied_index": self.raft.applied_index,
+            "broker_ready": broker.total_ready,
+            "broker_unacked": broker.total_unacked,
+            "broker_blocked": broker.total_blocked,
+            "plan_queue_depth": self.plan_queue.depth(),
+            "heartbeat_timers": self.heartbeat.num_timers(),
+        }

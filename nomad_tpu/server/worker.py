@@ -1,0 +1,153 @@
+"""Scheduling worker: dequeues evals, runs the scheduler, submits plans.
+
+Reference: /root/reference/nomad/worker.go. Each server runs N workers
+(NumSchedulers, config.go:223). The worker implements the scheduler's
+Planner interface: SubmitPlan stamps the EvalToken and routes through the
+plan queue; a RefreshIndex response forces a state refresh before retry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional, Tuple
+
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.server.eval_broker import BrokerError
+from nomad_tpu.structs import JOB_TYPE_CORE, Evaluation, Plan, PlanResult
+
+RAFT_SYNC_LIMIT = 2.0  # reference raftSyncLimit (worker.go:31-34)
+DEQUEUE_TIMEOUT = 0.5
+
+
+class Worker(threading.Thread):
+    """One scheduling thread (worker.go:45-125)."""
+
+    def __init__(self, server, worker_id: int = 0):
+        super().__init__(daemon=True, name=f"worker-{worker_id}")
+        self.server = server
+        self.logger = server.logger.getChild(f"worker{worker_id}")
+        self._stop = threading.Event()
+        self._paused = False
+        self._pause_cond = threading.Condition()
+        self.eval_token: Optional[str] = None
+        # State snapshot used for the current eval
+        self._snapshot = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.set_pause(False)
+
+    def set_pause(self, paused: bool) -> None:
+        """Leader pauses one worker to reduce contention (worker.go:77-93)."""
+        with self._pause_cond:
+            self._paused = paused
+            self._pause_cond.notify_all()
+
+    def _check_paused(self) -> None:
+        with self._pause_cond:
+            while self._paused and not self._stop.is_set():
+                self._pause_cond.wait(0.2)
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self._check_paused()
+            dequeued = self._dequeue_evaluation()
+            if dequeued is None:
+                continue
+            ev, token = dequeued
+
+            # Wait for the state to reach the eval's modify index
+            # (worker.go:209-230).
+            try:
+                self._wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
+            except TimeoutError as e:
+                self.logger.error("error waiting for state sync: %s", e)
+                self._send_ack(ev.id, token, ack=False)
+                continue
+
+            ok = self._invoke_scheduler(ev, token)
+            self._send_ack(ev.id, token, ack=ok)
+
+    # -- internals ---------------------------------------------------------
+
+    def _dequeue_evaluation(self) -> Optional[Tuple[Evaluation, str]]:
+        try:
+            ev, token = self.server.eval_broker.dequeue(
+                self.server.config.enabled_schedulers, timeout=DEQUEUE_TIMEOUT
+            )
+        except BrokerError:
+            time.sleep(0.05)
+            return None
+        if ev is None:
+            return None
+        self.logger.debug("dequeued evaluation %s", ev.id)
+        return ev, token
+
+    def _send_ack(self, eval_id: str, token: str, ack: bool) -> None:
+        """Best effort ack/nack (worker.go:172-202)."""
+        try:
+            if ack:
+                self.server.eval_broker.ack(eval_id, token)
+            else:
+                self.server.eval_broker.nack(eval_id, token)
+        except BrokerError as e:
+            self.logger.error(
+                "failed to %s evaluation '%s': %s", "ack" if ack else "nack",
+                eval_id, e,
+            )
+
+    def _wait_for_index(self, index: int, timeout: float) -> None:
+        """Spin until the FSM has applied ``index`` (worker.go:204-230)."""
+        start = time.monotonic()
+        delay = 0.001
+        while True:
+            if self.server.raft.applied_index >= index:
+                return
+            if time.monotonic() - start > timeout:
+                raise TimeoutError("sync wait timeout reached")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
+
+    def _invoke_scheduler(self, ev: Evaluation, token: str) -> bool:
+        """worker.go:232-261"""
+        self.eval_token = token
+        self._snapshot = self.server.state_store.snapshot()
+        try:
+            if ev.type == JOB_TYPE_CORE:
+                from nomad_tpu.server.core_sched import CoreScheduler
+
+                sched = CoreScheduler(self.server, self._snapshot)
+            else:
+                factory = self.server.config.scheduler_factory(ev.type)
+                sched = new_scheduler(factory, self._snapshot, self, self.logger)
+            sched.process(ev)
+            return True
+        except Exception:
+            self.logger.exception("failed to process evaluation %s", ev.id)
+            return False
+
+    # -- Planner interface (worker.go:263-396) ------------------------------
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        plan.eval_token = self.eval_token
+        pending = self.server.plan_queue.enqueue(plan)
+        result = pending.wait()
+
+        new_state = None
+        if result.refresh_index != 0:
+            # Stale data: wait for the log to catch up, then refresh
+            # (worker.go:304-322).
+            self._wait_for_index(result.refresh_index, RAFT_SYNC_LIMIT)
+            new_state = self.server.state_store.snapshot()
+        return result, new_state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.raft.apply("eval_update", {"evals": [ev]}).result()
+
+    def create_eval(self, ev: Evaluation) -> None:
+        ev.create_index = self.server.raft.applied_index
+        self.server.raft.apply("eval_update", {"evals": [ev]}).result()

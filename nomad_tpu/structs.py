@@ -21,8 +21,8 @@ Reference citations (``file:line`` into /root/reference):
 from __future__ import annotations
 
 import copy as _copy
+import os
 import re
-import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -82,8 +82,12 @@ RESOURCE_DIMS = ("cpu", "memory_mb", "disk_mb", "iops")
 
 
 def generate_uuid() -> str:
-    """Random UUID (reference: nomad/structs/funcs.go:126-139)."""
-    return str(uuid.uuid4())
+    """Random UUID (reference: nomad/structs/funcs.go:126-139).
+
+    Formatted from os.urandom directly — ~3x faster than uuid.uuid4() and
+    hot at bench scale (one per Allocation, 100k per big eval)."""
+    h = os.urandom(16).hex()
+    return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
 
 
 # ---------------------------------------------------------------------------

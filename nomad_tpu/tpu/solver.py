@@ -60,15 +60,9 @@ from nomad_tpu.structs import (
 from nomad_tpu.tpu.mirror import NodeMirror
 
 
-class _Placement:
-    """One successful placement out of a batched solve."""
-
-    __slots__ = ("node", "task_resources", "score")
-
-    def __init__(self, node: Node, task_resources: Dict[str, Resources], score: float):
-        self.node = node
-        self.task_resources = task_resources
-        self.score = score
+# A placement out of a batched solve: (node, task_resources). Plain tuples:
+# at bench scale (100k placements per eval) object construction is hot.
+_Placement = Tuple[Node, Dict[str, Resources]]
 
 
 class _SolveInputs:
@@ -199,6 +193,20 @@ class TPUStack:
         net_indexes: Dict[int, NetworkIndex] = {}
         placements: List[Optional[_Placement]] = []
 
+        if not any(t.resources is not None and t.resources.networks for t in tg.tasks):
+            # No network asks: nothing to offer. Share one task_resources
+            # map across placements — the reference's Select fallback also
+            # aliases the task's own Resources when no offer is needed
+            # (stack.go:150-154). Consumers must treat these as immutable;
+            # select() copies before handing them to inplace_update.
+            shared = {t.name: t.resources for t in tg.tasks}
+            nodes_list = mirror.nodes
+            n = mirror.n
+            return [
+                (nodes_list[idx], shared) if ok and 0 <= idx < n else None
+                for idx, ok in zip(idxs, oks)
+            ]
+
         for idx, ok in zip(idxs, oks):
             if not ok or idx < 0 or idx >= mirror.n:
                 placements.append(None)
@@ -228,7 +236,7 @@ class TPUStack:
             if failed:
                 placements.append(None)
                 continue
-            placements.append(_Placement(node, task_resources, 0.0))
+            placements.append((node, task_resources))
         return placements
 
     # -- Stack protocol ----------------------------------------------------
@@ -241,9 +249,11 @@ class TPUStack:
         placement = placements[0]
         if placement is None:
             return None, size
-        option = RankedNode(placement.node)
-        option.score = placement.score
-        option.task_resources = placement.task_resources
+        node, task_resources = placement
+        option = RankedNode(node)
+        # Copy per task: inplace_update mutates these (util.py network
+        # restore), and the fast path may alias the job spec's Resources.
+        option.task_resources = {k: v.copy() for k, v in task_resources.items()}
         for task in tg.tasks:
             if task.name not in option.task_resources:
                 option.task_resources[task.name] = task.resources
@@ -290,8 +300,8 @@ class TPUGenericScheduler(GenericScheduler):
                     metrics=self.ctx.metrics(),
                 )
                 if placement is not None:
-                    alloc.node_id = placement.node.id
-                    alloc.task_resources = placement.task_resources
+                    alloc.node_id = placement[0].id
+                    alloc.task_resources = placement[1]
                     alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
                     alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
                     self.plan.append_alloc(alloc)
@@ -389,8 +399,8 @@ class TPUSystemScheduler(SystemScheduler):
                     metrics=metrics,
                 )
                 if placement is not None:
-                    alloc.node_id = placement.node.id
-                    alloc.task_resources = placement.task_resources
+                    alloc.node_id = placement[0].id
+                    alloc.task_resources = placement[1]
                     alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
                     alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
                     self.plan.append_alloc(alloc)

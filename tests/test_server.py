@@ -1,0 +1,405 @@
+"""Server control-plane tests: broker, plan queue/apply, and the end-to-end
+single-process pipeline (reference: nomad/eval_broker_test.go,
+plan_apply_test.go, worker_test.go, job_endpoint_test.go,
+node_endpoint_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.eval_broker import FAILED_QUEUE, BrokerError, EvalBroker
+from nomad_tpu.server.plan_apply import evaluate_plan
+from nomad_tpu.server.plan_queue import PlanQueue, PlanQueueError
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Evaluation, Plan, Resources, generate_uuid
+
+
+def _eval(priority=50, job_id=None, eval_type="service"):
+    return Evaluation(
+        id=generate_uuid(),
+        priority=priority,
+        type=eval_type,
+        job_id=job_id or generate_uuid(),
+        status=structs.EVAL_STATUS_PENDING,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Eval broker (reference: eval_broker_test.go, 755 LoC)
+# ---------------------------------------------------------------------------
+
+
+def test_broker_enqueue_dequeue_ack():
+    b = EvalBroker(5.0, 3)
+    b.set_enabled(True)
+    ev = _eval()
+    b.enqueue(ev)
+    assert b.snapshot_stats().total_ready == 1
+
+    out, token = b.dequeue(["service"], timeout=1.0)
+    assert out is ev
+    assert token
+    stats = b.snapshot_stats()
+    assert stats.total_ready == 0
+    assert stats.total_unacked == 1
+
+    # Outstanding tracks the token
+    tok, ok = b.outstanding(ev.id)
+    assert ok and tok == token
+
+    b.ack(ev.id, token)
+    stats = b.snapshot_stats()
+    assert stats.total_unacked == 0
+    assert b.outstanding(ev.id) == ("", False)
+
+
+def test_broker_priority_order():
+    b = EvalBroker(5.0, 3)
+    b.set_enabled(True)
+    low = _eval(priority=10)
+    high = _eval(priority=90)
+    mid = _eval(priority=50)
+    for ev in (low, high, mid):
+        b.enqueue(ev)
+
+    out1, t1 = b.dequeue(["service"], timeout=1.0)
+    out2, t2 = b.dequeue(["service"], timeout=1.0)
+    out3, t3 = b.dequeue(["service"], timeout=1.0)
+    assert [out1.id, out2.id, out3.id] == [high.id, mid.id, low.id]
+
+
+def test_broker_job_serialization():
+    """One outstanding eval per job; later ones block until Ack."""
+    b = EvalBroker(5.0, 3)
+    b.set_enabled(True)
+    job_id = generate_uuid()
+    ev1 = _eval(job_id=job_id)
+    ev2 = _eval(job_id=job_id)
+    b.enqueue(ev1)
+    b.enqueue(ev2)
+
+    stats = b.snapshot_stats()
+    assert stats.total_ready == 1
+    assert stats.total_blocked == 1
+
+    out, token = b.dequeue(["service"], timeout=1.0)
+    assert out is ev1
+    # No more ready work while ev1 is outstanding
+    assert b.dequeue(["service"], timeout=0.05) == (None, "")
+
+    b.ack(ev1.id, token)
+    out2, token2 = b.dequeue(["service"], timeout=1.0)
+    assert out2 is ev2
+    b.ack(ev2.id, token2)
+
+
+def test_broker_nack_redelivers_then_fails():
+    b = EvalBroker(5.0, delivery_limit=2)
+    b.set_enabled(True)
+    ev = _eval()
+    b.enqueue(ev)
+
+    # First delivery + nack -> redelivered
+    out, token = b.dequeue(["service"], timeout=1.0)
+    b.nack(ev.id, token)
+    out, token = b.dequeue(["service"], timeout=1.0)
+    assert out is ev
+    # Second nack hits the delivery limit -> _failed queue
+    b.nack(ev.id, token)
+    assert b.dequeue(["service"], timeout=0.05) == (None, "")
+    out, token = b.dequeue([FAILED_QUEUE], timeout=1.0)
+    assert out is ev
+
+
+def test_broker_nack_timeout_redelivers():
+    b = EvalBroker(nack_timeout=0.1, delivery_limit=5)
+    b.set_enabled(True)
+    ev = _eval()
+    b.enqueue(ev)
+    out, token = b.dequeue(["service"], timeout=1.0)
+    # Don't ack; wait for the nack timer
+    out2, token2 = b.dequeue(["service"], timeout=2.0)
+    assert out2 is ev
+    assert token2 != token
+    # The old token no longer acks
+    with pytest.raises(BrokerError):
+        b.ack(ev.id, token)
+    b.ack(ev.id, token2)
+
+
+def test_broker_wait_eval():
+    b = EvalBroker(5.0, 3)
+    b.set_enabled(True)
+    ev = _eval()
+    ev.wait = 0.1
+    b.enqueue(ev)
+    assert b.snapshot_stats().total_waiting == 1
+    assert b.dequeue(["service"], timeout=0.01) == (None, "")
+    out, _ = b.dequeue(["service"], timeout=2.0)
+    assert out is ev
+
+
+def test_broker_dedup_enqueue():
+    b = EvalBroker(5.0, 3)
+    b.set_enabled(True)
+    ev = _eval()
+    b.enqueue(ev)
+    b.enqueue(ev)
+    assert b.snapshot_stats().total_ready == 1
+
+
+def test_broker_disabled():
+    b = EvalBroker(5.0, 3)
+    ev = _eval()
+    b.enqueue(ev)  # no-op while disabled
+    with pytest.raises(BrokerError):
+        b.dequeue(["service"], timeout=0.05)
+
+
+def test_broker_dequeue_batch():
+    b = EvalBroker(5.0, 3)
+    b.set_enabled(True)
+    evs = [_eval() for _ in range(5)]
+    for ev in evs:
+        b.enqueue(ev)
+    batch = b.dequeue_batch(["service"], max_batch=3, timeout=1.0)
+    assert len(batch) == 3
+    ids = {ev.id for ev, _ in batch}
+    assert len(ids) == 3
+    for ev, token in batch:
+        b.ack(ev.id, token)
+
+
+def test_broker_outstanding_reset_token_mismatch():
+    b = EvalBroker(5.0, 3)
+    b.set_enabled(True)
+    ev = _eval()
+    b.enqueue(ev)
+    _, token = b.dequeue(["service"], timeout=1.0)
+    b.outstanding_reset(ev.id, token)  # ok
+    with pytest.raises(BrokerError):
+        b.outstanding_reset(ev.id, "bogus-token")
+    with pytest.raises(BrokerError):
+        b.outstanding_reset("missing", token)
+
+
+# ---------------------------------------------------------------------------
+# Plan queue
+# ---------------------------------------------------------------------------
+
+
+def test_plan_queue_priority_and_future():
+    q = PlanQueue()
+    q.set_enabled(True)
+    low = Plan(priority=10)
+    high = Plan(priority=90)
+    p1 = q.enqueue(low)
+    p2 = q.enqueue(high)
+
+    out = q.dequeue(timeout=0.1)
+    assert out.plan is high
+    out2 = q.dequeue(timeout=0.1)
+    assert out2.plan is low
+
+    from nomad_tpu.structs import PlanResult
+
+    result = PlanResult()
+    out.respond(result, None)
+    assert p2.wait(0.1) is result
+
+
+def test_plan_queue_disabled():
+    q = PlanQueue()
+    with pytest.raises(PlanQueueError):
+        q.enqueue(Plan())
+
+
+# ---------------------------------------------------------------------------
+# Plan evaluation (reference: plan_apply_test.go)
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_plan_partial_commit():
+    state = StateStore()
+    node = mock.node()
+    state.upsert_node(1000, node)
+
+    # Fits
+    alloc_ok = mock.alloc()
+    alloc_ok.node_id = node.id
+    # Does not fit (oversized)
+    alloc_bad = mock.alloc()
+    alloc_bad.node_id = "missing-node"
+
+    plan = Plan(
+        node_allocation={node.id: [alloc_ok], "missing-node": [alloc_bad]},
+        node_update={},
+    )
+    snap = state.snapshot()
+    result = evaluate_plan(snap, plan)
+    assert node.id in result.node_allocation
+    assert "missing-node" not in result.node_allocation
+    assert result.refresh_index > 0
+    full, expected, actual = result.full_commit(plan)
+    assert not full and expected == 2 and actual == 1
+
+
+def test_evaluate_plan_all_at_once_rejects_all():
+    state = StateStore()
+    node = mock.node()
+    state.upsert_node(1000, node)
+    alloc_bad = mock.alloc()
+    alloc_bad.node_id = "missing-node"
+    plan = Plan(
+        all_at_once=True,
+        node_allocation={"missing-node": [alloc_bad]},
+        node_update={},
+    )
+    result = evaluate_plan(state.snapshot(), plan)
+    assert result.node_allocation == {}
+
+
+def test_evaluate_plan_evict_only_always_fits():
+    state = StateStore()
+    alloc = mock.alloc()
+    plan = Plan(node_update={"any-node": [alloc]}, node_allocation={})
+    result = evaluate_plan(state.snapshot(), plan)
+    assert result.node_update == {"any-node": [alloc]}
+    assert result.refresh_index == 0
+
+
+def test_evaluate_plan_overcommit_rejected():
+    state = StateStore()
+    node = mock.node()
+    node.resources = Resources(cpu=1000, memory_mb=1000, disk_mb=10000, iops=100)
+    node.reserved = None
+    state.upsert_node(1000, node)
+
+    big = mock.alloc()
+    big.node_id = node.id
+    big.resources = Resources(cpu=900, memory_mb=900)
+    state.upsert_allocs(1001, [big])
+
+    alloc = mock.alloc()
+    alloc.node_id = node.id
+    alloc.resources = Resources(cpu=500, memory_mb=256)
+    plan = Plan(node_allocation={node.id: [alloc]}, node_update={})
+    result = evaluate_plan(state.snapshot(), plan)
+    assert result.node_allocation == {}
+    assert result.refresh_index > 0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end single-process pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=["host", "tpu"])
+def server(request):
+    srv = Server(ServerConfig(scheduler_backend=request.param, num_schedulers=2))
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_end_to_end_job_register(server):
+    """register job -> eval -> broker -> worker -> solver -> plan apply ->
+    allocs in state (call stack SURVEY.md §3.1)."""
+    for _ in range(10):
+        server.node_register(mock.node())
+
+    job = mock.job()
+    eval_id, _ = server.job_register(job)
+
+    ev = server.wait_for_eval(eval_id, timeout=15.0)
+    assert ev.status == structs.EVAL_STATUS_COMPLETE
+
+    allocs = server.state_store.allocs_by_job(job.id)
+    assert len(allocs) == 10
+    assert all(a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN for a in allocs)
+    # All on distinct ready nodes
+    assert len({a.node_id for a in allocs}) == 10
+
+
+def test_end_to_end_deregister(server):
+    for _ in range(3):
+        server.node_register(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 3
+    eval_id, _ = server.job_register(job)
+    server.wait_for_eval(eval_id, timeout=15.0)
+
+    eval_id2, _ = server.job_deregister(job.id)
+    server.wait_for_eval(eval_id2, timeout=15.0)
+
+    allocs = structs.filter_terminal_allocs(server.state_store.allocs_by_job(job.id))
+    assert allocs == []
+
+
+def test_end_to_end_node_down_reschedules(server):
+    reply1 = server.node_register(mock.node())
+    node2 = mock.node()
+    server.node_register(node2)
+
+    job = mock.job()
+    job.task_groups[0].count = 2
+    eval_id, _ = server.job_register(job)
+    server.wait_for_eval(eval_id, timeout=15.0)
+    allocs = server.state_store.allocs_by_job(job.id)
+    assert len(allocs) == 2
+
+    # Mark node2 down: its alloc migrates to node1 (or fails if full)
+    reply = server.node_update_status(node2.id, structs.NODE_STATUS_DOWN)
+    assert reply["eval_ids"]
+    for ev_id in reply["eval_ids"]:
+        server.wait_for_eval(ev_id, timeout=15.0)
+
+    live = structs.filter_terminal_allocs(server.state_store.allocs_by_job(job.id))
+    assert all(a.node_id != node2.id for a in live)
+
+
+def test_heartbeat_ttl_marks_node_down():
+    cfg = ServerConfig(min_heartbeat_ttl=0.1, max_heartbeats_per_second=1000.0)
+    srv = Server(cfg)
+    srv.start()
+    try:
+        node = mock.node()
+        reply = server_reply = srv.node_register(node)
+        assert reply["heartbeat_ttl"] > 0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            out = srv.state_store.node_by_id(node.id)
+            if out.status == structs.NODE_STATUS_DOWN:
+                break
+            time.sleep(0.05)
+        assert srv.state_store.node_by_id(node.id).status == structs.NODE_STATUS_DOWN
+    finally:
+        srv.shutdown()
+
+
+def test_fsm_snapshot_restore_roundtrip():
+    srv = Server(ServerConfig(scheduler_backend="host"))
+    srv.start()
+    try:
+        for _ in range(3):
+            srv.node_register(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 3
+        eval_id, _ = srv.job_register(job)
+        srv.wait_for_eval(eval_id, timeout=15.0)
+
+        data = srv.fsm.snapshot_bytes()
+
+        from nomad_tpu.server.fsm import FSM
+
+        fsm2 = FSM()
+        fsm2.restore_bytes(data)
+        assert len(fsm2.state.nodes()) == 3
+        assert fsm2.state.job_by_id(job.id) is not None
+        assert len(fsm2.state.allocs_by_job(job.id)) == 3
+        assert fsm2.state.get_index("allocs") == srv.state_store.get_index("allocs")
+    finally:
+        srv.shutdown()
