@@ -1,0 +1,12 @@
+"""Client agent: the node-side muscle.
+
+Mirrors the reference client (/root/reference/client/, SURVEY.md §2.4):
+fingerprinting the node, registering + heartbeating with servers, watching
+for assigned allocations, and running them through pluggable task drivers
+with restart policies and persisted state.
+"""
+
+from nomad_tpu.client.client import Client
+from nomad_tpu.client.config import ClientConfig
+
+__all__ = ["Client", "ClientConfig"]

@@ -1,0 +1,61 @@
+"""exec driver: isolated command execution.
+
+Reference: /root/reference/client/driver/exec.go — cgroup/chroot isolation
+via the shared executor, artifact fetch via client/getter. Isolation
+degrades gracefully when the agent lacks cgroup privileges (the handle
+records whether limits were applied).
+"""
+
+from __future__ import annotations
+
+import platform
+
+from nomad_tpu.client.driver import executor
+from nomad_tpu.client.driver.driver import (
+    Driver,
+    DriverError,
+    DriverHandle,
+    task_environment,
+)
+from nomad_tpu.client.driver.raw_exec import _parse_args
+from nomad_tpu.client.getter import get_artifact
+from nomad_tpu.structs import Node, Task
+
+
+class ExecDriver(Driver):
+    name = "exec"
+
+    @classmethod
+    def fingerprint(cls, config, node: Node) -> bool:
+        # Reference gates on Linux + root for cgroups (exec.go:34-49); we
+        # advertise on Linux and record the isolation level as an attribute.
+        if platform.system() != "Linux":
+            return False
+        node.attributes["driver.exec"] = "1"
+        node.attributes["driver.exec.isolation"] = (
+            "cgroups" if executor.cgroups_available() else "none"
+        )
+        return True
+
+    def start(self, task: Task) -> DriverHandle:
+        command = task.config.get("command")
+        artifact = task.config.get("artifact_source")
+        if artifact:
+            task_dir = self.ctx.alloc_dir.task_dirs.get(
+                task.name, self.ctx.alloc_dir.alloc_dir
+            )
+            fetched = get_artifact(
+                artifact, task_dir, task.config.get("checksum", "")
+            )
+            if not command:
+                command = fetched
+        if not command:
+            raise DriverError("missing command for exec driver")
+        args = _parse_args(task.config.get("args"))
+        env = task_environment(self.ctx, task)
+        return executor.start_command(
+            self.ctx, task, command, args, env, isolate=True
+        )
+
+    def open(self, handle_id: str) -> DriverHandle:
+        return executor.open_handle(handle_id)

@@ -1,0 +1,181 @@
+"""Node fingerprinting: detect attributes + resources of the host.
+
+Reference: /root/reference/client/fingerprint/ (SURVEY.md §2.4). Each
+fingerprinter mutates node.attributes/resources and reports applicability;
+``BUILTIN_FINGERPRINTS`` is the ordered list (fingerprint.go:17-41). Some
+fingerprints are periodic (consul in the reference); the framework supports
+it via ``periodic()`` returning (enabled, interval).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import platform
+import shutil
+import socket
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import Node, Resources
+
+
+class Fingerprint:
+    """Base fingerprinter (reference: fingerprint/fingerprint.go:44-79)."""
+
+    name = "base"
+
+    def __init__(self, logger: Optional[logging.Logger] = None):
+        self.logger = logger or logging.getLogger("nomad_tpu.fingerprint")
+
+    def fingerprint(self, config, node: Node) -> bool:
+        """Mutate the node; return True if this fingerprint applies."""
+        raise NotImplementedError
+
+    def periodic(self) -> Tuple[bool, float]:
+        return False, 0.0
+
+
+class ArchFingerprint(Fingerprint):
+    """fingerprint/arch.go"""
+
+    name = "arch"
+
+    def fingerprint(self, config, node: Node) -> bool:
+        node.attributes["arch"] = platform.machine()
+        return True
+
+
+class HostFingerprint(Fingerprint):
+    """OS/kernel/hostname (fingerprint/host.go)."""
+
+    name = "host"
+
+    def fingerprint(self, config, node: Node) -> bool:
+        node.attributes["os.name"] = platform.system().lower()
+        node.attributes["os.version"] = platform.release()
+        node.attributes["kernel.name"] = platform.system().lower()
+        node.attributes["kernel.version"] = platform.release()
+        node.attributes["hostname"] = socket.gethostname()
+        if not node.name:
+            node.name = node.attributes["hostname"]
+        return True
+
+
+class CPUFingerprint(Fingerprint):
+    """Cores x MHz -> Resources.cpu (fingerprint/cpu.go)."""
+
+    name = "cpu"
+
+    def fingerprint(self, config, node: Node) -> bool:
+        cores = os.cpu_count() or 1
+        mhz = self._cpu_mhz()
+        node.attributes["cpu.numcores"] = str(cores)
+        node.attributes["cpu.frequency"] = str(int(mhz))
+        total = int(cores * mhz)
+        node.attributes["cpu.totalcompute"] = str(total)
+        if node.resources is None:
+            node.resources = Resources()
+        node.resources.cpu = total
+        return True
+
+    @staticmethod
+    def _cpu_mhz() -> float:
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.lower().startswith("cpu mhz"):
+                        return float(line.split(":")[1])
+        except (OSError, ValueError, IndexError):
+            pass
+        return 1000.0
+
+
+class MemoryFingerprint(Fingerprint):
+    """fingerprint/memory.go"""
+
+    name = "memory"
+
+    def fingerprint(self, config, node: Node) -> bool:
+        total_mb = self._total_memory_mb()
+        node.attributes["memory.totalbytes"] = str(total_mb * 1024 * 1024)
+        if node.resources is None:
+            node.resources = Resources()
+        node.resources.memory_mb = total_mb
+        return True
+
+    @staticmethod
+    def _total_memory_mb() -> int:
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        return int(line.split()[1]) // 1024
+        except (OSError, ValueError, IndexError):
+            pass
+        return 1024
+
+
+class StorageFingerprint(Fingerprint):
+    """Disk capacity of the alloc dir volume (fingerprint/storage.go)."""
+
+    name = "storage"
+
+    def fingerprint(self, config, node: Node) -> bool:
+        path = getattr(config, "alloc_dir", "") or "/"
+        try:
+            usage = shutil.disk_usage(path)
+        except OSError:
+            return False
+        node.attributes["storage.volume"] = path
+        node.attributes["storage.bytestotal"] = str(usage.total)
+        node.attributes["storage.bytesfree"] = str(usage.free)
+        if node.resources is None:
+            node.resources = Resources()
+        node.resources.disk_mb = usage.free // (1024 * 1024)
+        return True
+
+
+class NetworkFingerprint(Fingerprint):
+    """Interface + IP + throughput (fingerprint/network_*.go). Speed
+    detection falls back to a default like the reference's non-Linux path."""
+
+    name = "network"
+
+    DEFAULT_MBITS = 1000
+
+    def fingerprint(self, config, node: Node) -> bool:
+        from nomad_tpu.structs import NetworkResource
+
+        ip = self._default_ip()
+        if ip is None:
+            return False
+        node.attributes["network.ip-address"] = ip
+        if node.resources is None:
+            node.resources = Resources()
+        if not node.resources.networks:
+            node.resources.networks = [
+                NetworkResource(
+                    device="eth0", ip=ip, cidr=f"{ip}/32",
+                    mbits=self.DEFAULT_MBITS,
+                )
+            ]
+        return True
+
+    @staticmethod
+    def _default_ip() -> Optional[str]:
+        try:
+            hostname = socket.gethostname()
+            ip = socket.gethostbyname(hostname)
+            return ip
+        except OSError:
+            return "127.0.0.1"
+
+
+BUILTIN_FINGERPRINTS: List[Callable[..., Fingerprint]] = [
+    ArchFingerprint,
+    HostFingerprint,
+    CPUFingerprint,
+    MemoryFingerprint,
+    StorageFingerprint,
+    NetworkFingerprint,
+]

@@ -8,12 +8,13 @@ storms (SURVEY.md §7 "Hard parts: dynamic shapes"):
   stack.go:131-159): each step recomputes fit + BestFit score + anti-affinity
   penalty against the utilization carried from earlier placements.
 
-- ``solve_round``: one fused dispatch that places up to r tasks in a single
-  round, one per node, ordered by score. In the anti-affinity regime (penalty
-  10/5 dominates the per-placement BestFit delta, stack.go:10-19) greedy
-  provably round-robins across fitting nodes, so repeated rounds reproduce
-  greedy's outcome at a fraction of the dispatches — this is what makes
-  100k-task evals a handful of device calls instead of 100k.
+- ``solve_rounds_fused``: every round places up to one task per node on the
+  best-scoring nodes, and all rounds run inside one lax.while_loop dispatch.
+  In the anti-affinity regime (penalty 10/5 dominates the per-placement
+  BestFit delta, stack.go:10-19) greedy provably round-robins across fitting
+  nodes, so the rounds reproduce greedy's outcome in a single device call +
+  a single transfer — this is what makes 100k-task evals ~100ms instead of
+  100k dispatches.
 
 The node axis is shardable: see nomad_tpu.parallel.mesh for the pjit
 wrapping used on multi-chip meshes.
@@ -107,46 +108,6 @@ def solve_greedy(
         step, (used0, job_count0, tg_count0, bw_used0), active
     )
     return idxs, oks, scores
-
-
-@partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
-def solve_round(
-    total: jnp.ndarray,
-    sched_cap: jnp.ndarray,
-    used0: jnp.ndarray,
-    job_count0: jnp.ndarray,
-    tg_count0: jnp.ndarray,
-    bw_avail: jnp.ndarray,
-    bw_used0: jnp.ndarray,
-    eligible: jnp.ndarray,
-    ask: jnp.ndarray,
-    bw_ask: jnp.ndarray,
-    remaining: jnp.ndarray,   # [] int32 tasks still to place
-    penalty: jnp.ndarray,
-    job_distinct: bool,
-    tg_distinct: bool,
-):
-    """One round: place min(remaining, #fitting-nodes) tasks, at most one per
-    node, on the best-scoring nodes. Returns (selected[N] bool, new state...).
-    """
-    score, fit = _greedy_step_state(
-        total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
-        eligible, ask, bw_ask, penalty, job_distinct, tg_distinct,
-    )
-    n = total.shape[0]
-    # Rank of each node among fitting nodes by descending score.
-    order = jnp.argsort(-score)  # best first; -inf (unfit) sink to the end
-    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(
-        jnp.arange(n, dtype=jnp.int32)
-    )
-    selected = fit & (rank < remaining)
-    n_placed = selected.sum()
-
-    used = used0 + selected[:, None] * ask[None, :]
-    job_count = job_count0 + selected
-    tg_count = tg_count0 + selected
-    bw_used = bw_used0 + selected * bw_ask
-    return selected, n_placed, used, job_count, tg_count, bw_used
 
 
 @partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))

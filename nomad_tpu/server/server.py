@@ -128,6 +128,11 @@ class Server:
                 name="periodic-gc",
             )
             t.start()
+        reaper = threading.Thread(
+            target=self._reap_failed_evaluations, daemon=True,
+            name="failed-eval-reaper",
+        )
+        reaper.start()
 
     def shutdown(self) -> None:
         self._periodic_stop.set()
@@ -159,6 +164,34 @@ class Server:
             if now - last_node_gc >= self.config.node_gc_interval:
                 self._dispatch_core_job(CORE_JOB_NODE_GC)
                 last_node_gc = now
+
+    def _reap_failed_evaluations(self) -> None:
+        """Drain the broker's _failed queue: mark the eval failed through the
+        log and ack it so the job's blocked evals unwedge
+        (reference: leader.go:202-238)."""
+        from nomad_tpu.server.eval_broker import FAILED_QUEUE, BrokerError
+
+        while not self._periodic_stop.is_set():
+            try:
+                ev, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout=0.5)
+            except BrokerError:
+                if self._periodic_stop.wait(0.2):
+                    return
+                continue
+            if ev is None:
+                continue
+            self.logger.warning("failed evaluation %s reached delivery limit, marking as failed", ev.id)
+            new_eval = ev.copy()
+            new_eval.status = structs.EVAL_STATUS_FAILED
+            new_eval.status_description = (
+                f"evaluation reached delivery limit "
+                f"({self.config.eval_delivery_limit})"
+            )
+            try:
+                self.raft.apply("eval_update", {"evals": [new_eval]}).result()
+                self.eval_broker.ack(ev.id, token)
+            except Exception:
+                self.logger.exception("failed to reap evaluation %s", ev.id)
 
     def _dispatch_core_job(self, job_id: str) -> None:
         ev = Evaluation(

@@ -1,0 +1,357 @@
+"""Client agent tests (reference: client/client_test.go, driver tests,
+restarts_test.go, client/util_test.go, spawn_test.go)."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.client import diff_allocs
+from nomad_tpu.client.driver import ExecContext, new_driver
+from nomad_tpu.client.driver import spawn
+from nomad_tpu.client.getter import ArtifactError, get_artifact
+from nomad_tpu.client.restarts import (
+    BatchRestartTracker,
+    ServiceRestartTracker,
+    new_restart_tracker,
+)
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import Allocation, Resources, RestartPolicy, Task
+
+
+# ---------------------------------------------------------------------------
+# Pieces
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_dir_build(tmp_path):
+    d = AllocDir(str(tmp_path / "alloc1"))
+    d.build(["web", "db"])
+    assert os.path.isdir(os.path.join(d.shared_dir, "logs"))
+    assert os.path.isdir(os.path.join(d.shared_dir, "tmp"))
+    assert os.path.isdir(os.path.join(d.shared_dir, "data"))
+    assert os.path.isdir(os.path.join(d.task_dirs["web"], "local"))
+    d.destroy()
+    assert not os.path.exists(d.alloc_dir)
+
+
+def test_client_diff_allocs():
+    """reference: client/util_test.go:33-80"""
+    a_keep = Allocation(id="keep", modify_index=5)
+    a_update = Allocation(id="upd", modify_index=9)
+    a_new = Allocation(id="new", modify_index=1)
+    existing = {"keep": 5, "upd": 5, "gone": 2}
+    added, removed, updates, ignore = diff_allocs(
+        existing, [a_keep, a_update, a_new]
+    )
+    assert [a.id for a in added] == ["new"]
+    assert removed == ["gone"]
+    assert [a.id for a in updates] == ["upd"]
+    assert ignore == ["keep"]
+
+
+def test_restart_trackers():
+    """reference: client/restarts_test.go"""
+    batch = BatchRestartTracker(RestartPolicy(attempts=2, interval=100, delay=0.1))
+    assert batch.next_restart() == (True, 0.1)
+    assert batch.next_restart() == (True, 0.1)
+    assert batch.next_restart() == (False, 0.0)
+
+    svc = ServiceRestartTracker(RestartPolicy(attempts=1, interval=100, delay=0.2))
+    ok, wait = svc.next_restart()
+    assert ok and wait == 0.2
+    ok, wait = svc.next_restart()
+    # Window exhausted: still restarts, but waits out the interval remainder
+    assert ok and wait > 0.2
+
+    assert isinstance(new_restart_tracker("service", RestartPolicy()),
+                      ServiceRestartTracker)
+    assert isinstance(new_restart_tracker("batch", RestartPolicy()),
+                      BatchRestartTracker)
+
+
+def test_getter(tmp_path):
+    src = tmp_path / "artifact.sh"
+    src.write_text("#!/bin/sh\necho hi\n")
+    dest_dir = tmp_path / "dest"
+    dest_dir.mkdir()
+    out = get_artifact(str(src), str(dest_dir))
+    assert os.path.exists(out)
+    assert os.access(out, os.X_OK)
+
+    import hashlib
+
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()
+    get_artifact(str(src), str(dest_dir), f"sha256:{digest}")
+    with pytest.raises(ArtifactError):
+        get_artifact(str(src), str(dest_dir), "sha256:" + "0" * 64)
+    with pytest.raises(ArtifactError):
+        get_artifact("ftp://nope/x", str(dest_dir))
+
+
+# ---------------------------------------------------------------------------
+# Spawn daemon + raw_exec driver (reference: spawn_test.go, raw_exec_test.go)
+# ---------------------------------------------------------------------------
+
+
+def _exec_ctx(tmp_path, tasks):
+    d = AllocDir(str(tmp_path / "alloc"))
+    d.build(tasks)
+    return ExecContext(d, structs.generate_uuid())
+
+
+def test_spawn_daemon_roundtrip(tmp_path):
+    prefix = str(tmp_path / "task")
+    out = str(tmp_path / "out.log")
+    err = str(tmp_path / "err.log")
+    pid = spawn.spawn_detached(
+        "/bin/sh", ["-c", "echo hello; exit 3"],
+        {"PATH": "/usr/bin:/bin"}, str(tmp_path), out, err, prefix,
+    )
+    assert pid > 0
+    code = spawn.wait(prefix, timeout=10.0)
+    assert code == 3
+    with open(out) as f:
+        assert f.read().strip() == "hello"
+
+
+def test_spawn_missing_binary(tmp_path):
+    prefix = str(tmp_path / "task")
+    spawn.spawn_detached(
+        "/no/such/bin", [], {}, str(tmp_path),
+        str(tmp_path / "o"), str(tmp_path / "e"), prefix,
+    )
+    assert spawn.wait(prefix, timeout=10.0) == 127
+
+
+def test_raw_exec_driver(tmp_path):
+    config = ClientConfig(options={"driver.raw_exec.enable": "1"})
+    node = mock.node()
+    from nomad_tpu.client.driver.raw_exec import RawExecDriver
+
+    assert RawExecDriver.fingerprint(config, node)
+    assert node.attributes["driver.raw_exec"] == "1"
+
+    ctx = _exec_ctx(tmp_path, ["echoer"])
+    driver = new_driver("raw_exec", ctx)
+    task = Task(
+        name="echoer", driver="raw_exec",
+        config={"command": "/bin/sh", "args": ["-c", "echo $NOMAD_ALLOC_ID"]},
+        resources=Resources(cpu=100, memory_mb=64),
+    )
+    handle = driver.start(task)
+    assert handle.wait(timeout=10.0) == 0
+
+    # stdout landed in the shared log dir
+    stdout = os.path.join(ctx.alloc_dir.log_dir(), "echoer.stdout")
+    with open(stdout) as f:
+        assert f.read().strip() == ctx.alloc_id
+
+    # Reattach via handle ID
+    reopened = driver.open(handle.id())
+    assert reopened.wait(timeout=1.0) == 0
+
+
+def test_raw_exec_kill(tmp_path):
+    ctx = _exec_ctx(tmp_path, ["sleeper"])
+    driver = new_driver("raw_exec", ctx)
+    task = Task(
+        name="sleeper", driver="raw_exec",
+        config={"command": "/bin/sleep", "args": ["300"]},
+        resources=Resources(cpu=100, memory_mb=64),
+    )
+    handle = driver.start(task)
+    assert handle.is_running()
+    handle.kill()
+    code = handle.wait(timeout=10.0)
+    assert code != 0
+    assert not handle.is_running()
+
+
+def test_exec_driver_fingerprint():
+    from nomad_tpu.client.driver.exec_driver import ExecDriver
+
+    node = mock.node()
+    node.attributes.clear()
+    config = ClientConfig()
+    assert ExecDriver.fingerprint(config, node)  # linux
+    assert node.attributes["driver.exec"] == "1"
+
+
+def test_mock_driver(tmp_path):
+    ctx = _exec_ctx(tmp_path, ["m"])
+    driver = new_driver("mock_driver", ctx)
+    task = Task(name="m", driver="mock_driver",
+                config={"run_for": 0.1, "exit_code": 0})
+    handle = driver.start(task)
+    assert handle.is_running()
+    assert handle.wait(timeout=5.0) == 0
+
+    failing = Task(name="m", driver="mock_driver",
+                   config={"run_for": 0.05, "exit_code": 2})
+    handle = driver.start(failing)
+    assert handle.wait(timeout=5.0) == 2
+
+
+# ---------------------------------------------------------------------------
+# Client <-> server integration (reference: client_test.go)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    srv = Server(ServerConfig(
+        scheduler_backend="host",
+        min_heartbeat_ttl=0.2,
+        max_heartbeats_per_second=1000.0,
+    ))
+    srv.start()
+    config = ClientConfig(
+        dev_mode=True,
+        state_dir=str(tmp_path / "state"),
+        alloc_dir=str(tmp_path / "allocs"),
+        datacenter="dc1",
+        node_name="test-client",
+        rpc_handler=srv,
+        options={"driver.raw_exec.enable": "1", "driver.mock_driver.enable": "1"},
+    )
+    client = Client(config)
+    client.start()
+    yield srv, client
+    client.shutdown(destroy_allocs=True)
+    srv.shutdown()
+
+
+def _wait_until(predicate, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_client_registers_and_heartbeats(cluster):
+    srv, client = cluster
+    assert _wait_until(
+        lambda: (
+            (n := srv.state_store.node_by_id(client.node.id)) is not None
+            and n.status == structs.NODE_STATUS_READY
+        )
+    )
+    node = srv.state_store.node_by_id(client.node.id)
+    # Fingerprints populated the node
+    assert node.resources.cpu > 0
+    assert node.resources.memory_mb > 0
+    assert node.attributes["kernel.name"] == "linux"
+    assert node.attributes["driver.raw_exec"] == "1"
+
+
+def test_client_runs_allocation_end_to_end(cluster):
+    """The full story: job register -> schedule -> client picks up the alloc
+    -> spawn daemon runs the process -> status syncs back -> batch task
+    completes -> alloc goes dead (SURVEY.md §3.3)."""
+    srv, client = cluster
+    assert _wait_until(
+        lambda: (
+            (n := srv.state_store.node_by_id(client.node.id)) is not None
+            and n.status == structs.NODE_STATUS_READY
+        )
+    )
+
+    job = mock.job()
+    job.type = structs.JOB_TYPE_BATCH
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].driver = "raw_exec"
+    tg.tasks[0].config = {"command": "/bin/sh", "args": ["-c", "echo done"]}
+    tg.tasks[0].resources = Resources(cpu=100, memory_mb=64)
+
+    eval_id, _ = srv.job_register(job)
+    srv.wait_for_eval(eval_id, timeout=15.0)
+
+    allocs = srv.state_store.allocs_by_job(job.id)
+    assert len(allocs) == 1
+    assert allocs[0].node_id == client.node.id
+
+    # Client runs it; batch task exits 0 -> alloc client status dead
+    assert _wait_until(
+        lambda: srv.state_store.allocs_by_job(job.id)[0].client_status
+        == structs.ALLOC_CLIENT_STATUS_DEAD,
+        timeout=20.0,
+    ), srv.state_store.allocs_by_job(job.id)[0]
+
+
+def test_client_stops_alloc_on_deregister(cluster):
+    srv, client = cluster
+    assert _wait_until(
+        lambda: (
+            (n := srv.state_store.node_by_id(client.node.id)) is not None
+            and n.status == structs.NODE_STATUS_READY
+        )
+    )
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].driver = "raw_exec"
+    tg.tasks[0].config = {"command": "/bin/sleep", "args": ["300"]}
+    tg.tasks[0].resources = Resources(cpu=100, memory_mb=64)
+
+    eval_id, _ = srv.job_register(job)
+    srv.wait_for_eval(eval_id, timeout=15.0)
+    assert _wait_until(lambda: client.num_allocs() == 1, timeout=20.0)
+    assert _wait_until(
+        lambda: srv.state_store.allocs_by_job(job.id)[0].client_status
+        == structs.ALLOC_CLIENT_STATUS_RUNNING,
+        timeout=20.0,
+    )
+
+    eval_id2, _ = srv.job_deregister(job.id)
+    srv.wait_for_eval(eval_id2, timeout=15.0)
+
+    # The stop flows to the client, which kills the task
+    def stopped():
+        runners = list(client.alloc_runners.values())
+        return runners and not runners[0].alive()
+
+    assert _wait_until(stopped, timeout=20.0)
+
+
+def test_task_restart_policy(cluster, tmp_path):
+    """Failing batch task restarts up to the policy's attempts then fails."""
+    srv, client = cluster
+    assert _wait_until(
+        lambda: (
+            (n := srv.state_store.node_by_id(client.node.id)) is not None
+            and n.status == structs.NODE_STATUS_READY
+        )
+    )
+
+    counter = tmp_path / "attempts"
+    job = mock.job()
+    job.type = structs.JOB_TYPE_BATCH
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.restart_policy = RestartPolicy(attempts=2, interval=300.0, delay=0.05)
+    tg.tasks[0].driver = "raw_exec"
+    tg.tasks[0].config = {
+        "command": "/bin/sh",
+        "args": ["-c", f"echo x >> {counter}; exit 1"],
+    }
+    tg.tasks[0].resources = Resources(cpu=100, memory_mb=64)
+
+    eval_id, _ = srv.job_register(job)
+    srv.wait_for_eval(eval_id, timeout=15.0)
+
+    assert _wait_until(
+        lambda: srv.state_store.allocs_by_job(job.id)
+        and srv.state_store.allocs_by_job(job.id)[0].client_status
+        == structs.ALLOC_CLIENT_STATUS_FAILED,
+        timeout=30.0,
+    )
+    # 1 initial run + 2 restarts
+    assert counter.read_text().count("x") == 3

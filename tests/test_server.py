@@ -403,3 +403,36 @@ def test_fsm_snapshot_restore_roundtrip():
         assert fsm2.state.get_index("allocs") == srv.state_store.get_index("allocs")
     finally:
         srv.shutdown()
+
+
+def test_failed_eval_reaped_and_job_unwedged():
+    """An eval that exhausts its delivery limit lands in _failed, is marked
+    failed by the reaper (leader.go:202-238), and does not wedge later evals
+    for the same job."""
+    cfg = ServerConfig(eval_delivery_limit=1, eval_nack_timeout=60.0)
+    cfg.enabled_schedulers = cfg.enabled_schedulers + ["explode"]
+    srv = Server(cfg)
+    srv.start()
+    try:
+        job_id = generate_uuid()
+        bad = Evaluation(
+            id=generate_uuid(), priority=50, type="explode",
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job_id, status=structs.EVAL_STATUS_PENDING,
+        )
+        srv.raft.apply("eval_update", {"evals": [bad]}).result()
+
+        ev = srv.wait_for_eval(bad.id, timeout=10.0)
+        assert ev.status == structs.EVAL_STATUS_FAILED
+        assert "delivery limit" in ev.status_description
+
+        # The job must not be wedged: a good eval for the same job completes
+        srv.node_register(mock.node())
+        job = mock.job()
+        job.id = job_id
+        job.task_groups[0].count = 1
+        eval_id, _ = srv.job_register(job)
+        good = srv.wait_for_eval(eval_id, timeout=10.0)
+        assert good.status == structs.EVAL_STATUS_COMPLETE
+    finally:
+        srv.shutdown()
