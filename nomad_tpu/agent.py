@@ -1,0 +1,173 @@
+"""Agent: runs a server and/or client in one process, fronted by HTTP.
+
+Reference: /root/reference/command/agent/agent.go — builds server/client
+configs from agent config, embeds both, and routes RPC to whichever is
+in-process (agent.go:37-151, 273-279).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+
+
+@dataclass
+class AgentConfig:
+    """Agent-level configuration (reference: command/agent/config.go)."""
+
+    region: str = "global"
+    datacenter: str = "dc1"
+    node_name: str = ""
+    data_dir: str = ""
+    log_level: str = "INFO"
+    http_host: str = "127.0.0.1"
+    http_port: int = 4646
+    server_enabled: bool = False
+    client_enabled: bool = False
+    dev_mode: bool = False
+    scheduler_backend: str = "tpu"
+    client_options: Dict[str, str] = field(default_factory=dict)
+    node_class: str = ""
+    node_meta: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def dev(cls) -> "AgentConfig":
+        """Dev mode: server + client in one process (command.go DevConfig)."""
+        return cls(
+            server_enabled=True,
+            client_enabled=True,
+            dev_mode=True,
+            node_name="dev-node",
+            client_options={
+                "driver.raw_exec.enable": "1",
+                "driver.mock_driver.enable": "1",
+            },
+        )
+
+
+class Agent:
+    def __init__(self, config: AgentConfig,
+                 logger: Optional[logging.Logger] = None):
+        self.config = config
+        self.logger = logger or logging.getLogger("nomad_tpu.agent")
+        self.server: Optional[Server] = None
+        self.client: Optional[Client] = None
+        self.http: Optional[object] = None
+
+        if config.server_enabled:
+            self._setup_server()
+        if config.client_enabled:
+            self._setup_client()
+        if self.server is None and self.client is None:
+            raise ValueError("must have at least client or server mode enabled")
+
+    def _setup_server(self) -> None:
+        """agent.go:153-173"""
+        self.server = Server(
+            ServerConfig(
+                region=self.config.region,
+                datacenter=self.config.datacenter,
+                node_name=self.config.node_name or "server",
+                scheduler_backend=self.config.scheduler_backend,
+            ),
+            logger=self.logger.getChild("server"),
+        )
+
+    def _setup_client(self) -> None:
+        """agent.go:175-201"""
+        if self.server is None:
+            raise ValueError(
+                "client mode requires a server in-process until the network "
+                "RPC layer lands"
+            )
+        data_dir = self.config.data_dir or "/tmp/nomad-tpu-agent"
+        self.client_config = ClientConfig(
+            dev_mode=self.config.dev_mode,
+            state_dir=os.path.join(data_dir, "client"),
+            alloc_dir=os.path.join(data_dir, "allocs"),
+            region=self.config.region,
+            datacenter=self.config.datacenter,
+            node_name=self.config.node_name,
+            node_class=self.config.node_class,
+            node_meta=dict(self.config.node_meta),
+            options=dict(self.config.client_options),
+            rpc_handler=self.server,
+        )
+
+    def start(self) -> None:
+        from nomad_tpu.api.http import HTTPServer
+
+        if self.server is not None:
+            self.server.start()
+        if self.config.client_enabled:
+            self.client = Client(self.client_config,
+                                 self.logger.getChild("client"))
+            self.client.start()
+        self.http = HTTPServer(
+            self, self.config.http_host, self.config.http_port,
+            self.logger.getChild("http"),
+        )
+        self.http.start()
+
+    def shutdown(self) -> None:
+        if self.http is not None:
+            self.http.shutdown()
+        if self.client is not None:
+            self.client.shutdown(destroy_allocs=self.config.dev_mode)
+        if self.server is not None:
+            self.server.shutdown()
+
+    # -- info for the agent HTTP endpoints -----------------------------------
+
+    def self_info(self) -> Dict:
+        info: Dict = {
+            "config": {
+                "region": self.config.region,
+                "datacenter": self.config.datacenter,
+                "node_name": self.config.node_name,
+                "server_enabled": self.config.server_enabled,
+                "client_enabled": self.config.client_enabled,
+                "dev_mode": self.config.dev_mode,
+                "scheduler_backend": self.config.scheduler_backend,
+            },
+            "stats": {},
+        }
+        if self.server is not None:
+            info["stats"]["server"] = self.server.stats()
+            info["stats"]["leader"] = True
+        if self.client is not None:
+            info["stats"]["client"] = self.client.stats()
+        return info
+
+    def members(self) -> List[Dict]:
+        if self.server is None:
+            return []
+        return [
+            {
+                "name": self.server.config.node_name,
+                "addr": self.http.addr if self.http else "",
+                "status": "alive",
+                "leader": True,
+            }
+        ]
+
+    def server_addrs(self) -> List[str]:
+        return [self.http.addr] if self.http and self.server else []
+
+    def leader_addr(self) -> str:
+        return self.http.addr if self.http and self.server else ""
+
+    def peer_addrs(self) -> List[str]:
+        return self.server_addrs()
+
+    def join(self, addr: str) -> int:
+        self.logger.warning("agent join is a no-op in single-process mode")
+        return 0
+
+    def force_leave(self, node: str) -> None:
+        self.logger.warning("agent force-leave is a no-op in single-process mode")

@@ -1,0 +1,10 @@
+"""HTTP API: server endpoints + Python client SDK.
+
+Reference: /root/reference/command/agent/http.go (routes + blocking-query
+plumbing) and /root/reference/api/ (the client SDK with QueryOptions /
+QueryMeta / blocking-query semantics).
+"""
+
+from nomad_tpu.api.client import ApiClient, ApiError, QueryMeta, QueryOptions
+
+__all__ = ["ApiClient", "ApiError", "QueryMeta", "QueryOptions"]

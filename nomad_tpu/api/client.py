@@ -1,0 +1,266 @@
+"""Python client SDK for the HTTP API.
+
+Reference: /root/reference/api/ — ``api.Client`` with query/write/delete
+plus QueryOptions/QueryMeta mirroring server semantics including blocking
+queries (api.go:243-334), and typed sub-clients Jobs/Nodes/Evaluations/
+Allocations/Agent/Status (jobs.go, nodes.go, evals.go, allocations.go,
+agent.go, status.go).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from nomad_tpu.api.codec import from_dict, to_dict
+from nomad_tpu.structs import Allocation, Evaluation, Job, Node
+
+DEFAULT_ADDRESS = "http://127.0.0.1:4646"
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"unexpected response code {code}: {message}")
+        self.code = code
+
+
+@dataclass
+class QueryOptions:
+    """api.go:105-137"""
+
+    region: str = ""
+    allow_stale: bool = False
+    wait_index: int = 0
+    wait_time: str = ""
+
+
+@dataclass
+class QueryMeta:
+    """api.go:139-155"""
+
+    last_index: int = 0
+    last_contact: float = 0.0
+    known_leader: bool = False
+
+
+class ApiClient:
+    """api.go:157-241"""
+
+    def __init__(self, address: str = DEFAULT_ADDRESS, region: str = ""):
+        self.address = address.rstrip("/")
+        self.region = region
+
+    # -- raw verbs (api.go:243-376) -----------------------------------------
+
+    def _url(self, path: str, q: Optional[QueryOptions], params: Dict) -> str:
+        query = dict(params)
+        if q is not None:
+            if q.wait_index:
+                query["index"] = str(q.wait_index)
+            if q.wait_time:
+                query["wait"] = q.wait_time
+            if q.allow_stale:
+                query["stale"] = "1"
+            if q.region:
+                query["region"] = q.region
+        qs = urllib.parse.urlencode(query)
+        return f"{self.address}{path}" + (f"?{qs}" if qs else "")
+
+    def _do(self, method: str, path: str, body: Any = None,
+            q: Optional[QueryOptions] = None,
+            params: Optional[Dict] = None) -> Tuple[Any, QueryMeta]:
+        url = self._url(path, q, params or {})
+        data = json.dumps(to_dict(body)).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=330) as resp:
+                meta = QueryMeta(
+                    last_index=int(resp.headers.get("X-Nomad-Index", 0)),
+                    last_contact=float(
+                        resp.headers.get("X-Nomad-LastContact", 0)
+                    ),
+                    known_leader=resp.headers.get("X-Nomad-KnownLeader")
+                    == "true",
+                )
+                payload = resp.read()
+                return (json.loads(payload) if payload else None), meta
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+        except urllib.error.URLError as e:
+            raise ApiError(
+                0, f"failed to reach agent at {self.address}: {e.reason}"
+            ) from e
+
+    def query(self, path: str, q: Optional[QueryOptions] = None,
+              params: Optional[Dict] = None) -> Tuple[Any, QueryMeta]:
+        return self._do("GET", path, q=q, params=params)
+
+    def write(self, path: str, body: Any = None,
+              params: Optional[Dict] = None) -> Tuple[Any, QueryMeta]:
+        return self._do("PUT", path, body=body, params=params)
+
+    def delete(self, path: str) -> Tuple[Any, QueryMeta]:
+        return self._do("DELETE", path)
+
+    # -- typed sub-clients ---------------------------------------------------
+
+    def jobs(self) -> "Jobs":
+        return Jobs(self)
+
+    def nodes(self) -> "Nodes":
+        return Nodes(self)
+
+    def evaluations(self) -> "Evaluations":
+        return Evaluations(self)
+
+    def allocations(self) -> "Allocations":
+        return Allocations(self)
+
+    def agent(self) -> "AgentApi":
+        return AgentApi(self)
+
+    def status(self) -> "Status":
+        return Status(self)
+
+
+class Jobs:
+    """api/jobs.go"""
+
+    def __init__(self, client: ApiClient):
+        self.client = client
+
+    def register(self, job: Job) -> Tuple[str, QueryMeta]:
+        out, meta = self.client.write("/v1/jobs", body={"job": job})
+        return out["eval_id"], meta
+
+    def list(self, q: Optional[QueryOptions] = None) -> Tuple[List[Dict], QueryMeta]:
+        return self.client.query("/v1/jobs", q=q)
+
+    def info(self, job_id: str,
+             q: Optional[QueryOptions] = None) -> Tuple[Job, QueryMeta]:
+        out, meta = self.client.query(f"/v1/job/{job_id}", q=q)
+        return from_dict(Job, out), meta
+
+    def allocations(self, job_id: str,
+                    q: Optional[QueryOptions] = None) -> Tuple[List[Dict], QueryMeta]:
+        return self.client.query(f"/v1/job/{job_id}/allocations", q=q)
+
+    def evaluations(self, job_id: str,
+                    q: Optional[QueryOptions] = None) -> Tuple[List[Evaluation], QueryMeta]:
+        out, meta = self.client.query(f"/v1/job/{job_id}/evaluations", q=q)
+        return [from_dict(Evaluation, e) for e in out], meta
+
+    def evaluate(self, job_id: str) -> Tuple[str, QueryMeta]:
+        out, meta = self.client.write(f"/v1/job/{job_id}/evaluate")
+        return out["eval_id"], meta
+
+    def deregister(self, job_id: str) -> Tuple[str, QueryMeta]:
+        out, meta = self.client.delete(f"/v1/job/{job_id}")
+        return out["eval_id"], meta
+
+
+class Nodes:
+    """api/nodes.go"""
+
+    def __init__(self, client: ApiClient):
+        self.client = client
+
+    def list(self, q: Optional[QueryOptions] = None) -> Tuple[List[Dict], QueryMeta]:
+        return self.client.query("/v1/nodes", q=q)
+
+    def info(self, node_id: str,
+             q: Optional[QueryOptions] = None) -> Tuple[Node, QueryMeta]:
+        out, meta = self.client.query(f"/v1/node/{node_id}", q=q)
+        return from_dict(Node, out), meta
+
+    def allocations(self, node_id: str,
+                    q: Optional[QueryOptions] = None) -> Tuple[List[Allocation], QueryMeta]:
+        out, meta = self.client.query(f"/v1/node/{node_id}/allocations", q=q)
+        return [from_dict(Allocation, a) for a in out], meta
+
+    def toggle_drain(self, node_id: str, drain: bool) -> Tuple[Dict, QueryMeta]:
+        return self.client.write(
+            f"/v1/node/{node_id}/drain",
+            params={"enable": "true" if drain else "false"},
+        )
+
+    def force_evaluate(self, node_id: str) -> Tuple[Dict, QueryMeta]:
+        return self.client.write(f"/v1/node/{node_id}/evaluate")
+
+
+class Evaluations:
+    """api/evaluations.go"""
+
+    def __init__(self, client: ApiClient):
+        self.client = client
+
+    def list(self, q: Optional[QueryOptions] = None) -> Tuple[List[Evaluation], QueryMeta]:
+        out, meta = self.client.query("/v1/evaluations", q=q)
+        return [from_dict(Evaluation, e) for e in out], meta
+
+    def info(self, eval_id: str,
+             q: Optional[QueryOptions] = None) -> Tuple[Evaluation, QueryMeta]:
+        out, meta = self.client.query(f"/v1/evaluation/{eval_id}", q=q)
+        return from_dict(Evaluation, out), meta
+
+    def allocations(self, eval_id: str,
+                    q: Optional[QueryOptions] = None) -> Tuple[List[Dict], QueryMeta]:
+        return self.client.query(f"/v1/evaluation/{eval_id}/allocations", q=q)
+
+
+class Allocations:
+    """api/allocations.go"""
+
+    def __init__(self, client: ApiClient):
+        self.client = client
+
+    def list(self, q: Optional[QueryOptions] = None) -> Tuple[List[Dict], QueryMeta]:
+        return self.client.query("/v1/allocations", q=q)
+
+    def info(self, alloc_id: str,
+             q: Optional[QueryOptions] = None) -> Tuple[Allocation, QueryMeta]:
+        out, meta = self.client.query(f"/v1/allocation/{alloc_id}", q=q)
+        return from_dict(Allocation, out), meta
+
+
+class AgentApi:
+    """api/agent.go"""
+
+    def __init__(self, client: ApiClient):
+        self.client = client
+
+    def self_info(self) -> Dict:
+        out, _ = self.client.query("/v1/agent/self")
+        return out
+
+    def members(self) -> List[Dict]:
+        out, _ = self.client.query("/v1/agent/members")
+        return out
+
+    def join(self, addr: str) -> int:
+        out, _ = self.client.write("/v1/agent/join", params={"address": addr})
+        return out["num_joined"]
+
+    def force_leave(self, node: str) -> None:
+        self.client.write("/v1/agent/force-leave", params={"node": node})
+
+
+class Status:
+    """api/status.go"""
+
+    def __init__(self, client: ApiClient):
+        self.client = client
+
+    def leader(self) -> str:
+        out, _ = self.client.query("/v1/status/leader")
+        return out
+
+    def peers(self) -> List[str]:
+        out, _ = self.client.query("/v1/status/peers")
+        return out

@@ -1,0 +1,341 @@
+"""HTTP API server.
+
+Reference: /root/reference/command/agent/http.go — route table at :93-120,
+the ``wrap`` JSON/error envelope at :147-226, blocking-query parameter
+parsing (``index``/``wait``) at :228-250, and the X-Nomad-Index /
+X-Nomad-KnownLeader / X-Nomad-LastContact response headers. Endpoint
+behaviors mirror command/agent/{job,node,eval,alloc,agent}_endpoint.go.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from nomad_tpu.api.codec import from_dict, to_dict
+from nomad_tpu.jobspec import parse_duration
+from nomad_tpu.state.store import (
+    item_table,
+)
+from nomad_tpu.structs import Job, ValidationError
+
+
+class HTTPCodedError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class HTTPServer:
+    """The agent's HTTP interface (http.go:25-120)."""
+
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 4646,
+                 logger: Optional[logging.Logger] = None):
+        self.agent = agent
+        self.logger = logger or logging.getLogger("nomad_tpu.http")
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                api.logger.debug("http: " + fmt, *args)
+
+            def _handle(self):
+                api.dispatch(self)
+
+            do_GET = do_PUT = do_POST = do_DELETE = _handle
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self.addr = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="http-server"
+        )
+
+        # Route table (http.go:93-120)
+        self.routes = [
+            (r"^/v1/jobs$", self.jobs_request),
+            (r"^/v1/job/(?P<job_id>[^/]+)$", self.job_request),
+            (r"^/v1/job/(?P<job_id>[^/]+)/allocations$", self.job_allocations),
+            (r"^/v1/job/(?P<job_id>[^/]+)/evaluations$", self.job_evaluations),
+            (r"^/v1/job/(?P<job_id>[^/]+)/evaluate$", self.job_evaluate),
+            (r"^/v1/nodes$", self.nodes_request),
+            (r"^/v1/node/(?P<node_id>[^/]+)$", self.node_request),
+            (r"^/v1/node/(?P<node_id>[^/]+)/allocations$", self.node_allocations),
+            (r"^/v1/node/(?P<node_id>[^/]+)/evaluate$", self.node_evaluate),
+            (r"^/v1/node/(?P<node_id>[^/]+)/drain$", self.node_drain),
+            (r"^/v1/allocations$", self.allocs_request),
+            (r"^/v1/allocation/(?P<alloc_id>[^/]+)$", self.alloc_request),
+            (r"^/v1/evaluations$", self.evals_request),
+            (r"^/v1/evaluation/(?P<eval_id>[^/]+)$", self.eval_request),
+            (r"^/v1/evaluation/(?P<eval_id>[^/]+)/allocations$",
+             self.eval_allocations),
+            (r"^/v1/agent/self$", self.agent_self),
+            (r"^/v1/agent/members$", self.agent_members),
+            (r"^/v1/agent/servers$", self.agent_servers),
+            (r"^/v1/agent/join$", self.agent_join),
+            (r"^/v1/agent/force-leave$", self.agent_force_leave),
+            (r"^/v1/status/leader$", self.status_leader),
+            (r"^/v1/status/peers$", self.status_peers),
+        ]
+        self.routes = [(re.compile(p), h) for p, h in self.routes]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- dispatch + envelope (http.go:147-226 wrap) --------------------------
+
+    def dispatch(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        for pattern, handler in self.routes:
+            m = pattern.match(parsed.path)
+            if m is None:
+                continue
+            try:
+                out, index = handler(req, query, **m.groupdict())
+            except HTTPCodedError as e:
+                self._respond_error(req, e.code, str(e))
+            except KeyError as e:
+                # Endpoints raise KeyError for missing resources
+                self._respond_error(req, 404, str(e).strip("'\""))
+            except (ValidationError, ValueError) as e:
+                self._respond_error(req, 400, str(e))
+            except Exception as e:
+                self.logger.exception("http: request failed")
+                self._respond_error(req, 500, str(e))
+            else:
+                self._respond_json(req, out, index)
+            return
+        self._respond_error(req, 404, "not found")
+
+    def _respond_json(self, req, out: Any, index: Optional[int]) -> None:
+        body = json.dumps(to_dict(out)).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        if index is not None:
+            # Query meta headers (http.go setMeta)
+            req.send_header("X-Nomad-Index", str(index))
+            req.send_header("X-Nomad-KnownLeader", "true")
+            req.send_header("X-Nomad-LastContact", "0")
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _respond_error(self, req, code: int, message: str) -> None:
+        body = message.encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "text/plain")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _read_body(self, req) -> Dict:
+        length = int(req.headers.get("Content-Length", 0))
+        if length == 0:
+            return {}
+        try:
+            return json.loads(req.rfile.read(length))
+        except ValueError as e:
+            raise HTTPCodedError(400, f"invalid JSON body: {e}")
+
+    # -- blocking queries (http.go:228-250 parseWait + blockingRPC) ----------
+
+    def _maybe_block(self, query: Dict[str, str], table: str) -> None:
+        """Implements ?index=N&wait=D against the state watch: return when
+        the table index passes N or the wait expires."""
+        min_index = int(query.get("index", 0))
+        if min_index == 0:
+            return
+        wait = parse_duration(query.get("wait", "5m"))
+        store = self.agent.server.state_store
+        deadline = threading.Event()
+        store.watch.watch([item_table(table)], deadline)
+        try:
+            import time as _time
+
+            end = _time.monotonic() + wait
+            while store.get_index(table) <= min_index:
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    return
+                deadline.wait(min(remaining, 0.5))
+                deadline.clear()
+        finally:
+            store.watch.stop_watch([item_table(table)], deadline)
+
+    def _srv(self):
+        if self.agent.server is None:
+            raise HTTPCodedError(500, "no server running")
+        return self.agent.server
+
+    @staticmethod
+    def _require_write(req) -> None:
+        if req.command not in ("PUT", "POST"):
+            raise HTTPCodedError(405, "method not allowed")
+
+    # -- job endpoints (command/agent/job_endpoint.go) -----------------------
+
+    def jobs_request(self, req, query) -> Tuple[Any, int]:
+        srv = self._srv()
+        if req.command == "GET":
+            self._maybe_block(query, "jobs")
+            jobs = sorted(srv.state_store.jobs(), key=lambda j: j.id)
+            return [j.stub() for j in jobs], srv.state_store.get_index("jobs")
+        if req.command in ("PUT", "POST"):
+            payload = self._read_body(req)
+            job = from_dict(Job, payload.get("job", payload))
+            eval_id, index = srv.job_register(job)
+            return {"eval_id": eval_id, "eval_create_index": index,
+                    "job_modify_index": index, "index": index}, index
+        raise HTTPCodedError(405, "method not allowed")
+
+    def job_request(self, req, query, job_id: str) -> Tuple[Any, int]:
+        srv = self._srv()
+        if req.command == "GET":
+            self._maybe_block(query, "jobs")
+            job = srv.state_store.job_by_id(job_id)
+            if job is None:
+                raise HTTPCodedError(404, "job not found")
+            return job, srv.state_store.get_index("jobs")
+        if req.command in ("PUT", "POST"):
+            payload = self._read_body(req)
+            job = from_dict(Job, payload.get("job", payload))
+            if job.id != job_id:
+                raise HTTPCodedError(400, "job ID does not match request path")
+            eval_id, index = srv.job_register(job)
+            return {"eval_id": eval_id, "index": index}, index
+        if req.command == "DELETE":
+            eval_id, index = srv.job_deregister(job_id)
+            return {"eval_id": eval_id, "index": index}, index
+        raise HTTPCodedError(405, "method not allowed")
+
+    def job_allocations(self, req, query, job_id: str) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "allocs")
+        allocs = srv.state_store.allocs_by_job(job_id)
+        return [a.stub() for a in allocs], srv.state_store.get_index("allocs")
+
+    def job_evaluations(self, req, query, job_id: str) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "evals")
+        return (
+            srv.state_store.evals_by_job(job_id),
+            srv.state_store.get_index("evals"),
+        )
+
+    def job_evaluate(self, req, query, job_id: str) -> Tuple[Any, int]:
+        self._require_write(req)
+        srv = self._srv()
+        eval_id, index = srv.job_evaluate(job_id)
+        return {"eval_id": eval_id, "index": index}, index
+
+    # -- node endpoints ------------------------------------------------------
+
+    def nodes_request(self, req, query) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "nodes")
+        nodes = sorted(srv.state_store.nodes(), key=lambda n: n.id)
+        return [n.stub() for n in nodes], srv.state_store.get_index("nodes")
+
+    def node_request(self, req, query, node_id: str) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "nodes")
+        node = srv.state_store.node_by_id(node_id)
+        if node is None:
+            raise HTTPCodedError(404, "node not found")
+        return node, srv.state_store.get_index("nodes")
+
+    def node_allocations(self, req, query, node_id: str) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "allocs")
+        allocs = srv.state_store.allocs_by_node(node_id)
+        return allocs, srv.state_store.get_index("allocs")
+
+    def node_evaluate(self, req, query, node_id: str) -> Tuple[Any, int]:
+        self._require_write(req)
+        srv = self._srv()
+        reply = srv.node_evaluate(node_id)
+        return reply, reply.get("index", 0)
+
+    def node_drain(self, req, query, node_id: str) -> Tuple[Any, int]:
+        self._require_write(req)
+        srv = self._srv()
+        enable = query.get("enable", "").lower() in ("1", "true")
+        if "enable" not in query:
+            raise HTTPCodedError(400, "missing drain mode")
+        reply = srv.node_update_drain(node_id, enable)
+        return reply, reply.get("index", 0)
+
+    # -- alloc + eval endpoints ----------------------------------------------
+
+    def allocs_request(self, req, query) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "allocs")
+        allocs = sorted(srv.state_store.allocs(), key=lambda a: a.id)
+        return [a.stub() for a in allocs], srv.state_store.get_index("allocs")
+
+    def alloc_request(self, req, query, alloc_id: str) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "allocs")
+        alloc = srv.state_store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise HTTPCodedError(404, "alloc not found")
+        return alloc, srv.state_store.get_index("allocs")
+
+    def evals_request(self, req, query) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "evals")
+        evals = sorted(srv.state_store.evals(), key=lambda e: e.id)
+        return evals, srv.state_store.get_index("evals")
+
+    def eval_request(self, req, query, eval_id: str) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "evals")
+        ev = srv.state_store.eval_by_id(eval_id)
+        if ev is None:
+            raise HTTPCodedError(404, "eval not found")
+        return ev, srv.state_store.get_index("evals")
+
+    def eval_allocations(self, req, query, eval_id: str) -> Tuple[Any, int]:
+        srv = self._srv()
+        self._maybe_block(query, "allocs")
+        allocs = srv.state_store.allocs_by_eval(eval_id)
+        return [a.stub() for a in allocs], srv.state_store.get_index("allocs")
+
+    # -- agent + status endpoints --------------------------------------------
+
+    def agent_self(self, req, query) -> Tuple[Any, Optional[int]]:
+        return self.agent.self_info(), None
+
+    def agent_members(self, req, query) -> Tuple[Any, Optional[int]]:
+        return self.agent.members(), None
+
+    def agent_servers(self, req, query) -> Tuple[Any, Optional[int]]:
+        return self.agent.server_addrs(), None
+
+    def agent_join(self, req, query) -> Tuple[Any, Optional[int]]:
+        self._require_write(req)
+        addr = query.get("address", "")
+        return {"num_joined": self.agent.join(addr), "error": ""}, None
+
+    def agent_force_leave(self, req, query) -> Tuple[Any, Optional[int]]:
+        self._require_write(req)
+        self.agent.force_leave(query.get("node", ""))
+        return {}, None
+
+    def status_leader(self, req, query) -> Tuple[Any, Optional[int]]:
+        return self.agent.leader_addr(), None
+
+    def status_peers(self, req, query) -> Tuple[Any, Optional[int]]:
+        return self.agent.peer_addrs(), None

@@ -51,6 +51,20 @@ class AllocRunner:
             return None
         return self.alloc.job.lookup_task_group(self.alloc.task_group)
 
+    def _merged_task(self, task):
+        """The runnable task: the job's spec with resources replaced by the
+        allocation's offered TaskResources (assigned IPs/ports) — reference
+        alloc_runner.go merges alloc.TaskResources into the task before
+        handing it to the TaskRunner."""
+        offered = self.alloc.task_resources.get(task.name)
+        if offered is None:
+            return task
+        import copy as _copy
+
+        merged = _copy.copy(task)
+        merged.resources = offered
+        return merged
+
     # -- lifecycle (alloc_runner.go Run) ------------------------------------
 
     def run(self) -> None:
@@ -69,7 +83,7 @@ class AllocRunner:
             runner = TaskRunner(
                 self.ctx,
                 self.alloc.id,
-                task,
+                self._merged_task(task),
                 self.alloc.job.type,
                 tg.restart_policy,
                 self._on_task_status,
@@ -88,7 +102,8 @@ class AllocRunner:
         self.alloc_dir.build([t.name for t in tg.tasks])
         for task in tg.tasks:
             runner = TaskRunner(
-                self.ctx, self.alloc.id, task, self.alloc.job.type,
+                self.ctx, self.alloc.id, self._merged_task(task),
+                self.alloc.job.type,
                 tg.restart_policy, self._on_task_status, self.logger,
             )
             task_state = state.get("tasks", {}).get(task.name)
@@ -159,7 +174,7 @@ class AllocRunner:
             for task in tg.tasks:
                 runner = self.task_runners.get(task.name)
                 if runner is not None:
-                    runner.update(task)
+                    runner.update(self._merged_task(task))
 
     def destroy_tasks(self) -> None:
         for runner in self.task_runners.values():

@@ -83,6 +83,7 @@ def task_environment(ctx: ExecContext, task: Task) -> Dict[str, str]:
             net = task.resources.networks[0]
             if net.ip:
                 env["NOMAD_IP"] = net.ip
+            # map_dynamic_ports returns {} on a raw (unoffered) ask.
             for label, port in net.map_dynamic_ports().items():
                 env[f"NOMAD_PORT_{label}"] = str(port)
     for key, value in task.meta.items():

@@ -196,6 +196,7 @@ def alloc() -> Allocation:
                         reserved_ports=[5000],
                         mbits=50,
                         dynamic_ports=["http"],
+                        offered=True,
                     )
                 ],
             )

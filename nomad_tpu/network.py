@@ -115,6 +115,7 @@ class NetworkIndex:
                 mbits=ask.mbits,
                 reserved_ports=list(ask.reserved_ports),
                 dynamic_ports=list(ask.dynamic_ports),
+                offered=True,
             )
 
             for _ in range(len(ask.dynamic_ports)):

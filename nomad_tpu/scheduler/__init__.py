@@ -82,11 +82,34 @@ def _register_builtins() -> None:
     register("system", new_system_scheduler)
 
     # The TPU factories live behind a lazy import so the control plane can
-    # run host-only (e.g. on machines without jax).
+    # run host-only (e.g. on machines without jax). If the device backend
+    # cannot initialize at all, fall back to the host solver instead of
+    # failing every evaluation — same placements, scalar speed.
+    _device_probe: Dict[str, bool] = {}
+
+    def _tpu_solver(logger):
+        """Import + probe once; None if the device path cannot come up."""
+        if "solver" not in _device_probe:
+            try:
+                import jax
+
+                jax.devices()
+                from nomad_tpu.tpu import solver
+
+                _device_probe["solver"] = solver
+            except Exception as e:
+                logger.warning(
+                    "jax device backend unavailable (%s); "
+                    "TPU factories fall back to the host scheduler", e,
+                )
+                _device_probe["solver"] = None
+        return _device_probe["solver"]
+
     def _lazy_tpu(variant: str) -> Factory:
         def factory(state, planner, logger):
-            from nomad_tpu.tpu import solver
-
+            solver = _tpu_solver(logger)
+            if solver is None:
+                return BUILTIN_SCHEDULERS[variant](state, planner, logger)
             return solver.new_tpu_scheduler(variant, state, planner, logger)
 
         return factory

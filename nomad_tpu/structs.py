@@ -118,6 +118,9 @@ class NetworkResource:
     mbits: int = 0
     reserved_ports: List[int] = field(default_factory=list)
     dynamic_ports: List[str] = field(default_factory=list)
+    # True once this is an *offer* with assigned dynamic ports appended to
+    # reserved_ports (set by NetworkIndex.assign_network); raw asks are False.
+    offered: bool = False
 
     def copy(self) -> "NetworkResource":
         new = _copy.copy(self)
@@ -133,7 +136,10 @@ class NetworkResource:
 
     def map_dynamic_ports(self) -> Dict[str, int]:
         """Label -> assigned port for dynamic ports; the offer process appends
-        assigned dynamic ports to reserved_ports (structs.go:659-696)."""
+        assigned dynamic ports to reserved_ports (structs.go:659-696).
+        Returns {} on a raw (unoffered) ask — there is nothing assigned yet."""
+        if not self.offered:
+            return {}
         ports = self.reserved_ports[len(self.reserved_ports) - len(self.dynamic_ports):]
         return {label: ports[i] for i, label in enumerate(self.dynamic_ports)}
 

@@ -1,0 +1,143 @@
+"""CLI tests (reference: command/*_test.go with cli.MockUi)."""
+
+import time
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.cli import main
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    config = AgentConfig.dev()
+    config.data_dir = str(tmp_path_factory.mktemp("cli-agent"))
+    config.http_port = 0
+    config.scheduler_backend = "host"
+    a = Agent(config)
+    a.start()
+    # wait for the dev node
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        node = a.client.node if a.client else None
+        if node and a.server.state_store.node_by_id(node.id) and \
+           a.server.state_store.node_by_id(node.id).status == "ready":
+            break
+        time.sleep(0.1)
+    yield a
+    a.shutdown()
+
+
+def _run(agent, *argv):
+    return main(["--address", agent.http.addr, *argv])
+
+
+def test_version(capsys, agent):
+    assert _run(agent, "version") == 0
+    assert "nomad-tpu v" in capsys.readouterr().out
+
+
+def test_init_validate(tmp_path, monkeypatch, capsys, agent):
+    monkeypatch.chdir(tmp_path)
+    assert _run(agent, "init") == 0
+    assert _run(agent, "validate", "example.hcl") == 0
+    out = capsys.readouterr().out
+    assert "Job validation successful" in out
+    # Second init fails (file exists)
+    assert _run(agent, "init") == 1
+
+
+def test_run_status_stop(tmp_path, capsys, agent):
+    jobfile = tmp_path / "job.hcl"
+    jobfile.write_text('''
+job "cli-test" {
+    datacenters = ["dc1"]
+    type = "service"
+    group "g" {
+        count = 1
+        task "t" {
+            driver = "mock_driver"
+            config { run_for = "60" }
+            resources { cpu = 50 memory = 32 }
+        }
+    }
+}
+''')
+    assert _run(agent, "run", str(jobfile)) == 0
+    out = capsys.readouterr().out
+    assert "Monitoring evaluation" in out
+    assert 'Allocation' in out
+    assert '"pending" -> "complete"' in out
+
+    assert _run(agent, "status") == 0
+    assert "cli-test" in capsys.readouterr().out
+
+    assert _run(agent, "status", "cli-test") == 0
+    out = capsys.readouterr().out
+    assert "ID          = cli-test" in out
+    assert "==> Allocations" in out
+
+    assert _run(agent, "node-status") == 0
+    out = capsys.readouterr().out
+    assert "ready" in out
+
+    # node-status detail + alloc-status
+    node_id = agent.client.node.id
+    assert _run(agent, "node-status", node_id) == 0
+    out = capsys.readouterr().out
+    assert f"ID         = {node_id}" in out
+
+    allocs = agent.server.state_store.allocs_by_job("cli-test")
+    assert _run(agent, "alloc-status", allocs[0].id) == 0
+    out = capsys.readouterr().out
+    assert "Placement Metrics" in out
+
+    assert _run(agent, "stop", "cli-test") == 0
+    out = capsys.readouterr().out
+    assert '"pending" -> "complete"' in out
+
+
+def test_run_placement_failure_reported(tmp_path, capsys, agent):
+    jobfile = tmp_path / "fail.hcl"
+    jobfile.write_text('''
+job "impossible" {
+    datacenters = ["dc1"]
+    group "g" {
+        count = 1
+        task "t" {
+            driver = "mock_driver"
+            config { run_for = "1" }
+            resources { cpu = 99999999 memory = 99999999 }
+        }
+    }
+}
+''')
+    assert _run(agent, "run", str(jobfile)) == 0  # eval completes with failed alloc
+    out = capsys.readouterr().out
+    assert "Scheduling error" in out
+    _run(agent, "stop", "-detach", "impossible")
+    capsys.readouterr()
+
+
+def test_validate_bad_job(tmp_path, capsys, agent):
+    bad = tmp_path / "bad.hcl"
+    bad.write_text('job "x" { }')  # no datacenters/task groups
+    assert _run(agent, "validate", str(bad)) == 1
+    assert "Error validating job" in capsys.readouterr().out
+
+
+def test_server_members_and_agent_info(capsys, agent):
+    assert _run(agent, "server-members") == 0
+    assert "alive" in capsys.readouterr().out
+    assert _run(agent, "agent-info") == 0
+    assert "server_enabled" in capsys.readouterr().out
+
+
+def test_node_drain_cli(capsys, agent):
+    node_id = agent.client.node.id
+    assert _run(agent, "node-drain", node_id) == 1  # missing flag
+    capsys.readouterr()
+    assert _run(agent, "node-drain", "-enable", node_id) == 0
+    assert agent.server.state_store.node_by_id(node_id).drain
+    assert _run(agent, "node-drain", "-disable", node_id) == 0
+    assert not agent.server.state_store.node_by_id(node_id).drain

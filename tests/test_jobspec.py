@@ -1,0 +1,177 @@
+"""Jobspec parser tests, anchored to the reference fixtures
+(/root/reference/jobspec/parse_test.go + test-fixtures/*.hcl)."""
+
+import pytest
+
+from nomad_tpu import structs
+from nomad_tpu.jobspec import JobspecError, parse, parse_duration, parse_file
+
+BASIC = open("/root/reference/jobspec/test-fixtures/basic.hcl").read()
+
+
+def test_parse_basic():
+    """reference: parse_test.go TestParse basic.hcl expectations"""
+    job = parse(BASIC)
+    assert job.id == "binstore-storagelocker"
+    assert job.name == "binstore-storagelocker"
+    assert job.region == "global"
+    assert job.type == "service"
+    assert job.priority == 50
+    assert job.all_at_once is True
+    assert job.datacenters == ["us2", "eu1"]
+    assert job.meta == {"foo": "bar"}
+
+    assert len(job.constraints) == 1
+    c = job.constraints[0]
+    assert c.l_target == "kernel.os"
+    assert c.r_target == "windows"
+    assert c.operand == "="
+
+    assert job.update.stagger == 60.0
+    assert job.update.max_parallel == 2
+
+    # Standalone task becomes its own group, then the explicit group
+    assert [tg.name for tg in job.task_groups] == ["outside", "binsl"]
+    outside = job.task_groups[0]
+    assert outside.count == 1
+    assert outside.tasks[0].driver == "java"
+    assert outside.tasks[0].config == {"jar": "s3://my-cool-store/foo.jar"}
+    assert outside.tasks[0].meta == {"my-cool-key": "foobar"}
+    assert outside.restart_policy is not None
+
+    binsl = job.task_groups[1]
+    assert binsl.count == 5
+    assert binsl.restart_policy.attempts == 5
+    assert binsl.restart_policy.interval == 600.0
+    assert binsl.restart_policy.delay == 15.0
+    assert binsl.meta == {
+        "elb_mode": "tcp", "elb_interval": "10", "elb_checks": "3",
+    }
+    assert len(binsl.constraints) == 1
+
+    assert [t.name for t in binsl.tasks] == ["binstore", "storagelocker"]
+    binstore = binsl.tasks[0]
+    assert binstore.driver == "docker"
+    assert binstore.env == {"HELLO": "world", "LOREM": "ipsum"}
+    assert binstore.resources.cpu == 500
+    assert binstore.resources.memory_mb == 128
+    net = binstore.resources.networks[0]
+    assert net.mbits == 100
+    assert net.reserved_ports == [1, 2, 3]
+    assert net.dynamic_ports == ["http", "https", "admin"]
+
+    storagelocker = binsl.tasks[1]
+    assert len(storagelocker.constraints) == 1
+    assert storagelocker.constraints[0].l_target == "kernel.arch"
+
+
+def test_parse_default_job():
+    job = parse_file("/root/reference/jobspec/test-fixtures/default-job.hcl")
+    assert job.id == "foo"
+    assert job.name == "foo"
+    assert job.priority == 50
+    assert job.region == "global"
+    assert job.type == "service"
+
+
+def test_parse_specify_job():
+    job = parse_file("/root/reference/jobspec/test-fixtures/specify-job.hcl")
+    assert job.id == "job1"
+    assert job.name == "My Job"
+
+
+def test_parse_version_constraint():
+    job = parse_file("/root/reference/jobspec/test-fixtures/version-constraint.hcl")
+    c = job.constraints[0]
+    assert c.l_target == "$attr.kernel.version"
+    assert c.r_target == "~> 3.2"
+    assert c.operand == structs.CONSTRAINT_VERSION
+
+
+def test_parse_regexp_constraint():
+    job = parse_file("/root/reference/jobspec/test-fixtures/regexp-constraint.hcl")
+    c = job.constraints[0]
+    assert c.r_target == "[0-9.]+"
+    assert c.operand == structs.CONSTRAINT_REGEX
+
+
+def test_parse_distinct_hosts():
+    job = parse_file(
+        "/root/reference/jobspec/test-fixtures/distinctHosts-constraint.hcl"
+    )
+    assert job.constraints[0].operand == structs.CONSTRAINT_DISTINCT_HOSTS
+
+
+def test_parse_bad_ports():
+    with pytest.raises(JobspecError, match="naming requirements"):
+        parse_file("/root/reference/jobspec/test-fixtures/bad-ports.hcl")
+
+
+def test_parse_overlapping_ports():
+    with pytest.raises(JobspecError, match="collision"):
+        parse_file("/root/reference/jobspec/test-fixtures/overlapping-ports.hcl")
+
+
+def test_parse_multi_network_rejected():
+    with pytest.raises(JobspecError, match="only one 'network'"):
+        parse_file("/root/reference/jobspec/test-fixtures/multi-network.hcl")
+
+
+def test_parse_multi_resource_rejected():
+    with pytest.raises(JobspecError, match="only one 'resource'"):
+        parse_file("/root/reference/jobspec/test-fixtures/multi-resource.hcl")
+
+
+def test_parse_errors():
+    with pytest.raises(JobspecError, match="'job' stanza not found"):
+        parse("")
+    with pytest.raises(JobspecError, match="only one 'job'"):
+        parse('job "a" {}\njob "b" {}')
+    with pytest.raises(JobspecError):
+        parse('job "a" { unclosed ')
+
+
+def test_duration_parsing():
+    assert parse_duration("60s") == 60.0
+    assert parse_duration("10m") == 600.0
+    assert parse_duration("1h30m") == 5400.0
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration(0) == 0.0
+    with pytest.raises(JobspecError):
+        parse_duration("10 parsecs")
+
+
+def test_parsed_job_validates_and_schedules():
+    """A parsed spec drives the full scheduler."""
+    spec = '''
+job "web-app" {
+    datacenters = ["dc1"]
+    group "web" {
+        count = 3
+        task "server" {
+            driver = "exec"
+            config { command = "/bin/sleep" args = "60" }
+            resources { cpu = 100 memory = 64 }
+        }
+    }
+}
+'''
+    job = parse(spec)
+    job.validate()
+
+    import sys
+    sys.path.insert(0, "tests")
+    from sched_harness import Harness, flatten
+    from nomad_tpu import mock
+    from nomad_tpu.structs import Evaluation, generate_uuid
+
+    h = Harness()
+    for _ in range(5):
+        h.state.upsert_node(h.next_index(), mock.node())
+    h.state.upsert_job(h.next_index(), job)
+    ev = Evaluation(
+        id=generate_uuid(), priority=job.priority,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+    h.process("tpu-service", ev)
+    assert len(flatten(h.plans[0].node_allocation)) == 3
