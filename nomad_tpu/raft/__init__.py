@@ -1,0 +1,12 @@
+"""Raft consensus: replicated log for multi-server state.
+
+Reference: hashicorp/raft wired at /root/reference/nomad/server.go:397-500
+with the FSM at nomad/fsm.go. This is a from-scratch Raft (leader election,
+log replication, commitment, follower catch-up) speaking the framework's
+RPC layer; it exposes the same ``apply``/``applied_index`` interface as the
+in-process replication layer so the rest of the server is unchanged.
+"""
+
+from nomad_tpu.raft.node import NotLeaderError, RaftConfig, RaftNode
+
+__all__ = ["RaftNode", "RaftConfig", "NotLeaderError"]

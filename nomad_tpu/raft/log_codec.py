@@ -1,0 +1,48 @@
+"""Log entry payload codec.
+
+FSM payloads carry data-model objects; log entries must cross the wire.
+The reference tags msgpack bodies with a 1-byte MessageType
+(nomad/structs/structs.go:1586-1591); here each message type maps its
+payload fields to dataclass types and round-trips through the JSON codec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from nomad_tpu.api.codec import from_dict, to_dict
+from nomad_tpu.structs import Allocation, Evaluation, Job, Node
+
+# msg_type -> {payload_field: element_dataclass or None for plain values}
+_SCHEMAS: Dict[str, Dict[str, Any]] = {
+    "node_register": {"node": Node},
+    "node_deregister": {"node_id": None},
+    "node_status_update": {"node_id": None, "status": None},
+    "node_drain_update": {"node_id": None, "drain": None},
+    "job_register": {"job": Job},
+    "job_deregister": {"job_id": None},
+    "eval_update": {"evals": [Evaluation]},
+    "eval_delete": {"evals": None, "allocs": None},
+    "alloc_update": {"allocs": [Allocation]},
+    "alloc_client_update": {"allocs": [Allocation]},
+}
+
+
+def encode_payload(msg_type: str, payload: dict) -> dict:
+    return {k: to_dict(v) for k, v in payload.items()}
+
+
+def decode_payload(msg_type: str, payload: dict) -> dict:
+    schema = _SCHEMAS.get(msg_type)
+    if schema is None:
+        return payload
+    out = {}
+    for key, value in payload.items():
+        spec = schema.get(key)
+        if spec is None:
+            out[key] = value
+        elif isinstance(spec, list):
+            out[key] = [from_dict(spec[0], v) for v in value]
+        else:
+            out[key] = from_dict(spec, value)
+    return out

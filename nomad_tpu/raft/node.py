@@ -1,0 +1,529 @@
+"""The Raft node: election, replication, commitment.
+
+A compact, correct Raft core (Ongaro & Ousterhout's algorithm) over the
+framework RPC layer. Scope notes vs the paper:
+- log compaction/InstallSnapshot: not yet (logs are bounded by GC upstream;
+  snapshot shipping lands with WAN federation)
+- membership change: static peer set per cluster (the reference's
+  bootstrap_expect posture, nomad/serf.go:76-134)
+
+Persistence: term/vote/log journal to ``data_dir`` when set, replayed on
+restart; in-memory otherwise (the reference's DevMode InmemStore,
+server.go:420-427).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.raft.log_codec import decode_payload, encode_payload
+from nomad_tpu.rpc import ConnPool, RPCError, RPCServer, RemoteError
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_addr: str = ""):
+        super().__init__(
+            f"not the leader (leader: {leader_addr or 'unknown'})"
+        )
+        self.leader_addr = leader_addr
+
+
+@dataclass
+class RaftConfig:
+    node_id: str = ""
+    # node_id -> rpc addr for every member, including self
+    peers: Dict[str, str] = field(default_factory=dict)
+    heartbeat_interval: float = 0.05
+    election_timeout_min: float = 0.15
+    election_timeout_max: float = 0.30
+    data_dir: str = ""
+
+
+@dataclass
+class _Entry:
+    term: int
+    msg_type: str
+    payload: dict  # encoded (wire) form
+
+    def to_wire(self) -> dict:
+        return {"term": self.term, "type": self.msg_type, "payload": self.payload}
+
+    @staticmethod
+    def from_wire(d: dict) -> "_Entry":
+        return _Entry(d["term"], d["type"], d["payload"])
+
+
+class RaftNode:
+    """One Raft participant. Exposes the replication-layer interface the
+    server uses: apply(msg_type, payload) -> Future[index], applied_index,
+    plus on_leadership_change notifications."""
+
+    def __init__(self, config: RaftConfig, fsm, rpc: RPCServer,
+                 pool: Optional[ConnPool] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.config = config
+        self.fsm = fsm
+        self.rpc = rpc
+        self.pool = pool or ConnPool(timeout=2.0)
+        self.logger = logger or logging.getLogger(
+            f"nomad_tpu.raft.{config.node_id}"
+        )
+
+        # Persistent state
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log: List[_Entry] = []  # 1-indexed via helpers
+
+        # Volatile
+        self.commit_index = 0
+        self.last_applied = 0
+        self.role = FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+
+        self._lock = threading.RLock()
+        self._apply_futures: Dict[int, Future] = {}
+        self._last_heartbeat = time.monotonic()
+        self._election_deadline = self._random_deadline()
+        self._shutdown = threading.Event()
+        self._replicate_now = threading.Event()
+        self.on_leadership_change: Optional[Callable[[bool], None]] = None
+
+        self._load_persistent()
+        rpc.register("Raft.RequestVote", self._handle_request_vote)
+        rpc.register("Raft.AppendEntries", self._handle_append_entries)
+
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        # Construction (e.g. jit warmup elsewhere in the server) may predate
+        # start by a while; don't let the first election fire instantly.
+        with self._lock:
+            self._election_deadline = self._random_deadline()
+        for target, name in ((self._election_loop, "raft-election"),
+                             (self._leader_loop, "raft-leader")):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"{name}-{self.config.node_id}")
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._replicate_now.set()
+        self.pool.shutdown()
+
+    # -- public interface ---------------------------------------------------
+
+    @property
+    def applied_index(self) -> int:
+        with self._lock:
+            return self.last_applied
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == LEADER
+
+    @property
+    def leader_addr(self) -> str:
+        with self._lock:
+            if self.leader_id is None:
+                return ""
+            return self.config.peers.get(self.leader_id, "")
+
+    def apply(self, msg_type: str, payload: dict) -> Future:
+        """Append + replicate + commit + FSM-apply. Resolves with the log
+        index; raises NotLeaderError through the future on followers."""
+        future: Future = Future()
+        with self._lock:
+            if self.role != LEADER:
+                future.set_exception(NotLeaderError(self.leader_addr))
+                return future
+            entry = _Entry(
+                self.current_term, msg_type, encode_payload(msg_type, payload)
+            )
+            self.log.append(entry)
+            index = len(self.log)
+            self._apply_futures[index] = future
+            self._persist_entry(index, entry)
+            if len(self.config.peers) == 1:
+                self._advance_commit_locked()
+        self._replicate_now.set()
+        return future
+
+    def barrier(self, timeout: float = 5.0) -> int:
+        """Commit a no-op and wait for it — the leader's read barrier."""
+        future = self.apply("_noop", {})
+        return future.result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self.role,
+                "term": self.current_term,
+                "leader_id": self.leader_id,
+                "commit_index": self.commit_index,
+                "applied_index": self.last_applied,
+                "last_log_index": len(self.log),
+                "num_peers": len(self.config.peers) - 1,
+            }
+
+    # -- persistence --------------------------------------------------------
+
+    def _paths(self) -> Tuple[str, str]:
+        d = self.config.data_dir
+        return os.path.join(d, "raft-meta.json"), os.path.join(d, "raft-log.jsonl")
+
+    def _persist_meta(self) -> None:
+        if not self.config.data_dir:
+            return
+        meta_path, _ = self._paths()
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.current_term, "voted_for": self.voted_for}, f)
+        os.replace(tmp, meta_path)
+
+    def _persist_entry(self, index: int, entry: _Entry) -> None:
+        if not self.config.data_dir:
+            return
+        _, log_path = self._paths()
+        with open(log_path, "a") as f:
+            f.write(json.dumps({"index": index, **entry.to_wire()}) + "\n")
+
+    def _truncate_persisted_log(self) -> None:
+        if not self.config.data_dir:
+            return
+        _, log_path = self._paths()
+        with open(log_path, "w") as f:
+            for i, entry in enumerate(self.log, start=1):
+                f.write(json.dumps({"index": i, **entry.to_wire()}) + "\n")
+
+    def _load_persistent(self) -> None:
+        if not self.config.data_dir:
+            return
+        os.makedirs(self.config.data_dir, exist_ok=True)
+        meta_path, log_path = self._paths()
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            self.current_term = meta.get("term", 0)
+            self.voted_for = meta.get("voted_for")
+        except (OSError, ValueError):
+            pass
+        try:
+            with open(log_path) as f:
+                for line in f:
+                    d = json.loads(line)
+                    self.log.append(_Entry.from_wire(d))
+        except (OSError, ValueError):
+            pass
+
+    # -- helpers ------------------------------------------------------------
+
+    def _random_deadline(self) -> float:
+        return time.monotonic() + random.uniform(
+            self.config.election_timeout_min, self.config.election_timeout_max
+        )
+
+    def _last_log(self) -> Tuple[int, int]:
+        if not self.log:
+            return 0, 0
+        return len(self.log), self.log[-1].term
+
+    def _other_peers(self) -> Dict[str, str]:
+        return {
+            pid: addr
+            for pid, addr in self.config.peers.items()
+            if pid != self.config.node_id
+        }
+
+    def _become_follower(self, term: int, leader_id: Optional[str]) -> None:
+        was_leader = self.role == LEADER
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist_meta()
+        self.role = FOLLOWER
+        if leader_id is not None:
+            self.leader_id = leader_id
+        if was_leader and self.on_leadership_change:
+            threading.Thread(
+                target=self.on_leadership_change, args=(False,), daemon=True
+            ).start()
+        # Fail outstanding leader futures
+        for future in self._apply_futures.values():
+            if not future.done():
+                future.set_exception(NotLeaderError(self.leader_addr))
+        self._apply_futures.clear()
+
+    # -- election (paper §5.2) ----------------------------------------------
+
+    def _election_loop(self) -> None:
+        while not self._shutdown.is_set():
+            time.sleep(0.01)
+            with self._lock:
+                if self.role == LEADER:
+                    continue
+                if time.monotonic() < self._election_deadline:
+                    continue
+                # Start an election
+                self.role = CANDIDATE
+                self.current_term += 1
+                self.voted_for = self.config.node_id
+                self._persist_meta()
+                term = self.current_term
+                last_idx, last_term = self._last_log()
+                self._election_deadline = self._random_deadline()
+            self._run_election(term, last_idx, last_term)
+
+    def _run_election(self, term: int, last_idx: int, last_term: int) -> None:
+        votes = 1
+        needed = len(self.config.peers) // 2 + 1
+        votes_lock = threading.Lock()
+        done = threading.Event()
+
+        def request(pid: str, addr: str) -> None:
+            nonlocal votes
+            try:
+                resp = self.pool.call(addr, "Raft.RequestVote", {
+                    "term": term,
+                    "candidate_id": self.config.node_id,
+                    "last_log_index": last_idx,
+                    "last_log_term": last_term,
+                }, timeout=1.0)
+            except (RPCError, RemoteError):
+                return
+            with self._lock:
+                if resp["term"] > self.current_term:
+                    self._become_follower(resp["term"], None)
+                    done.set()
+                    return
+            if resp.get("vote_granted"):
+                with votes_lock:
+                    votes += 1
+                    if votes >= needed:
+                        done.set()
+
+        threads = [
+            threading.Thread(target=request, args=(pid, addr), daemon=True)
+            for pid, addr in self._other_peers().items()
+        ]
+        for t in threads:
+            t.start()
+        if needed == 1:
+            done.set()
+        done.wait(timeout=self.config.election_timeout_max)
+
+        with self._lock:
+            if self.role != CANDIDATE or self.current_term != term:
+                return
+            with votes_lock:
+                won = votes >= needed
+            if not won:
+                return
+            # Become leader (paper §5.3)
+            self.role = LEADER
+            self.leader_id = self.config.node_id
+            last_idx, _ = self._last_log()
+            for pid in self._other_peers():
+                self.next_index[pid] = last_idx + 1
+                self.match_index[pid] = 0
+            self.logger.info(
+                "raft: node %s won election for term %d",
+                self.config.node_id, term,
+            )
+        # Commit a no-op immediately: a leader may only count replicas for
+        # current-term entries (paper §5.4.2), so this is what commits any
+        # prior-term tail — including a freshly replayed log.
+        self.apply("_noop", {})
+        if self.on_leadership_change:
+            threading.Thread(
+                target=self.on_leadership_change, args=(True,), daemon=True
+            ).start()
+        self._replicate_now.set()
+
+    def _handle_request_vote(self, args: dict) -> dict:
+        with self._lock:
+            term = args["term"]
+            if term > self.current_term:
+                self._become_follower(term, None)
+            granted = False
+            if term == self.current_term and self.voted_for in (
+                None, args["candidate_id"]
+            ):
+                last_idx, last_term = self._last_log()
+                up_to_date = (args["last_log_term"], args["last_log_index"]) >= (
+                    last_term, last_idx
+                )
+                if up_to_date:
+                    granted = True
+                    self.voted_for = args["candidate_id"]
+                    self._persist_meta()
+                    self._election_deadline = self._random_deadline()
+            return {"term": self.current_term, "vote_granted": granted}
+
+    # -- replication (paper §5.3) --------------------------------------------
+
+    def _leader_loop(self) -> None:
+        while not self._shutdown.is_set():
+            fired = self._replicate_now.wait(self.config.heartbeat_interval)
+            self._replicate_now.clear()
+            with self._lock:
+                if self.role != LEADER:
+                    continue
+            self._broadcast_append()
+            del fired
+
+    def _broadcast_append(self) -> None:
+        peers = self._other_peers()
+        if not peers:
+            with self._lock:
+                self._advance_commit_locked()
+            return
+        threads = [
+            threading.Thread(
+                target=self._replicate_to, args=(pid, addr), daemon=True
+            )
+            for pid, addr in peers.items()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1.0)
+
+    def _replicate_to(self, pid: str, addr: str) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            term = self.current_term
+            next_idx = self.next_index.get(pid, 1)
+            prev_idx = next_idx - 1
+            prev_term = self.log[prev_idx - 1].term if prev_idx > 0 else 0
+            entries = [e.to_wire() for e in self.log[next_idx - 1:]]
+            commit = self.commit_index
+        try:
+            resp = self.pool.call(addr, "Raft.AppendEntries", {
+                "term": term,
+                "leader_id": self.config.node_id,
+                "prev_log_index": prev_idx,
+                "prev_log_term": prev_term,
+                "entries": entries,
+                "leader_commit": commit,
+            }, timeout=1.0)
+        except (RPCError, RemoteError):
+            return
+        with self._lock:
+            if resp["term"] > self.current_term:
+                self._become_follower(resp["term"], None)
+                return
+            if self.role != LEADER or self.current_term != term:
+                return
+            if resp.get("success"):
+                self.match_index[pid] = prev_idx + len(entries)
+                self.next_index[pid] = self.match_index[pid] + 1
+                self._advance_commit_locked()
+            else:
+                # Back off and retry (fast backtrack via follower hint)
+                hint = resp.get("conflict_index")
+                self.next_index[pid] = max(
+                    1, hint if hint else self.next_index.get(pid, 2) - 1
+                )
+                self._replicate_now.set()
+
+    def _advance_commit_locked(self) -> None:
+        """Advance commit index over majority-matched entries of the current
+        term (paper §5.4.2), then apply."""
+        last_idx, _ = self._last_log()
+        for n in range(last_idx, self.commit_index, -1):
+            if self.log[n - 1].term != self.current_term:
+                break
+            votes = 1 + sum(
+                1 for pid in self._other_peers() if self.match_index.get(pid, 0) >= n
+            )
+            if votes >= len(self.config.peers) // 2 + 1:
+                self.commit_index = n
+                break
+        self._apply_committed_locked()
+
+    def _apply_committed_locked(self) -> None:
+        while self.last_applied < self.commit_index:
+            index = self.last_applied + 1
+            entry = self.log[index - 1]
+            try:
+                if entry.msg_type != "_noop":
+                    self.fsm.apply(
+                        index, entry.msg_type,
+                        decode_payload(entry.msg_type, entry.payload),
+                    )
+                error = None
+            except Exception as e:  # deterministic FSM error
+                error = e
+            self.last_applied = index
+            future = self._apply_futures.pop(index, None)
+            if future is not None and not future.done():
+                if error is None:
+                    future.set_result(index)
+                else:
+                    future.set_exception(error)
+
+    def _handle_append_entries(self, args: dict) -> dict:
+        with self._lock:
+            term = args["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            # Valid leader for this term
+            if term > self.current_term or self.role != FOLLOWER:
+                self._become_follower(term, args["leader_id"])
+            self.leader_id = args["leader_id"]
+            self._election_deadline = self._random_deadline()
+
+            prev_idx = args["prev_log_index"]
+            prev_term = args["prev_log_term"]
+            if prev_idx > 0:
+                if len(self.log) < prev_idx:
+                    return {"term": self.current_term, "success": False,
+                            "conflict_index": len(self.log) + 1}
+                if self.log[prev_idx - 1].term != prev_term:
+                    # Find the first index of the conflicting term
+                    conflict_term = self.log[prev_idx - 1].term
+                    first = prev_idx
+                    while first > 1 and self.log[first - 2].term == conflict_term:
+                        first -= 1
+                    return {"term": self.current_term, "success": False,
+                            "conflict_index": first}
+
+            # Append any new entries, truncating conflicts
+            changed = False
+            for i, wire in enumerate(args["entries"]):
+                idx = prev_idx + 1 + i
+                entry = _Entry.from_wire(wire)
+                if len(self.log) >= idx:
+                    if self.log[idx - 1].term != entry.term:
+                        del self.log[idx - 1:]
+                        self.log.append(entry)
+                        changed = True
+                else:
+                    self.log.append(entry)
+                    changed = True
+            if changed:
+                self._truncate_persisted_log()
+
+            if args["leader_commit"] > self.commit_index:
+                self.commit_index = min(args["leader_commit"], len(self.log))
+                self._apply_committed_locked()
+            return {"term": self.current_term, "success": True}

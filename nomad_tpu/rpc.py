@@ -1,0 +1,192 @@
+"""Network RPC: framed JSON over TCP with connection pooling.
+
+The transport tier of the reference is msgpack-RPC over yamux with a pooled
+client (/root/reference/nomad/rpc.go:21-137, nomad/pool.go). Capabilities
+carried over: a single listener serving concurrent requests, client-side
+connection reuse, request/response correlation, and clean propagation of
+remote errors. Framing is length-prefixed JSON (the codec is internal to
+this framework; pickle is avoided — peers are semi-trusted).
+
+Wire format: 4-byte big-endian length + JSON object.
+Request:  {"seq": n, "method": "Service.Method", "args": {...}}
+Response: {"seq": n, "error": null | str, "result": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 << 20
+
+
+class RPCError(Exception):
+    pass
+
+
+class RemoteError(RPCError):
+    """An error raised by the remote handler."""
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise RPCError(f"frame too large: {length}")
+    return json.loads(_recv_exact(sock, length))
+
+
+class RPCServer:
+    """Serves registered handlers on a TCP listener (rpc.go:21-72 listen/
+    handleConn, minus the protocol-byte demux — raft runs on its own RPC
+    methods instead of a separate stream)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 logger: Optional[logging.Logger] = None):
+        self.logger = logger or logging.getLogger("nomad_tpu.rpc")
+        self._handlers: Dict[str, Callable[[dict], Any]] = {}
+        self._listener = socket.create_server((host, port))
+        self.addr = "{}:{}".format(*self._listener.getsockname())
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"rpc-{self.addr}"
+        )
+
+    def register(self, method: str, handler: Callable[[dict], Any]) -> None:
+        self._handlers[method] = handler
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._shutdown.is_set():
+                req = _recv_frame(conn)
+                resp = self._dispatch(req)
+                _send_frame(conn, resp)
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def _dispatch(self, req: dict) -> dict:
+        seq = req.get("seq")
+        method = req.get("method", "")
+        handler = self._handlers.get(method)
+        if handler is None:
+            return {"seq": seq, "error": f"unknown method {method!r}",
+                    "result": None}
+        try:
+            return {"seq": seq, "error": None, "result": handler(req.get("args", {}))}
+        except Exception as e:
+            self.logger.debug("rpc: handler %s failed: %s", method, e)
+            return {"seq": seq, "error": f"{type(e).__name__}: {e}",
+                    "result": None}
+
+
+class ConnPool:
+    """Pooled RPC client connections (reference: nomad/pool.go:138-371).
+    One pooled connection per address; requests on a connection serialize
+    (sufficient at control-plane rates; the reference multiplexes instead)."""
+
+    def __init__(self, timeout: float = 10.0):
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._conns: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
+        self._seq = 0
+
+    def call(self, addr: str, method: str, args: dict,
+             timeout: Optional[float] = None) -> Any:
+        """RPC to addr; raises RemoteError for handler errors, RPCError for
+        transport failures (after invalidating the pooled conn)."""
+        sock, conn_lock = self._acquire(addr)
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        try:
+            with conn_lock:
+                sock.settimeout(timeout or self.timeout)
+                _send_frame(sock, {"seq": seq, "method": method, "args": args})
+                resp = _recv_frame(sock)
+        except (ConnectionError, OSError, ValueError) as e:
+            self._invalidate(addr)
+            raise RPCError(f"rpc to {addr} failed: {e}") from e
+        if resp.get("error"):
+            raise RemoteError(resp["error"])
+        return resp.get("result")
+
+    def _acquire(self, addr: str) -> Tuple[socket.socket, threading.Lock]:
+        with self._lock:
+            entry = self._conns.get(addr)
+            if entry is not None:
+                return entry
+        host, port = addr.rsplit(":", 1)
+        try:
+            sock = socket.create_connection((host, int(port)), timeout=self.timeout)
+        except OSError as e:
+            raise RPCError(f"failed to connect to {addr}: {e}") from e
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        entry = (sock, threading.Lock())
+        with self._lock:
+            existing = self._conns.get(addr)
+            if existing is not None:
+                sock.close()
+                return existing
+            self._conns[addr] = entry
+        return entry
+
+    def _invalidate(self, addr: str) -> None:
+        with self._lock:
+            entry = self._conns.pop(addr, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for sock, _ in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()

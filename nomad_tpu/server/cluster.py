@@ -1,0 +1,326 @@
+"""ClusterServer: a Raft-replicated, network-RPC member of a server cluster.
+
+Reference composition: nomad/server.go (Raft + RPC wiring), nomad/leader.go
+(leadership monitor enabling broker/plan queue, restoring broker state,
+renewing heartbeat timers on failover), nomad/rpc.go:163-228 (leader
+forwarding). Every server runs workers; followers forward Eval.Dequeue /
+Plan.Submit / write RPCs to the leader, exactly like the reference's
+optimistically-concurrent worker pool.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.api.codec import from_dict, to_dict
+from nomad_tpu.raft import NotLeaderError, RaftConfig, RaftNode
+from nomad_tpu.rpc import ConnPool, RPCError, RPCServer, RemoteError
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    Plan,
+    PlanResult,
+)
+
+
+@dataclass
+class ClusterConfig:
+    """Cluster membership for one server (static peer set; the reference's
+    bootstrap_expect posture, serf.go:76-134)."""
+
+    node_id: str = ""
+    bind_host: str = "127.0.0.1"
+    bind_port: int = 0
+    # node_id -> rpc addr for all members, incl. self; filled in by
+    # form_cluster for tests or by configuration.
+    peers: Dict[str, str] = field(default_factory=dict)
+    raft_data_dir: str = ""
+    heartbeat_interval: float = 0.05
+    election_timeout_min: float = 0.15
+    election_timeout_max: float = 0.30
+
+
+class ClusterServer(Server):
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 cluster: Optional[ClusterConfig] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.cluster = cluster or ClusterConfig()
+        super().__init__(config, logger)
+
+        self.rpc = RPCServer(
+            self.cluster.bind_host, self.cluster.bind_port,
+            self.logger.getChild("rpc"),
+        )
+        self.rpc_addr = self.rpc.addr
+        self.pool = ConnPool(timeout=5.0)
+        # Long-poll traffic (Eval.Dequeue) gets its own pooled connection so
+        # blocking dequeues don't serialize behind control traffic (the
+        # reference multiplexes with yamux instead, nomad/pool.go).
+        self.longpoll_pool = ConnPool(timeout=5.0)
+
+        if not self.cluster.node_id:
+            self.cluster.node_id = self.config.node_name
+        self.cluster.peers.setdefault(self.cluster.node_id, self.rpc_addr)
+
+        # Replace the in-process replication layer with Raft
+        self.raft = RaftNode(
+            RaftConfig(
+                node_id=self.cluster.node_id,
+                peers=self.cluster.peers,
+                heartbeat_interval=self.cluster.heartbeat_interval,
+                election_timeout_min=self.cluster.election_timeout_min,
+                election_timeout_max=self.cluster.election_timeout_max,
+                data_dir=self.cluster.raft_data_dir,
+            ),
+            self.fsm,
+            self.rpc,
+            logger=self.logger.getChild("raft"),
+        )
+        self.raft.on_leadership_change = self._leadership_changed
+        # Only a current leader feeds its broker during FSM apply; raft role
+        # flips synchronously under the raft lock, unlike the async
+        # leadership notification that enables/disables the broker.
+        self.fsm.enqueue_guard = lambda: self.raft.is_leader
+        # Plan applier must ride the raft replication layer
+        self.plan_applier.raft = self.raft
+        self._register_endpoints()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self.rpc.start()
+        self.raft.start()
+        self.plan_applier.start()
+        from nomad_tpu.server.worker import Worker
+
+        for i in range(self.config.num_schedulers):
+            worker = Worker(self, i)
+            worker.start()
+            self.workers.append(worker)
+        reaper = threading.Thread(
+            target=self._reap_failed_evaluations, daemon=True,
+            name="failed-eval-reaper",
+        )
+        reaper.start()
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        self.raft.shutdown()
+        self.rpc.shutdown()
+        self.pool.shutdown()
+        self.longpoll_pool.shutdown()
+
+    def _leadership_changed(self, is_leader: bool) -> None:
+        """establishLeadership / revokeLeadership (leader.go:99-140,
+        240-260)."""
+        if is_leader:
+            self.logger.info("cluster: %s gained leadership",
+                             self.cluster.node_id)
+            self.plan_queue.set_enabled(True)
+            self.eval_broker.set_enabled(True)
+            self.restore_eval_broker()
+            # Renew heartbeat TTLs with the failover grace so nodes aren't
+            # marked down during the transition (heartbeat.go:13-42).
+            for node in self.state_store.nodes():
+                if not node.terminal_status():
+                    self.heartbeat.reset_heartbeat_timer(node.id)
+        else:
+            self.logger.info("cluster: %s lost leadership",
+                             self.cluster.node_id)
+            self.plan_queue.set_enabled(False)
+            self.eval_broker.set_enabled(False)
+            self.heartbeat.clear_all()
+
+    # -- forwarding (rpc.go:163-228) ------------------------------------------
+
+    def _forward(self, method: str, args: dict, pool: Optional[ConnPool] = None,
+                 timeout: Optional[float] = None):
+        """Forward an RPC to the current leader. Waits briefly for leader
+        discovery (a follower learns the leader from the first heartbeat of a
+        term); raises NotLeaderError if none appears — callers back off and
+        retry like the reference worker (worker.go:398-411)."""
+        import time as _time
+
+        deadline = _time.monotonic() + 1.0
+        while True:
+            leader = self.raft.leader_addr
+            if leader:
+                return (pool or self.pool).call(
+                    leader, method, args, timeout=timeout
+                )
+            if self.raft.is_leader or _time.monotonic() >= deadline:
+                raise NotLeaderError("")
+            _time.sleep(0.02)
+
+    # -- overridden server seams ----------------------------------------------
+
+    def eval_dequeue(self, schedulers: List[str], timeout: float):
+        if self.raft.is_leader:
+            return self.eval_broker.dequeue(schedulers, timeout)
+        out = self._forward(
+            "Eval.Dequeue", {"schedulers": schedulers, "timeout": timeout},
+            pool=self.longpoll_pool, timeout=timeout + 5.0,
+        )
+        if out.get("eval") is None:
+            return None, ""
+        return from_dict(Evaluation, out["eval"]), out["token"]
+
+    def eval_ack(self, eval_id: str, token: str) -> None:
+        if self.raft.is_leader:
+            self.eval_broker.ack(eval_id, token)
+            return
+        self._forward("Eval.Ack", {"eval_id": eval_id, "token": token})
+
+    def eval_nack(self, eval_id: str, token: str) -> None:
+        if self.raft.is_leader:
+            self.eval_broker.nack(eval_id, token)
+            return
+        self._forward("Eval.Nack", {"eval_id": eval_id, "token": token})
+
+    def eval_upsert(self, evals: List[Evaluation]) -> int:
+        if self.raft.is_leader:
+            return self.raft.apply("eval_update", {"evals": evals}).result()
+        return self._forward(
+            "Eval.Upsert", {"evals": [to_dict(e) for e in evals]}
+        )
+
+    def plan_submit(self, plan: Plan) -> PlanResult:
+        if self.raft.is_leader:
+            return self.plan_queue.enqueue(plan).wait()
+        out = self._forward("Plan.Submit", {"plan": to_dict(plan)})
+        return from_dict(PlanResult, out)
+
+    def job_register(self, job: Job):
+        if self.raft.is_leader:
+            return super().job_register(job)
+        out = self._forward("Job.Register", {"job": to_dict(job)})
+        return out["eval_id"], out["index"]
+
+    def job_deregister(self, job_id: str):
+        if self.raft.is_leader:
+            return super().job_deregister(job_id)
+        out = self._forward("Job.Deregister", {"job_id": job_id})
+        return out["eval_id"], out["index"]
+
+    def node_register(self, node: Node):
+        if self.raft.is_leader:
+            return super().node_register(node)
+        return self._forward("Node.Register", {"node": to_dict(node)})
+
+    def node_update_status(self, node_id: str, status: str):
+        if self.raft.is_leader:
+            return super().node_update_status(node_id, status)
+        return self._forward(
+            "Node.UpdateStatus", {"node_id": node_id, "status": status}
+        )
+
+    def node_update_drain(self, node_id: str, drain: bool):
+        if self.raft.is_leader:
+            return super().node_update_drain(node_id, drain)
+        return self._forward(
+            "Node.UpdateDrain", {"node_id": node_id, "drain": drain}
+        )
+
+    def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
+        if self.raft.is_leader:
+            return super().update_allocs_from_client(allocs)
+        return self._forward(
+            "Node.UpdateAlloc", {"allocs": [to_dict(a) for a in allocs]}
+        )
+
+    # -- RPC endpoint registration (server.go:130-137) -------------------------
+
+    def _register_endpoints(self) -> None:
+        r = self.rpc.register
+        r("Status.Ping", lambda args: "pong")
+        r("Status.Leader", lambda args: self.raft.leader_addr)
+        r("Status.Peers", lambda args: list(self.cluster.peers.values()))
+        r("Status.Stats", lambda args: {**self.stats(), **self.raft.stats()})
+
+        r("Eval.Dequeue", self._rpc_eval_dequeue)
+        r("Eval.Ack", lambda a: self.eval_ack(a["eval_id"], a["token"]))
+        r("Eval.Nack", lambda a: self.eval_nack(a["eval_id"], a["token"]))
+        r("Eval.Upsert", lambda a: self.eval_upsert(
+            [from_dict(Evaluation, e) for e in a["evals"]]
+        ))
+        r("Plan.Submit", self._rpc_plan_submit)
+        r("Job.Register", self._rpc_job_register)
+        r("Job.Deregister", self._rpc_job_deregister)
+        r("Node.Register", lambda a: self.node_register(from_dict(Node, a["node"])))
+        r("Node.UpdateStatus", lambda a: self.node_update_status(
+            a["node_id"], a["status"]
+        ))
+        r("Node.UpdateDrain", lambda a: self.node_update_drain(
+            a["node_id"], a["drain"]
+        ))
+        r("Node.UpdateAlloc", lambda a: self.update_allocs_from_client(
+            [from_dict(Allocation, x) for x in a["allocs"]]
+        ))
+
+    def _rpc_eval_dequeue(self, args: dict):
+        ev, token = self.eval_dequeue(
+            args["schedulers"], min(float(args.get("timeout", 0.5)), 10.0)
+        )
+        if ev is None:
+            return {"eval": None, "token": ""}
+        return {"eval": to_dict(ev), "token": token}
+
+    def _rpc_plan_submit(self, args: dict):
+        plan = from_dict(Plan, args["plan"])
+        return to_dict(self.plan_submit(plan))
+
+    def _rpc_job_register(self, args: dict):
+        eval_id, index = self.job_register(from_dict(Job, args["job"]))
+        return {"eval_id": eval_id, "index": index}
+
+    def _rpc_job_deregister(self, args: dict):
+        eval_id, index = self.job_deregister(args["job_id"])
+        return {"eval_id": eval_id, "index": index}
+
+
+def form_cluster(
+    n: int,
+    server_config: Optional[ServerConfig] = None,
+    base_cluster: Optional[ClusterConfig] = None,
+    logger: Optional[logging.Logger] = None,
+) -> List[ClusterServer]:
+    """Build an n-server cluster on localhost with a shared static peer set
+    (the in-process multi-server posture of reference server tests,
+    nomad/server_test.go:26-87)."""
+    import copy as _copy
+
+    servers: List[ClusterServer] = []
+    peers: Dict[str, str] = {}
+    for i in range(n):
+        cfg = _copy.deepcopy(server_config) if server_config else ServerConfig()
+        cfg.node_name = f"server-{i}"
+        cluster = _copy.deepcopy(base_cluster) if base_cluster else ClusterConfig()
+        cluster.node_id = cfg.node_name
+        cluster.peers = peers  # shared dict: filled as servers bind
+        srv = ClusterServer(cfg, cluster, logger)
+        servers.append(srv)
+    for srv in servers:
+        srv.start()
+    return servers
+
+
+def wait_for_leader(servers: List[ClusterServer], timeout: float = 10.0):
+    """testutil.WaitForLeader (testutil/wait.go:33)."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        for srv in servers:
+            if srv.raft.is_leader:
+                return srv
+        _time.sleep(0.02)
+    raise TimeoutError("no cluster leader elected")

@@ -34,6 +34,12 @@ class FSM:
     ):
         self.state = StateStore()
         self.eval_broker = eval_broker
+        # Gate for broker enqueue on apply: in a cluster this is raft
+        # leadership, checked synchronously at apply time. The broker's own
+        # enabled flag lags leadership changes (they notify asynchronously),
+        # so a deposed leader could otherwise enqueue replicated evals into
+        # its stale broker and double-deliver.
+        self.enqueue_guard = lambda: True
         self.logger = logger or logging.getLogger("nomad_tpu.fsm")
         self._handlers: Dict[str, Callable[[int, dict], Any]] = {
             "node_register": self._apply_node_register,
@@ -78,7 +84,7 @@ class FSM:
         evals = payload["evals"]
         self.state.upsert_evals(index, evals)
         # On the leader, hand pending evals to the broker (fsm.go:243-250)
-        if self.eval_broker is not None:
+        if self.eval_broker is not None and self.enqueue_guard():
             for ev in evals:
                 if ev.should_enqueue():
                     self.eval_broker.enqueue(ev)

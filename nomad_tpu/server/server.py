@@ -188,7 +188,7 @@ class Server:
                 f"({self.config.eval_delivery_limit})"
             )
             try:
-                self.raft.apply("eval_update", {"evals": [new_eval]}).result()
+                self.eval_upsert([new_eval])
                 self.eval_broker.ack(ev.id, token)
             except Exception:
                 self.logger.exception("failed to reap evaluation %s", ev.id)
@@ -223,7 +223,7 @@ class Server:
             job_modify_index=index,
             status=structs.EVAL_STATUS_PENDING,
         )
-        eval_index = self.raft.apply("eval_update", {"evals": [ev]}).result()
+        eval_index = self.eval_upsert([ev])
         return ev.id, eval_index
 
     def job_evaluate(self, job_id: str) -> Tuple[str, int]:
@@ -240,7 +240,7 @@ class Server:
             job_modify_index=job.modify_index,
             status=structs.EVAL_STATUS_PENDING,
         )
-        index = self.raft.apply("eval_update", {"evals": [ev]}).result()
+        index = self.eval_upsert([ev])
         return ev.id, index
 
     def job_deregister(self, job_id: str) -> Tuple[str, int]:
@@ -260,7 +260,7 @@ class Server:
             job_modify_index=index,
             status=structs.EVAL_STATUS_PENDING,
         )
-        eval_index = self.raft.apply("eval_update", {"evals": [ev]}).result()
+        eval_index = self.eval_upsert([ev])
         return ev.id, eval_index
 
     # -- Node endpoint (node_endpoint.go) ------------------------------------
@@ -410,7 +410,7 @@ class Server:
                 )
             )
 
-        index = self.raft.apply("eval_update", {"evals": evals}).result()
+        index = self.eval_upsert(evals)
         return [e.id for e in evals], index
 
     # -- Eval endpoint (eval_endpoint.go) ------------------------------------
@@ -423,6 +423,11 @@ class Server:
 
     def eval_nack(self, eval_id: str, token: str) -> None:
         self.eval_broker.nack(eval_id, token)
+
+    def eval_upsert(self, evals: List[Evaluation]) -> int:
+        """Commit evals through the log (Eval.Update / Eval.Create RPC,
+        eval_endpoint.go)."""
+        return self.raft.apply("eval_update", {"evals": evals}).result()
 
     def eval_reap(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
         return self.raft.apply(

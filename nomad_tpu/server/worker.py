@@ -76,11 +76,16 @@ class Worker(threading.Thread):
 
     def _dequeue_evaluation(self) -> Optional[Tuple[Evaluation, str]]:
         try:
-            ev, token = self.server.eval_broker.dequeue(
+            ev, token = self.server.eval_dequeue(
                 self.server.config.enabled_schedulers, timeout=DEQUEUE_TIMEOUT
             )
         except BrokerError:
             time.sleep(0.05)
+            return None
+        except Exception as e:
+            # Transient cluster conditions (no leader yet, forwarding error)
+            self.logger.debug("dequeue failed, retrying: %s", e)
+            time.sleep(0.1)
             return None
         if ev is None:
             return None
@@ -91,10 +96,10 @@ class Worker(threading.Thread):
         """Best effort ack/nack (worker.go:172-202)."""
         try:
             if ack:
-                self.server.eval_broker.ack(eval_id, token)
+                self.server.eval_ack(eval_id, token)
             else:
-                self.server.eval_broker.nack(eval_id, token)
-        except BrokerError as e:
+                self.server.eval_nack(eval_id, token)
+        except Exception as e:
             self.logger.error(
                 "failed to %s evaluation '%s': %s", "ack" if ack else "nack",
                 eval_id, e,
@@ -134,8 +139,7 @@ class Worker(threading.Thread):
 
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         plan.eval_token = self.eval_token
-        pending = self.server.plan_queue.enqueue(plan)
-        result = pending.wait()
+        result = self.server.plan_submit(plan)
 
         new_state = None
         if result.refresh_index != 0:
@@ -146,8 +150,7 @@ class Worker(threading.Thread):
         return result, new_state
 
     def update_eval(self, ev: Evaluation) -> None:
-        self.server.raft.apply("eval_update", {"evals": [ev]}).result()
+        self.server.eval_upsert([ev])
 
     def create_eval(self, ev: Evaluation) -> None:
-        ev.create_index = self.server.raft.applied_index
-        self.server.raft.apply("eval_update", {"evals": [ev]}).result()
+        self.server.eval_upsert([ev])
