@@ -86,7 +86,9 @@ def build_cluster():
 
 
 class _TimingStack:
-    """Wraps TPUStack.select_many to capture the solve wall time."""
+    """Wraps TPUStack.solve_group to capture the solve wall time: masks +
+    usage tensorization + device dispatch + readback (+ any host work the
+    scheduler overlaps with the transfer)."""
 
     solve_times = []
 
@@ -94,15 +96,15 @@ class _TimingStack:
     def install(cls):
         from nomad_tpu.tpu.solver import TPUStack
 
-        orig = TPUStack.select_many
+        orig = TPUStack.solve_group
 
-        def timed(self, tg, count):
+        def timed(self, tg, count, overlap=None):
             start = time.perf_counter()
-            out = orig(self, tg, count)
+            out = orig(self, tg, count, overlap=overlap)
             cls.solve_times.append(time.perf_counter() - start)
             return out
 
-        TPUStack.select_many = timed
+        TPUStack.solve_group = timed
 
 
 def run_once(nodes, job):

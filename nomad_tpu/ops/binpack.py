@@ -142,11 +142,21 @@ def solve_rounds_fused(
             total, sched_cap, used, job_count, tg_count, bw_avail, bw_used,
             eligible, ask, bw_ask, penalty, job_distinct, tg_distinct,
         )
-        order = jnp.argsort(-score)
-        rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(
-            jnp.arange(n, dtype=jnp.int32)
+        n_fit = fit.sum().astype(jnp.int32)
+
+        def take_topk(_):
+            # Partial round: keep only the `remaining` best-scoring fits.
+            # Non-fit scores are NEG_INF, so fit nodes sort first.
+            order = jnp.argsort(-score)
+            rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(
+                jnp.arange(n, dtype=jnp.int32)
+            )
+            return fit & (rank < remaining)
+
+        # All full rounds skip the argsort: every fitting node is selected.
+        selected = lax.cond(
+            n_fit <= remaining, lambda _: fit, take_topk, None
         )
-        selected = fit & (rank < remaining)
         n_placed = selected.sum().astype(jnp.int32)
         used = used + selected[:, None] * ask[None, :]
         job_count = job_count + selected
@@ -166,20 +176,23 @@ def solve_rounds_fused(
     return counts, remaining
 
 
-def solve_many(
+def solve_many_async(
     total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
     eligible, ask, bw_ask, count: int, penalty: float,
     job_distinct: bool = False, tg_distinct: bool = False,
     exact_threshold: int = 128,
 ):
-    """Place ``count`` copies of one ask. Dispatches the exact scan for small
-    counts and the fused round solver for large ones.
+    """Dispatch the solve for ``count`` copies of one ask; return a fetch()
+    closure that blocks on the device and yields (node_indices, ok).
 
-    Returns (node_indices, ok) numpy arrays of length count. The exact path
-    is in true greedy placement order; the fused path reconstructs from
-    per-node counts, so indices come grouped by node — copies of one ask are
-    interchangeable, so callers must not rely on ordering. Unplaceable tail
-    is idx -1 / ok False.
+    Device dispatch is asynchronous but the result readback pays a full
+    host<->device round-trip, so callers overlap independent host work
+    (uuid generation, name materialization) between dispatch and fetch.
+
+    The exact scan path (small counts) is in true greedy placement order;
+    the fused path reconstructs from per-node counts, so indices come
+    grouped by node — copies of one ask are interchangeable, so callers
+    must not rely on ordering. Unplaceable tail is idx -1 / ok False.
     """
     import numpy as np
 
@@ -191,22 +204,46 @@ def solve_many(
             bw_used0, eligible, ask, bw_ask, active,
             jnp.float32(penalty), k, job_distinct, tg_distinct,
         )
-        idxs, oks = jax.device_get((idxs, oks))
-        return idxs[:count], oks[:count]
+
+        def fetch_exact():
+            i, o = jax.device_get((idxs, oks))
+            return i[:count], o[:count]
+
+        return fetch_exact
 
     # Fused round solver: one dispatch + one transfer for the whole batch.
     # distinct_hosts needs no special-casing: the fit mask excludes nodes
     # whose job/tg counts grew, so the loop drains and exits on no-progress.
-    counts, _remaining = solve_rounds_fused(
+    counts_dev, _remaining = solve_rounds_fused(
         total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
         eligible, ask, bw_ask, jnp.int32(count), jnp.float32(penalty),
         job_distinct, tg_distinct,
     )
-    counts = np.asarray(jax.device_get(counts))
-    idxs = np.repeat(np.arange(counts.shape[0], dtype=np.int64), counts)
-    n_placed = idxs.shape[0]
-    out_idx = np.full(count, -1, dtype=np.int64)
-    out_idx[:n_placed] = idxs[:count]
-    oks = np.zeros(count, dtype=bool)
-    oks[: min(n_placed, count)] = True
-    return out_idx, oks
+
+    def fetch_fused():
+        counts = np.asarray(jax.device_get(counts_dev))
+        idxs = np.repeat(np.arange(counts.shape[0], dtype=np.int64), counts)
+        n_placed = idxs.shape[0]
+        out_idx = np.full(count, -1, dtype=np.int64)
+        out_idx[:n_placed] = idxs[:count]
+        oks = np.zeros(count, dtype=bool)
+        oks[: min(n_placed, count)] = True
+        return out_idx, oks
+
+    return fetch_fused
+
+
+def solve_many(
+    total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+    eligible, ask, bw_ask, count: int, penalty: float,
+    job_distinct: bool = False, tg_distinct: bool = False,
+    exact_threshold: int = 128,
+):
+    """Synchronous wrapper over solve_many_async."""
+    fetch = solve_many_async(
+        total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+        eligible, ask, bw_ask, count, penalty,
+        job_distinct=job_distinct, tg_distinct=tg_distinct,
+        exact_threshold=exact_threshold,
+    )
+    return fetch()

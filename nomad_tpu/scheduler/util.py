@@ -81,6 +81,13 @@ def diff_allocs(
     """Set difference of target vs existing allocations
     (reference: util.go:54-131)."""
     result = DiffResult()
+
+    if not allocs:
+        # Fresh registration fast path: everything is a placement. Hot at
+        # bench scale (100k names); skips per-name membership checks.
+        result.place = [AllocTuple(name, tg) for name, tg in required.items()]
+        return result
+
     existing: Set[str] = set()
 
     for exist in allocs:

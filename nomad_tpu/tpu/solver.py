@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nomad_tpu.network import NetworkIndex
-from nomad_tpu.ops.binpack import solve_many
+from nomad_tpu.ops.binpack import solve_many_async
 from nomad_tpu.scheduler.context import EvalContext
 from nomad_tpu.scheduler.feasible import _has_distinct_hosts
 from nomad_tpu.scheduler.generic import GenericScheduler
@@ -56,6 +56,7 @@ from nomad_tpu.structs import (
     Resources,
     TaskGroup,
     generate_uuid,
+    generate_uuids,
 )
 from nomad_tpu.tpu.mirror import NodeMirror
 
@@ -115,29 +116,49 @@ class TPUStack:
 
     # -- core batched solve ------------------------------------------------
 
+    def solve_group(self, tg: TaskGroup, count: int, overlap=None):
+        """One batched device solve for ``count`` copies of a task group:
+        eligibility masks + usage tensorization + dispatch + readback. This
+        is the reformulated Stack.Select loop (stack.go:131-159) and the
+        north-star timed phase.
+
+        Returns (idxs, oks, size): numpy node indices / ok flags per copy
+        (idxs is None when the node set is empty). ``overlap``, if given, is
+        called between device dispatch and readback — independent host work
+        (uuid batches, name materialization) rides the transfer round-trip.
+        """
+        start = time.perf_counter()
+        tg_constr = task_group_constraints(tg)
+        prep = self.prepare(tg, tg_constr)
+        if prep is None:
+            if overlap is not None:
+                overlap()
+            self.ctx.metrics().allocation_time = time.perf_counter() - start
+            return None, None, tg_constr.size
+
+        fetch = solve_many_async(
+            self.mirror.total, self.mirror.sched_cap, prep.used,
+            prep.job_count, prep.tg_count, self.mirror.bw_avail, prep.bw_used,
+            prep.mask, prep.ask, prep.bw_ask, count, self.penalty,
+            job_distinct=prep.job_distinct, tg_distinct=prep.tg_distinct,
+        )
+        if overlap is not None:
+            overlap()
+        idxs, oks = fetch()
+        self.ctx.metrics().allocation_time = time.perf_counter() - start
+        return idxs, oks, tg_constr.size
+
     def select_many(self, tg: TaskGroup, count: int) -> Tuple[List[Optional[_Placement]], Resources]:
         """Place ``count`` copies of a task group in one batched device solve.
 
         Returns (placements, size): ``placements[i]`` is None when no node
         was found for the i-th copy.
         """
-        start = time.perf_counter()
-        tg_constr = task_group_constraints(tg)
-        prep = self.prepare(tg, tg_constr)
-        if prep is None:
-            self.ctx.metrics().allocation_time = time.perf_counter() - start
-            return [None] * count, tg_constr.size
-
-        idxs, oks = solve_many(
-            self.mirror.total, self.mirror.sched_cap, prep.used,
-            prep.job_count, prep.tg_count, self.mirror.bw_avail, prep.bw_used,
-            prep.mask, prep.ask, prep.bw_ask, count, self.penalty,
-            job_distinct=prep.job_distinct, tg_distinct=prep.tg_distinct,
-        )
-
+        idxs, oks, size = self.solve_group(tg, count)
+        if idxs is None:
+            return [None] * count, size
         placements = self._offer_networks(tg, idxs, oks)
-        self.ctx.metrics().allocation_time = time.perf_counter() - start
-        return placements, tg_constr.size
+        return placements, size
 
     def prepare(self, tg: TaskGroup, tg_constr) -> Optional["_SolveInputs"]:
         """Assemble the device inputs for one task group: eligibility mask,
@@ -269,7 +290,9 @@ class TPUGenericScheduler(GenericScheduler):
 
     def compute_placements(self, place: List[AllocTuple]) -> None:
         """Batched replacement of generic_sched.go:245-298: one solve per
-        task group instead of one Select per missing alloc."""
+        task group instead of one Select per missing alloc. Host-side object
+        assembly is lean: uuid batches overlap the device round-trip and
+        Allocations are stamped from a shared field template."""
         nodes = ready_nodes_in_dcs(self.state, self.job.datacenters)
         self.stack.set_nodes(nodes)
 
@@ -281,31 +304,97 @@ class TPUGenericScheduler(GenericScheduler):
 
         for tg, missing_list in groups.values():
             self.ctx.reset()
-            placements, size = self.stack.select_many(tg, len(missing_list))
+            count = len(missing_list)
+            uuids: List[str] = []
+
+            idxs, oks, size = self.stack.solve_group(
+                tg, count, overlap=lambda: uuids.extend(generate_uuids(count))
+            )
+
+            has_networks = any(
+                t.resources is not None and t.resources.networks for t in tg.tasks
+            )
+            if idxs is None:
+                placements: List[Optional[_Placement]] = [None] * count
+            elif has_networks:
+                # Sparse + sequential port assignment: host post-pass.
+                placements = self.stack._offer_networks(tg, idxs, oks)
+            else:
+                placements = None  # lean path below
+
+            metrics = self.ctx.metrics()
+            template = {
+                "id": "", "eval_id": self.eval.id, "name": "", "node_id": "",
+                "job_id": self.job.id, "job": self.job, "task_group": tg.name,
+                "resources": size, "task_resources": {}, "metrics": metrics,
+                "desired_status": ALLOC_DESIRED_STATUS_RUN,
+                "desired_description": "",
+                "client_status": ALLOC_CLIENT_STATUS_PENDING,
+                "client_description": "", "create_index": 0, "modify_index": 0,
+            }
             failed_alloc: Optional[Allocation] = None
 
-            for missing, placement in zip(missing_list, placements):
+            if placements is None:
+                # Lean path (no network asks): stamp Allocations straight
+                # from the solve indices. The fused solve returns indices
+                # grouped by node, so per-node plan lists build in runs.
+                # task_resources aliases the job spec like the reference's
+                # Select fallback (stack.go:150-154); treat as immutable.
+                shared_tr = {t.name: t.resources for t in tg.tasks}
+                template["task_resources"] = shared_tr
+                nodes_list = self.stack.mirror.nodes
+                n = self.stack.mirror.n
+                node_alloc = self.plan.node_allocation
+                run_node_id = None
+                run_list = None
+                for i, missing in enumerate(missing_list):
+                    idx = idxs[i]
+                    if oks[i] and 0 <= idx < n:
+                        node_id = nodes_list[idx].id
+                        alloc = object.__new__(Allocation)
+                        d = dict(template)
+                        d["id"] = uuids[i]
+                        d["name"] = missing.name
+                        d["node_id"] = node_id
+                        alloc.__dict__ = d
+                        if node_id != run_node_id:
+                            run_list = node_alloc.setdefault(node_id, [])
+                            run_node_id = node_id
+                        run_list.append(alloc)
+                    elif failed_alloc is not None:
+                        failed_alloc.metrics.coalesced_failures += 1
+                    else:
+                        alloc = object.__new__(Allocation)
+                        d = dict(template)
+                        d["id"] = uuids[i]
+                        d["name"] = missing.name
+                        d["task_resources"] = {}
+                        d["desired_status"] = ALLOC_DESIRED_STATUS_FAILED
+                        d["desired_description"] = (
+                            "failed to find a node for placement"
+                        )
+                        d["client_status"] = ALLOC_CLIENT_STATUS_FAILED
+                        alloc.__dict__ = d
+                        self.plan.append_failed(alloc)
+                        failed_alloc = alloc
+                continue
+
+            for i, (missing, placement) in enumerate(zip(missing_list, placements)):
                 if placement is None and failed_alloc is not None:
                     failed_alloc.metrics.coalesced_failures += 1
                     continue
 
-                alloc = Allocation(
-                    id=generate_uuid(),
-                    eval_id=self.eval.id,
-                    name=missing.name,
-                    job_id=self.job.id,
-                    job=self.job,
-                    task_group=tg.name,
-                    resources=size,
-                    metrics=self.ctx.metrics(),
-                )
+                alloc = object.__new__(Allocation)
+                d = dict(template)
+                d["id"] = uuids[i]
+                d["name"] = missing.name
+                alloc.__dict__ = d
                 if placement is not None:
                     alloc.node_id = placement[0].id
                     alloc.task_resources = placement[1]
-                    alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
-                    alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
                     self.plan.append_alloc(alloc)
                 else:
+                    alloc.task_resources = {}
                     alloc.desired_status = ALLOC_DESIRED_STATUS_FAILED
                     alloc.desired_description = "failed to find a node for placement"
                     alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
