@@ -176,6 +176,83 @@ def solve_rounds_fused(
     return counts, remaining
 
 
+@partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
+def solve_waterfill(
+    total: jnp.ndarray,
+    sched_cap: jnp.ndarray,
+    used0: jnp.ndarray,
+    job_count0: jnp.ndarray,
+    tg_count0: jnp.ndarray,
+    bw_avail: jnp.ndarray,
+    bw_used0: jnp.ndarray,
+    eligible: jnp.ndarray,
+    ask: jnp.ndarray,
+    bw_ask: jnp.ndarray,
+    count: jnp.ndarray,       # [] int32 total tasks to place
+    penalty: jnp.ndarray,
+    job_distinct: bool,
+    tg_distinct: bool,
+):
+    """Closed-form equivalent of ``solve_rounds_fused`` in one shot.
+
+    Every *full* round of the round solver selects ALL fitting nodes (the
+    argsort-free branch), so after L full rounds node i holds
+    ``min(cap_i, L)`` placements, where cap_i is its total capacity for this
+    ask. The final partial round takes the ``remaining`` best-scoring nodes
+    among those with cap > L. So: binary-search L, then one scored top-k —
+    no sequential state updates at all. Returns (counts[N], unplaced).
+    """
+    big = jnp.int32(2**30)
+
+    # Per-node capacity for this ask, in copies.
+    avail = total - used0
+    nonneg = jnp.all(avail >= 0, axis=-1) & (bw_used0 <= bw_avail)
+    safe_ask = jnp.maximum(ask, 1)[None, :]
+    dim_cap = jnp.where(ask[None, :] > 0, avail // safe_ask, big)
+    cap = jnp.min(dim_cap, axis=-1)
+    bw_cap = jnp.where(bw_ask > 0, (bw_avail - bw_used0) // jnp.maximum(bw_ask, 1), big)
+    cap = jnp.minimum(cap, bw_cap)
+    if job_distinct:
+        cap = jnp.minimum(cap, jnp.where(job_count0 == 0, 1, 0))
+    if tg_distinct:
+        cap = jnp.minimum(cap, jnp.where(tg_count0 == 0, 1, 0))
+    cap = jnp.where(eligible & nonneg, jnp.clip(cap, 0, count), 0).astype(jnp.int32)
+
+    # Largest L with sum(min(cap, L)) <= count.
+    def placed_at(level):
+        return jnp.minimum(cap, level).sum()
+
+    def bs_cond(c):
+        lo, hi = c
+        return lo < hi
+
+    def bs_body(c):
+        lo, hi = c
+        mid = (lo + hi + 1) // 2
+        ok = placed_at(mid) <= count
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1))
+
+    level, _ = lax.while_loop(bs_cond, bs_body, (jnp.int32(0), count))
+
+    base = jnp.minimum(cap, level)
+    remaining = count - base.sum()
+
+    # Partial round: top-`remaining` by score among nodes with headroom.
+    score, fit = _greedy_step_state(
+        total, sched_cap, used0 + base[:, None] * ask[None, :],
+        job_count0 + base, tg_count0 + base, bw_avail,
+        bw_used0 + base * bw_ask, eligible, ask, bw_ask, penalty,
+        job_distinct, tg_distinct,
+    )
+    candidates = fit & (cap > level)
+    n = total.shape[0]
+    order = jnp.argsort(-jnp.where(candidates, score, NEG_INF))
+    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    selected = candidates & (rank < remaining)
+    counts = base + selected.astype(jnp.int32)
+    return counts, count - counts.sum()
+
+
 def solve_many_async(
     total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
     eligible, ask, bw_ask, count: int, penalty: float,
@@ -211,10 +288,10 @@ def solve_many_async(
 
         return fetch_exact
 
-    # Fused round solver: one dispatch + one transfer for the whole batch.
-    # distinct_hosts needs no special-casing: the fit mask excludes nodes
-    # whose job/tg counts grew, so the loop drains and exits on no-progress.
-    counts_dev, _remaining = solve_rounds_fused(
+    # Water-fill solver: one dispatch + one transfer for the whole batch.
+    # distinct_hosts needs no special-casing: capacity is clamped to one
+    # copy on nodes without same-scope allocs, zero otherwise.
+    counts_dev, _remaining = solve_waterfill(
         total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
         eligible, ask, bw_ask, jnp.int32(count), jnp.float32(penalty),
         job_distinct, tg_distinct,

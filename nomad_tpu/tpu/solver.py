@@ -65,6 +65,20 @@ from nomad_tpu.tpu.mirror import NodeMirror
 # at bench scale (100k placements per eval) object construction is hot.
 _Placement = Tuple[Node, Dict[str, Resources]]
 
+_UUID_POOL = None
+
+
+def _uuid_pool():
+    """Single worker thread for id generation overlapped with device waits."""
+    global _UUID_POOL
+    if _UUID_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _UUID_POOL = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="nomad-uuid"
+        )
+    return _UUID_POOL
+
 
 class _SolveInputs:
     """Device inputs for one task-group solve, assembled by TPUStack.prepare."""
@@ -296,20 +310,31 @@ class TPUGenericScheduler(GenericScheduler):
         nodes = ready_nodes_in_dcs(self.state, self.job.datacenters)
         self.stack.set_nodes(nodes)
 
-        # Group the missing allocs by task group, preserving order.
-        groups: Dict[int, Tuple[TaskGroup, List[AllocTuple]]] = {}
-        for missing in place:
-            key = id(missing.task_group)
-            groups.setdefault(key, (missing.task_group, []))[1].append(missing)
+        # Group the missing allocs by task group. Diff output arrives in
+        # materialization order (all copies of one group contiguous), so
+        # run-slicing avoids 100k dict operations; out-of-order stragglers
+        # from rolling updates just start a new run for the same group.
+        groups: List[Tuple[TaskGroup, List[AllocTuple]]] = []
+        run_tg = None
+        run_start = 0
+        for i, missing in enumerate(place):
+            if missing.task_group is not run_tg:
+                if run_tg is not None:
+                    groups.append((run_tg, place[run_start:i]))
+                run_tg = missing.task_group
+                run_start = i
+        if run_tg is not None:
+            groups.append((run_tg, place[run_start:]))
 
-        for tg, missing_list in groups.values():
+        for tg, missing_list in groups:
             self.ctx.reset()
             count = len(missing_list)
-            uuids: List[str] = []
+            # Generate ids on a worker thread: it runs while this thread
+            # blocks (GIL released) in the device readback inside solve_group.
+            uuid_future = _uuid_pool().submit(generate_uuids, count)
 
-            idxs, oks, size = self.stack.solve_group(
-                tg, count, overlap=lambda: uuids.extend(generate_uuids(count))
-            )
+            idxs, oks, size = self.stack.solve_group(tg, count)
+            uuids = uuid_future.result()
 
             has_networks = any(
                 t.resources is not None and t.resources.networks for t in tg.tasks
@@ -347,6 +372,8 @@ class TPUGenericScheduler(GenericScheduler):
                 node_alloc = self.plan.node_allocation
                 run_node_id = None
                 run_list = None
+                idxs = idxs.tolist()
+                oks = oks.tolist()
                 for i, missing in enumerate(missing_list):
                     idx = idxs[i]
                     if oks[i] and 0 <= idx < n:

@@ -155,3 +155,56 @@ def test_differential_host_vs_tpu(seed):
 
     # The TPU global solve must place at least as many as the sampled host.
     assert results["tpu-service"] >= results["service"], results
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_waterfill_matches_round_solver(seed):
+    """solve_waterfill must reproduce solve_rounds_fused's per-node counts
+    exactly on random heterogeneous instances (it is the closed form of the
+    same semantics: L full rounds + one scored partial round)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nomad_tpu.ops.binpack import solve_rounds_fused, solve_waterfill
+
+    rng = random.Random(seed)
+    n = 64
+    total = np.zeros((n, 4), dtype=np.int32)
+    for i in range(n):
+        total[i] = [
+            rng.choice([500, 1000, 2000, 4000]),
+            rng.choice([512, 1024, 4096]),
+            50_000,
+            100,
+        ]
+    used0 = np.zeros((n, 4), dtype=np.int32)
+    for i in range(n):
+        if rng.random() < 0.3:
+            used0[i, 0] = rng.randrange(0, total[i, 0])
+            used0[i, 1] = rng.randrange(0, total[i, 1])
+    job_count0 = np.array([rng.choice([0, 0, 0, 1, 2]) for _ in range(n)], np.int32)
+    eligible = np.array([rng.random() < 0.9 for _ in range(n)])
+    count = rng.choice([5, 40, 300, 2000])
+    args = dict(
+        total=jnp.asarray(total),
+        sched_cap=jnp.asarray(total[:, :2].astype(np.float32)),
+        used0=jnp.asarray(used0),
+        job_count0=jnp.asarray(job_count0),
+        tg_count0=jnp.asarray(job_count0),
+        bw_avail=jnp.full((n,), 1000, jnp.int32),
+        bw_used0=jnp.zeros((n,), jnp.int32),
+        eligible=jnp.asarray(eligible),
+        ask=jnp.asarray(np.array([100, 128, 0, 0], np.int32)),
+        bw_ask=jnp.int32(0),
+        count=jnp.int32(count),
+        penalty=jnp.float32(5.0),
+    )
+    for job_distinct in (False, True):
+        c1, r1 = solve_rounds_fused(
+            *args.values(), job_distinct=job_distinct, tg_distinct=False
+        )
+        c2, r2 = solve_waterfill(
+            *args.values(), job_distinct=job_distinct, tg_distinct=False
+        )
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+        assert int(r1) == int(r2)
