@@ -27,7 +27,7 @@ from nomad_tpu.structs import (
 )
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocTuple:
     """(name, task group, existing alloc) tuple (reference: util.go:12-17)."""
 

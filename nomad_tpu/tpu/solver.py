@@ -372,15 +372,16 @@ class TPUGenericScheduler(GenericScheduler):
                 node_alloc = self.plan.node_allocation
                 run_node_id = None
                 run_list = None
-                idxs = idxs.tolist()
-                oks = oks.tolist()
-                for i, missing in enumerate(missing_list):
-                    idx = idxs[i]
-                    if oks[i] and 0 <= idx < n:
+                new = object.__new__
+                copy_t = template.copy
+                for missing, idx, ok, uid in zip(
+                    missing_list, idxs.tolist(), oks.tolist(), uuids
+                ):
+                    if ok and 0 <= idx < n:
                         node_id = nodes_list[idx].id
-                        alloc = object.__new__(Allocation)
-                        d = dict(template)
-                        d["id"] = uuids[i]
+                        alloc = new(Allocation)
+                        d = copy_t()
+                        d["id"] = uid
                         d["name"] = missing.name
                         d["node_id"] = node_id
                         alloc.__dict__ = d
@@ -391,9 +392,9 @@ class TPUGenericScheduler(GenericScheduler):
                     elif failed_alloc is not None:
                         failed_alloc.metrics.coalesced_failures += 1
                     else:
-                        alloc = object.__new__(Allocation)
-                        d = dict(template)
-                        d["id"] = uuids[i]
+                        alloc = new(Allocation)
+                        d = copy_t()
+                        d["id"] = uid
                         d["name"] = missing.name
                         d["task_resources"] = {}
                         d["desired_status"] = ALLOC_DESIRED_STATUS_FAILED
