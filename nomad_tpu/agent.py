@@ -34,6 +34,20 @@ class AgentConfig:
     client_options: Dict[str, str] = field(default_factory=dict)
     node_class: str = ""
     node_meta: Dict[str, str] = field(default_factory=dict)
+    client_servers: List[str] = field(default_factory=list)
+    client_state_dir: str = ""
+    client_alloc_dir: str = ""
+    num_schedulers: int = 0
+    enabled_schedulers: List[str] = field(default_factory=list)
+    bootstrap_expect: int = 0
+    enable_debug: bool = False
+    statsite_addr: str = ""
+    statsd_addr: str = ""
+    disable_hostname_metrics: bool = False
+    enable_syslog: bool = False
+    syslog_facility: str = "LOCAL0"
+    leave_on_interrupt: bool = False
+    leave_on_terminate: bool = False
 
     @classmethod
     def dev(cls) -> "AgentConfig":
@@ -47,6 +61,40 @@ class AgentConfig:
                 "driver.raw_exec.enable": "1",
                 "driver.mock_driver.enable": "1",
             },
+        )
+
+    @classmethod
+    def from_file_config(cls, fc) -> "AgentConfig":
+        """Convert a merged agent_config.FileConfig (agent.go:47-150 builds
+        nomad.Config/client.Config from the file config the same way)."""
+        return cls(
+            region=fc.region or "global",
+            datacenter=fc.datacenter or "dc1",
+            node_name=fc.name,
+            data_dir=fc.data_dir,
+            log_level=fc.log_level or "INFO",
+            http_host=fc.addresses.http or fc.bind_addr or "127.0.0.1",
+            http_port=fc.ports.http,
+            server_enabled=fc.server.enabled,
+            client_enabled=fc.client.enabled,
+            scheduler_backend=fc.scheduler_backend or "tpu",
+            client_options=dict(fc.client.options),
+            node_class=fc.client.node_class,
+            node_meta=dict(fc.client.meta),
+            client_servers=list(fc.client.servers),
+            client_state_dir=fc.client.state_dir,
+            client_alloc_dir=fc.client.alloc_dir,
+            num_schedulers=fc.server.num_schedulers,
+            enabled_schedulers=list(fc.server.enabled_schedulers),
+            bootstrap_expect=fc.server.bootstrap_expect,
+            enable_debug=fc.enable_debug,
+            statsite_addr=fc.telemetry.statsite_address,
+            statsd_addr=fc.telemetry.statsd_address,
+            disable_hostname_metrics=fc.telemetry.disable_hostname,
+            enable_syslog=fc.enable_syslog,
+            syslog_facility=fc.syslog_facility,
+            leave_on_interrupt=fc.leave_on_interrupt,
+            leave_on_terminate=fc.leave_on_terminate,
         )
 
 
@@ -68,15 +116,19 @@ class Agent:
 
     def _setup_server(self) -> None:
         """agent.go:153-173"""
-        self.server = Server(
-            ServerConfig(
-                region=self.config.region,
-                datacenter=self.config.datacenter,
-                node_name=self.config.node_name or "server",
-                scheduler_backend=self.config.scheduler_backend,
-            ),
-            logger=self.logger.getChild("server"),
+        server_config = ServerConfig(
+            region=self.config.region,
+            datacenter=self.config.datacenter,
+            node_name=self.config.node_name or "server",
+            scheduler_backend=self.config.scheduler_backend,
         )
+        if self.config.num_schedulers:
+            server_config.num_schedulers = self.config.num_schedulers
+        if self.config.enabled_schedulers:
+            server_config.enabled_schedulers = list(
+                self.config.enabled_schedulers
+            )
+        self.server = Server(server_config, logger=self.logger.getChild("server"))
 
     def _setup_client(self) -> None:
         """agent.go:175-201"""
@@ -88,8 +140,10 @@ class Agent:
         data_dir = self.config.data_dir or "/tmp/nomad-tpu-agent"
         self.client_config = ClientConfig(
             dev_mode=self.config.dev_mode,
-            state_dir=os.path.join(data_dir, "client"),
-            alloc_dir=os.path.join(data_dir, "allocs"),
+            state_dir=self.config.client_state_dir
+            or os.path.join(data_dir, "client"),
+            alloc_dir=self.config.client_alloc_dir
+            or os.path.join(data_dir, "allocs"),
             region=self.config.region,
             datacenter=self.config.datacenter,
             node_name=self.config.node_name,
@@ -99,9 +153,43 @@ class Agent:
             rpc_handler=self.server,
         )
 
+    def setup_telemetry(self) -> None:
+        """Metrics sinks + SIGUSR1 dump (command/agent/command.go:486-520)."""
+        import threading
+
+        from nomad_tpu import telemetry
+
+        inmem, sink = telemetry.build_sink(
+            statsite_addr=self.config.statsite_addr,
+            statsd_addr=self.config.statsd_addr,
+        )
+        self.inmem_sink = inmem
+        telemetry.set_global(
+            telemetry.Metrics(
+                sink,
+                service="nomad",
+                enable_hostname=not self.config.disable_hostname_metrics,
+            )
+        )
+        if threading.current_thread() is threading.main_thread():
+            telemetry.setup_signal_dump(inmem)
+
+    def setup_logging(self) -> None:
+        """Level gate + circular stream buffer + optional syslog."""
+        from nomad_tpu.logbuf import setup_agent_logging
+
+        self.log_writer = setup_agent_logging(
+            log_level=self.config.log_level,
+            enable_syslog=self.config.enable_syslog,
+        )
+
     def start(self) -> None:
         from nomad_tpu.api.http import HTTPServer
 
+        if getattr(self, "log_writer", None) is None:
+            self.setup_logging()
+        if getattr(self, "inmem_sink", None) is None:
+            self.setup_telemetry()
         if self.server is not None:
             self.server.start()
         if self.config.client_enabled:

@@ -1,0 +1,330 @@
+"""Agent configuration files: HCL/JSON parsing + merge semantics.
+
+Reference: /root/reference/command/agent/config.go (624 LoC) — the agent
+reads any number of config files/directories given with ``-config``; later
+files override earlier ones field-by-field, maps merge key-by-key, and CLI
+flags override files. Blocks: ports, addresses, advertise, client, server,
+telemetry, atlas.
+
+The HCL dialect is the same one job specs use, so this reuses
+``nomad_tpu.jobspec.hcl``; ``.json`` files parse with the stdlib.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nomad_tpu.jobspec.hcl import Body, parse as parse_hcl
+
+
+@dataclass
+class Ports:
+    """config.go Ports block."""
+
+    http: int = 4646
+    rpc: int = 4647
+    serf: int = 4648
+
+
+@dataclass
+class Addresses:
+    """Bind overrides per subsystem (config.go Addresses block)."""
+
+    http: str = ""
+    rpc: str = ""
+    serf: str = ""
+
+
+@dataclass
+class AdvertiseAddrs:
+    """Addresses advertised to peers (config.go AdvertiseAddrs block)."""
+
+    rpc: str = ""
+    serf: str = ""
+
+
+@dataclass
+class ClientBlock:
+    """config.go ClientConfig block."""
+
+    enabled: bool = False
+    state_dir: str = ""
+    alloc_dir: str = ""
+    servers: List[str] = field(default_factory=list)
+    node_class: str = ""
+    node_id: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    options: Dict[str, str] = field(default_factory=dict)
+    network_interface: str = ""
+    network_speed: int = 0
+
+
+@dataclass
+class ServerBlock:
+    """config.go ServerConfig block."""
+
+    enabled: bool = False
+    bootstrap_expect: int = 0
+    data_dir: str = ""
+    protocol_version: int = 0
+    num_schedulers: int = 0
+    enabled_schedulers: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Telemetry:
+    """config.go Telemetry block."""
+
+    statsite_address: str = ""
+    statsd_address: str = ""
+    disable_hostname: bool = False
+
+
+@dataclass
+class Atlas:
+    """config.go AtlasConfig block. Parsed for config compatibility; the
+    SCADA uplink itself (command/agent/scada.go dials HashiCorp infra) is
+    intentionally not implemented — see nomad_tpu.scada."""
+
+    infrastructure: str = ""
+    token: str = ""
+    join: bool = False
+    endpoint: str = ""
+
+
+@dataclass
+class FileConfig:
+    """Full agent config-file surface (config.go Config struct)."""
+
+    region: str = ""
+    datacenter: str = ""
+    name: str = ""
+    data_dir: str = ""
+    log_level: str = ""
+    bind_addr: str = ""
+    enable_debug: bool = False
+    ports: Ports = field(default_factory=Ports)
+    addresses: Addresses = field(default_factory=Addresses)
+    advertise: AdvertiseAddrs = field(default_factory=AdvertiseAddrs)
+    client: ClientBlock = field(default_factory=ClientBlock)
+    server: ServerBlock = field(default_factory=ServerBlock)
+    telemetry: Telemetry = field(default_factory=Telemetry)
+    atlas: Atlas = field(default_factory=Atlas)
+    leave_on_interrupt: bool = False
+    leave_on_terminate: bool = False
+    enable_syslog: bool = False
+    syslog_facility: str = "LOCAL0"
+    disable_update_check: bool = False
+    scheduler_backend: str = ""  # tpu-native extension: 'tpu' | 'host'
+
+    # -- merge ------------------------------------------------------------
+
+    def merge(self, other: "FileConfig") -> "FileConfig":
+        """Field-by-field override by ``other`` (config.go Merge): scalars
+        override when set, maps/lists merge/extend, nested blocks recurse."""
+        out = FileConfig()
+        for name in (
+            "region", "datacenter", "name", "data_dir", "log_level",
+            "bind_addr", "syslog_facility", "scheduler_backend",
+        ):
+            setattr(out, name, getattr(other, name) or getattr(self, name))
+        for name in (
+            "enable_debug", "leave_on_interrupt", "leave_on_terminate",
+            "enable_syslog", "disable_update_check",
+        ):
+            setattr(out, name, getattr(other, name) or getattr(self, name))
+
+        out.ports = Ports(
+            http=other.ports.http if other.ports.http != 4646 else self.ports.http,
+            rpc=other.ports.rpc if other.ports.rpc != 4647 else self.ports.rpc,
+            serf=other.ports.serf if other.ports.serf != 4648 else self.ports.serf,
+        )
+        out.addresses = Addresses(
+            http=other.addresses.http or self.addresses.http,
+            rpc=other.addresses.rpc or self.addresses.rpc,
+            serf=other.addresses.serf or self.addresses.serf,
+        )
+        out.advertise = AdvertiseAddrs(
+            rpc=other.advertise.rpc or self.advertise.rpc,
+            serf=other.advertise.serf or self.advertise.serf,
+        )
+        out.client = ClientBlock(
+            enabled=other.client.enabled or self.client.enabled,
+            state_dir=other.client.state_dir or self.client.state_dir,
+            alloc_dir=other.client.alloc_dir or self.client.alloc_dir,
+            servers=self.client.servers + [
+                s for s in other.client.servers if s not in self.client.servers
+            ],
+            node_class=other.client.node_class or self.client.node_class,
+            node_id=other.client.node_id or self.client.node_id,
+            meta={**self.client.meta, **other.client.meta},
+            options={**self.client.options, **other.client.options},
+            network_interface=(
+                other.client.network_interface or self.client.network_interface
+            ),
+            network_speed=other.client.network_speed or self.client.network_speed,
+        )
+        out.server = ServerBlock(
+            enabled=other.server.enabled or self.server.enabled,
+            bootstrap_expect=(
+                other.server.bootstrap_expect or self.server.bootstrap_expect
+            ),
+            data_dir=other.server.data_dir or self.server.data_dir,
+            protocol_version=(
+                other.server.protocol_version or self.server.protocol_version
+            ),
+            num_schedulers=other.server.num_schedulers or self.server.num_schedulers,
+            enabled_schedulers=(
+                other.server.enabled_schedulers or self.server.enabled_schedulers
+            ),
+        )
+        out.telemetry = Telemetry(
+            statsite_address=(
+                other.telemetry.statsite_address or self.telemetry.statsite_address
+            ),
+            statsd_address=(
+                other.telemetry.statsd_address or self.telemetry.statsd_address
+            ),
+            disable_hostname=(
+                other.telemetry.disable_hostname or self.telemetry.disable_hostname
+            ),
+        )
+        out.atlas = Atlas(
+            infrastructure=other.atlas.infrastructure or self.atlas.infrastructure,
+            token=other.atlas.token or self.atlas.token,
+            join=other.atlas.join or self.atlas.join,
+            endpoint=other.atlas.endpoint or self.atlas.endpoint,
+        )
+        return out
+
+
+def default_config() -> FileConfig:
+    """config.go DefaultConfig."""
+    cfg = FileConfig()
+    cfg.region = "global"
+    cfg.datacenter = "dc1"
+    cfg.log_level = "INFO"
+    cfg.bind_addr = "127.0.0.1"
+    return cfg
+
+
+def dev_config() -> FileConfig:
+    """config.go DevConfig: server + client in one process, permissive
+    driver options."""
+    cfg = default_config()
+    cfg.name = "dev-node"
+    cfg.server.enabled = True
+    cfg.server.bootstrap_expect = 1
+    cfg.client.enabled = True
+    cfg.client.options = {
+        "driver.raw_exec.enable": "1",
+        "driver.mock_driver.enable": "1",
+    }
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Parsing
+# ---------------------------------------------------------------------------
+
+
+def _from_mapping(data: dict) -> FileConfig:
+    cfg = FileConfig()
+    scalars = {
+        "region", "datacenter", "name", "data_dir", "log_level", "bind_addr",
+        "enable_debug", "leave_on_interrupt", "leave_on_terminate",
+        "enable_syslog", "syslog_facility", "disable_update_check",
+        "scheduler_backend",
+    }
+    for key, value in data.items():
+        if key in scalars:
+            setattr(cfg, key, value)
+        elif key == "ports":
+            for k, v in value.items():
+                setattr(cfg.ports, k, int(v))
+        elif key == "addresses":
+            for k, v in value.items():
+                setattr(cfg.addresses, k, v)
+        elif key == "advertise":
+            for k, v in value.items():
+                setattr(cfg.advertise, k, v)
+        elif key == "client":
+            for k, v in value.items():
+                if k in ("meta", "options"):
+                    getattr(cfg.client, k).update(
+                        {str(mk): str(mv) for mk, mv in v.items()}
+                    )
+                elif k == "servers":
+                    cfg.client.servers = list(v)
+                elif k == "network_speed":
+                    cfg.client.network_speed = int(v)
+                else:
+                    setattr(cfg.client, k, v)
+        elif key == "server":
+            for k, v in value.items():
+                if k == "enabled_schedulers":
+                    cfg.server.enabled_schedulers = list(v)
+                elif k in ("bootstrap_expect", "protocol_version", "num_schedulers"):
+                    setattr(cfg.server, k, int(v))
+                else:
+                    setattr(cfg.server, k, v)
+        elif key == "telemetry":
+            for k, v in value.items():
+                setattr(cfg.telemetry, k, v)
+        elif key == "atlas":
+            for k, v in value.items():
+                setattr(cfg.atlas, k, v)
+        else:
+            raise ValueError(f"unknown agent config key {key!r}")
+    return cfg
+
+
+def _body_to_mapping(body: Body) -> dict:
+    """Collapse the generic HCL AST into the JSON-equivalent mapping:
+    repeated blocks merge, block labels are invalid for agent config."""
+    out: dict = dict(body.assigns())
+    from nomad_tpu.jobspec.hcl import Block
+
+    for item in body.items:
+        if isinstance(item, Block):
+            if item.labels:
+                raise ValueError(
+                    f"agent config block {item.type!r} takes no labels"
+                )
+            sub = _body_to_mapping(item.body)
+            if item.type in out and isinstance(out[item.type], dict):
+                out[item.type].update(sub)
+            else:
+                out[item.type] = sub
+    return out
+
+
+def parse_config(text: str, name: str = "<config>") -> FileConfig:
+    """Parse one config file's text: JSON if it looks like JSON, else HCL."""
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        return _from_mapping(json.loads(text))
+    return _from_mapping(_body_to_mapping(parse_hcl(text)))
+
+
+def load_config_file(path: str) -> FileConfig:
+    with open(path, "r") as fh:
+        return parse_config(fh.read(), name=path)
+
+
+def load_config_path(path: str) -> FileConfig:
+    """File or directory (directories load *.hcl / *.json sorted by name,
+    like config.go LoadConfigDir)."""
+    if os.path.isdir(path):
+        cfg = FileConfig()
+        entries = sorted(
+            e for e in os.listdir(path)
+            if e.endswith(".hcl") or e.endswith(".json")
+        )
+        for entry in entries:
+            cfg = cfg.merge(load_config_file(os.path.join(path, entry)))
+        return cfg
+    return load_config_file(path)

@@ -77,6 +77,7 @@ class HTTPServer:
             (r"^/v1/evaluation/(?P<eval_id>[^/]+)/allocations$",
              self.eval_allocations),
             (r"^/v1/agent/self$", self.agent_self),
+            (r"^/v1/agent/logs$", self.agent_logs),
             (r"^/v1/agent/members$", self.agent_members),
             (r"^/v1/agent/servers$", self.agent_servers),
             (r"^/v1/agent/join$", self.agent_join),
@@ -317,6 +318,19 @@ class HTTPServer:
 
     def agent_self(self, req, query) -> Tuple[Any, Optional[int]]:
         return self.agent.self_info(), None
+
+    def agent_logs(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Tail of the agent's circular log buffer (the reference streams
+        the same buffer to `nomad monitor`, command/agent/log_writer.go)."""
+        writer = getattr(self.agent, "log_writer", None)
+        lines = writer.tail() if writer is not None else []
+        try:
+            n = int(query.get("n", "0"))
+        except ValueError:
+            n = 0
+        if n > 0:
+            lines = lines[-n:]
+        return {"lines": lines}, None
 
     def agent_members(self, req, query) -> Tuple[Any, Optional[int]]:
         return self.agent.members(), None

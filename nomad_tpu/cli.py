@@ -100,6 +100,38 @@ def _monitor_eval(client: ApiClient, eval_id: str, timeout: float = 60.0) -> int
 # -- commands ---------------------------------------------------------------
 
 
+def _read_agent_config(args):
+    """Merged config: defaults <- (-dev) <- config files/dirs <- CLI flags
+    (reference: command/agent/command.go readConfig)."""
+    from nomad_tpu import agent_config as ac
+
+    config = ac.dev_config() if args.dev else ac.default_config()
+    for path in args.config or []:
+        config = config.merge(ac.load_config_path(path))
+
+    flags = ac.FileConfig()
+    flags.data_dir = args.data_dir
+    flags.log_level = "" if args.log_level == "INFO" else args.log_level
+    flags.bind_addr = args.bind
+    flags.region = args.region
+    flags.datacenter = args.dc
+    flags.name = args.node
+    flags.server.enabled = args.server
+    flags.client.enabled = args.client
+    flags.scheduler_backend = (
+        "" if args.scheduler_backend == "tpu" else args.scheduler_backend
+    )
+    if args.http_port != 4646:
+        flags.ports.http = args.http_port
+    config = config.merge(flags)
+
+    if config.atlas.infrastructure:
+        from nomad_tpu.scada import scada_unavailable_reason
+
+        print(f"==> Atlas/SCADA disabled: {scada_unavailable_reason()}")
+    return config
+
+
 def cmd_agent(args) -> int:
     """reference: command/agent/command.go"""
     import logging
@@ -110,17 +142,10 @@ def cmd_agent(args) -> int:
     )
     from nomad_tpu.agent import Agent, AgentConfig
 
+    file_config = _read_agent_config(args)
+    config = AgentConfig.from_file_config(file_config)
     if args.dev:
-        config = AgentConfig.dev()
-    else:
-        config = AgentConfig(
-            server_enabled=args.server,
-            client_enabled=args.client,
-        )
-    if args.data_dir:
-        config.data_dir = args.data_dir
-    config.http_port = args.http_port
-    config.scheduler_backend = args.scheduler_backend
+        config.dev_mode = True
 
     agent = Agent(config)
     agent.start()
@@ -128,6 +153,9 @@ def cmd_agent(args) -> int:
     print(f"    Server: {agent.server is not None}, "
           f"Client: {agent.client is not None}, "
           f"Scheduler backend: {config.scheduler_backend}")
+    if config.statsite_addr or config.statsd_addr:
+        print(f"    Telemetry: statsite={config.statsite_addr or '-'} "
+              f"statsd={config.statsd_addr or '-'}")
 
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -357,6 +385,43 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_server_join(args) -> int:
+    """reference: command/server_join.go"""
+    client = _client(args)
+    n = client.agent().join(args.addr)
+    print(f"Joined {n} servers successfully")
+    return 0
+
+
+def cmd_server_force_leave(args) -> int:
+    """reference: command/server_force_leave.go"""
+    client = _client(args)
+    client.agent().force_leave(args.node)
+    return 0
+
+
+def cmd_client_config(args) -> int:
+    """reference: command/client_config.go — view the client's known
+    servers (the 0.1.2-era command surfaces -servers only)."""
+    client = _client(args)
+    if not args.servers:
+        print("Must specify -servers")
+        return 1
+    servers, _ = client.query("/v1/agent/servers")
+    for server in servers:
+        print(server)
+    return 0
+
+
+def cmd_spawn_daemon(args) -> int:
+    """reference: command/spawn_daemon.go — internal plumbing command; the
+    exec/raw_exec drivers re-exec this to double-fork user tasks so they
+    survive agent restarts."""
+    from nomad_tpu.client.driver.spawn import _daemon_main
+
+    return _daemon_main(args.spec)
+
+
 def make_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="nomad-tpu",
@@ -373,7 +438,14 @@ def make_parser() -> argparse.ArgumentParser:
                    help="Dev mode: in-memory server + client")
     p.add_argument("-server", dest="server", action="store_true")
     p.add_argument("-client", dest="client", action="store_true")
+    p.add_argument("-config", dest="config", action="append", default=[],
+                   help="Config file or directory (repeatable; later "
+                        "files override earlier)")
     p.add_argument("-data-dir", dest="data_dir", default="")
+    p.add_argument("-bind", dest="bind", default="")
+    p.add_argument("-region", dest="region", default="")
+    p.add_argument("-dc", dest="dc", default="")
+    p.add_argument("-node", dest="node", default="")
     p.add_argument("-http-port", dest="http_port", type=int, default=4646)
     p.add_argument("-log-level", dest="log_level", default="INFO")
     p.add_argument("-scheduler-backend", dest="scheduler_backend",
@@ -424,6 +496,25 @@ def make_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("server-members", help="Display the server membership")
     p.set_defaults(func=cmd_server_members)
+
+    p = sub.add_parser("server-join", help="Join the local server to a cluster")
+    p.add_argument("addr")
+    p.set_defaults(func=cmd_server_join)
+
+    p = sub.add_parser("server-force-leave",
+                       help="Force a server into the 'left' state")
+    p.add_argument("node")
+    p.set_defaults(func=cmd_server_force_leave)
+
+    p = sub.add_parser("client-config", help="View client configuration")
+    p.add_argument("-servers", dest="servers", action="store_true",
+                   help="List the known server addresses")
+    p.set_defaults(func=cmd_client_config)
+
+    p = sub.add_parser("spawn-daemon",
+                       help="Internal: daemonize a task (used by drivers)")
+    p.add_argument("spec", help="JSON spawn spec")
+    p.set_defaults(func=cmd_spawn_daemon)
 
     p = sub.add_parser("version", help="Print the version")
     p.set_defaults(func=cmd_version)

@@ -1,0 +1,23 @@
+"""Atlas/SCADA stub.
+
+Reference: /root/reference/command/agent/scada.go — dials HashiCorp's Atlas
+infrastructure and exposes the agent HTTP API over a yamux tunnel so the
+hosted dashboard can reach it (scada.go:26-60, listener shim :76-195).
+
+That capability is deliberately not reproduced: it exists solely to uplink
+to a third-party SaaS endpoint (scada.hashicorp.com), which a cluster
+scheduler deployment on TPU pods has no use for and which this build's
+environment cannot reach. The ``atlas`` config block still parses
+(nomad_tpu.agent_config.Atlas) so reference configs load unchanged; when it
+is set, the agent logs why the uplink is off.
+"""
+
+from __future__ import annotations
+
+
+def scada_unavailable_reason() -> str:
+    return (
+        "the Atlas/SCADA uplink (a tunnel to HashiCorp's hosted dashboard) "
+        "is not implemented in nomad-tpu; the atlas config block is parsed "
+        "and ignored"
+    )

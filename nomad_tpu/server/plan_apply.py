@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 from nomad_tpu.server.eval_broker import BrokerError, EvalBroker
+from nomad_tpu import telemetry
 from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
 from nomad_tpu.structs import (
     Allocation,
@@ -142,7 +144,9 @@ class PlanApplier(threading.Thread):
             if wait_event is None or snap is None:
                 snap = self.state_store.snapshot()
 
+            t0 = time.perf_counter()
             result = evaluate_plan(snap, pending.plan)
+            telemetry.measure_since(("plan", "evaluate"), t0)
 
             if result.is_noop():
                 pending.respond(result, None)
@@ -167,8 +171,10 @@ class PlanApplier(threading.Thread):
     def _apply(self, result: PlanResult, snap):
         """Dispatch the replicated alloc update + optimistic snapshot apply
         (plan_apply.go:119-144)."""
+        t0 = time.perf_counter()
         allocs = _flatten_result(result)
         future = self.raft.apply("alloc_update", {"allocs": allocs})
+        telemetry.measure_since(("plan", "submit"), t0)
         if snap is not None:
             # Stamp the optimistic snapshot with the entry's real index: with
             # a synchronous replication layer the future is already resolved;

@@ -133,6 +133,10 @@ class Server:
             name="failed-eval-reaper",
         )
         reaper.start()
+        emitter = threading.Thread(
+            target=self._emit_stats, daemon=True, name="stats-emitter",
+        )
+        emitter.start()
 
     def shutdown(self) -> None:
         self._periodic_stop.set()
@@ -142,6 +146,36 @@ class Server:
         self.plan_queue.set_enabled(False)
         self.eval_broker.set_enabled(False)
         self.heartbeat.clear_all()
+
+    def _emit_stats(self) -> None:
+        """Periodic telemetry gauges at 1 Hz (server.go:213-228 EmitStats ->
+        eval_broker.go:557-575, plan_queue.go:198-209, heartbeat.go:135-148)."""
+        from nomad_tpu import telemetry
+
+        while not self._periodic_stop.wait(1.0):
+            broker = self.eval_broker.snapshot_stats()
+            telemetry.set_gauge(
+                ("broker", "total_ready"), broker.total_ready
+            )
+            telemetry.set_gauge(
+                ("broker", "total_unacked"), broker.total_unacked
+            )
+            telemetry.set_gauge(
+                ("broker", "total_blocked"), broker.total_blocked
+            )
+            for queue, stats in broker.by_scheduler.items():
+                telemetry.set_gauge(
+                    ("broker", queue, "ready"), stats.ready
+                )
+                telemetry.set_gauge(
+                    ("broker", queue, "unacked"), stats.unacked
+                )
+            telemetry.set_gauge(
+                ("plan", "queue_depth"), self.plan_queue.depth()
+            )
+            telemetry.set_gauge(
+                ("heartbeat", "active"), self.heartbeat.num_timers()
+            )
 
     def restore_eval_broker(self) -> None:
         """Re-enqueue non-terminal evals after (re)gaining leadership

@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Optional, Tuple
 
+from nomad_tpu import telemetry
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.server.eval_broker import BrokerError
 from nomad_tpu.structs import JOB_TYPE_CORE, Evaluation, Plan, PlanResult
@@ -75,6 +76,7 @@ class Worker(threading.Thread):
     # -- internals ---------------------------------------------------------
 
     def _dequeue_evaluation(self) -> Optional[Tuple[Evaluation, str]]:
+        start = time.perf_counter()
         try:
             ev, token = self.server.eval_dequeue(
                 self.server.config.enabled_schedulers, timeout=DEQUEUE_TIMEOUT
@@ -89,11 +91,13 @@ class Worker(threading.Thread):
             return None
         if ev is None:
             return None
+        telemetry.measure_since(("worker", "dequeue_eval"), start)
         self.logger.debug("dequeued evaluation %s", ev.id)
         return ev, token
 
     def _send_ack(self, eval_id: str, token: str, ack: bool) -> None:
         """Best effort ack/nack (worker.go:172-202)."""
+        start = time.perf_counter()
         try:
             if ack:
                 self.server.eval_ack(eval_id, token)
@@ -104,13 +108,20 @@ class Worker(threading.Thread):
                 "failed to %s evaluation '%s': %s", "ack" if ack else "nack",
                 eval_id, e,
             )
+        else:
+            telemetry.measure_since(
+                ("worker", "send_ack" if ack else "send_nack"), start
+            )
 
     def _wait_for_index(self, index: int, timeout: float) -> None:
-        """Spin until the FSM has applied ``index`` (worker.go:204-230)."""
+        """Spin until the FSM has applied ``index`` (worker.go:204-230).
+        Timing recorded as nomad.worker.wait_for_index (worker.go:212)."""
+        t0 = time.perf_counter()
         start = time.monotonic()
         delay = 0.001
         while True:
             if self.server.raft.applied_index >= index:
+                telemetry.measure_since(("worker", "wait_for_index"), t0)
                 return
             if time.monotonic() - start > timeout:
                 raise TimeoutError("sync wait timeout reached")
@@ -119,6 +130,7 @@ class Worker(threading.Thread):
 
     def _invoke_scheduler(self, ev: Evaluation, token: str) -> bool:
         """worker.go:232-261"""
+        start = time.perf_counter()
         self.eval_token = token
         self._snapshot = self.server.state_store.snapshot()
         try:
@@ -130,6 +142,7 @@ class Worker(threading.Thread):
                 factory = self.server.config.scheduler_factory(ev.type)
                 sched = new_scheduler(factory, self._snapshot, self, self.logger)
             sched.process(ev)
+            telemetry.measure_since(("worker", "invoke_scheduler", ev.type), start)
             return True
         except Exception:
             self.logger.exception("failed to process evaluation %s", ev.id)
@@ -138,8 +151,10 @@ class Worker(threading.Thread):
     # -- Planner interface (worker.go:263-396) ------------------------------
 
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        start = time.perf_counter()
         plan.eval_token = self.eval_token
         result = self.server.plan_submit(plan)
+        telemetry.measure_since(("worker", "submit_plan"), start)
 
         new_state = None
         if result.refresh_index != 0:

@@ -1,0 +1,331 @@
+"""Telemetry: metrics sinks + timing instrumentation.
+
+The reference instruments every hot path with armon/go-metrics —
+``defer metrics.MeasureSince(...)`` in the worker (reference:
+nomad/worker.go:147,175,234,270), plan applier (nomad/plan_apply.go:149,168),
+FSM applies (nomad/fsm.go:148) and RPC counters (nomad/rpc.go:68,153-157) —
+fanned out to an in-memory sink (SIGUSR1 dump) plus optional statsite/statsd
+sinks configured at agent startup (command/agent/command.go:486-520).
+
+This module reproduces that surface: ``Metrics`` front with
+set_gauge / incr_counter / add_sample / measure_since, an interval-aggregated
+``InmemSink`` with a signal dump, UDP ``StatsdSink``, TCP ``StatsiteSink``,
+``FanoutSink``, and a module-level global like go-metrics' default registry.
+"""
+
+from __future__ import annotations
+
+import math
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+Key = Tuple[str, ...]
+
+
+def _flat(key: Key) -> str:
+    return ".".join(str(p) for p in key)
+
+
+class AggregateSample:
+    """Streaming aggregate of one sample series within an interval
+    (go-metrics inmem.go AggregateSample)."""
+
+    __slots__ = ("count", "sum", "sum_sq", "min", "max", "last", "last_time")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.sum_sq = 0.0
+        self.min = 0.0
+        self.max = 0.0
+        self.last = 0.0
+        self.last_time = 0.0
+
+    def ingest(self, v: float) -> None:
+        if self.count == 0 or v < self.min:
+            self.min = v
+        if self.count == 0 or v > self.max:
+            self.max = v
+        self.count += 1
+        self.sum += v
+        self.sum_sq += v * v
+        self.last = v
+        self.last_time = time.time()
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    @property
+    def stddev(self) -> float:
+        if self.count < 2:
+            return 0.0
+        var = (self.sum_sq - self.sum * self.sum / self.count) / (self.count - 1)
+        return math.sqrt(var) if var > 0 else 0.0
+
+    def __repr__(self) -> str:
+        return (
+            f"Count: {self.count} Sum: {self.sum:.3f} "
+            f"Min: {self.min:.3f} Mean: {self.mean:.3f} Max: {self.max:.3f} "
+            f"Stddev: {self.stddev:.3f}"
+        )
+
+
+class IntervalMetrics:
+    """One aggregation interval of the in-memory sink."""
+
+    def __init__(self, interval_start: float):
+        self.interval = interval_start
+        self.gauges: Dict[str, float] = {}
+        self.counters: Dict[str, AggregateSample] = {}
+        self.samples: Dict[str, AggregateSample] = {}
+
+
+class InmemSink:
+    """Ring of aggregation intervals (go-metrics inmem.go), dumpable on
+    SIGUSR1 via :func:`setup_signal_dump`."""
+
+    def __init__(self, interval: float = 10.0, retain: float = 60.0):
+        self.interval = interval
+        self.max_intervals = max(1, int(retain / interval))
+        self.intervals: List[IntervalMetrics] = []
+        self._lock = threading.Lock()
+
+    def _current(self) -> IntervalMetrics:
+        now = time.time()
+        start = now - (now % self.interval)
+        if self.intervals and self.intervals[-1].interval == start:
+            return self.intervals[-1]
+        cur = IntervalMetrics(start)
+        self.intervals.append(cur)
+        if len(self.intervals) > self.max_intervals:
+            self.intervals.pop(0)
+        return cur
+
+    def set_gauge(self, key: Key, value: float) -> None:
+        with self._lock:
+            self._current().gauges[_flat(key)] = value
+
+    def incr_counter(self, key: Key, value: float) -> None:
+        with self._lock:
+            cur = self._current()
+            agg = cur.counters.get(_flat(key))
+            if agg is None:
+                agg = cur.counters[_flat(key)] = AggregateSample()
+            agg.ingest(value)
+
+    def add_sample(self, key: Key, value: float) -> None:
+        with self._lock:
+            cur = self._current()
+            agg = cur.samples.get(_flat(key))
+            if agg is None:
+                agg = cur.samples[_flat(key)] = AggregateSample()
+            agg.ingest(value)
+
+    def dump(self, out=None) -> str:
+        """Formatted dump of all retained intervals (inmem_signal.go)."""
+        lines: List[str] = []
+        with self._lock:
+            for ivl in self.intervals:
+                stamp = time.strftime(
+                    "%Y-%m-%d %H:%M:%S", time.localtime(ivl.interval)
+                )
+                for name, value in sorted(ivl.gauges.items()):
+                    lines.append(f"[{stamp}] [G] '{name}': {value:.3f}")
+                for name, agg in sorted(ivl.counters.items()):
+                    lines.append(f"[{stamp}] [C] '{name}': {agg!r}")
+                for name, agg in sorted(ivl.samples.items()):
+                    lines.append(f"[{stamp}] [S] '{name}': {agg!r}")
+        text = "\n".join(lines)
+        if out is not None:
+            print(text, file=out)
+        return text
+
+
+class StatsdSink:
+    """Push metrics to a statsd daemon over UDP (go-metrics statsd.go)."""
+
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _emit(self, key: Key, value: float, kind: str) -> None:
+        try:
+            self._sock.sendto(
+                f"{_flat(key)}:{value:f}|{kind}".encode(), self.addr
+            )
+        except OSError:  # pragma: no cover - fire and forget
+            pass
+
+    def set_gauge(self, key: Key, value: float) -> None:
+        self._emit(key, value, "g")
+
+    def incr_counter(self, key: Key, value: float) -> None:
+        self._emit(key, value, "c")
+
+    def add_sample(self, key: Key, value: float) -> None:
+        self._emit(key, value, "ms")
+
+
+class StatsiteSink:
+    """Push metrics to statsite over TCP (go-metrics statsite.go). Connects
+    lazily and drops metrics while unreachable."""
+
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _emit(self, key: Key, value: float, kind: str) -> None:
+        line = f"{_flat(key)}:{value:f}|{kind}\n".encode()
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(self.addr, timeout=1.0)
+                self._sock.sendall(line)
+            except OSError:
+                self._sock = None
+
+    def set_gauge(self, key: Key, value: float) -> None:
+        self._emit(key, value, "g")
+
+    def incr_counter(self, key: Key, value: float) -> None:
+        self._emit(key, value, "c")
+
+    def add_sample(self, key: Key, value: float) -> None:
+        self._emit(key, value, "ms")
+
+
+class FanoutSink:
+    """Broadcast to several sinks (go-metrics sink.go FanoutSink)."""
+
+    def __init__(self, sinks: List):
+        self.sinks = list(sinks)
+
+    def set_gauge(self, key: Key, value: float) -> None:
+        for s in self.sinks:
+            s.set_gauge(key, value)
+
+    def incr_counter(self, key: Key, value: float) -> None:
+        for s in self.sinks:
+            s.incr_counter(key, value)
+
+    def add_sample(self, key: Key, value: float) -> None:
+        for s in self.sinks:
+            s.add_sample(key, value)
+
+
+class BlackholeSink:
+    def set_gauge(self, key: Key, value: float) -> None:
+        pass
+
+    def incr_counter(self, key: Key, value: float) -> None:
+        pass
+
+    def add_sample(self, key: Key, value: float) -> None:
+        pass
+
+
+class Metrics:
+    """Front-end adding service-name prefix and hostname tagging
+    (go-metrics start.go Config + metrics.go)."""
+
+    def __init__(self, sink, service: str = "nomad",
+                 hostname: str = "", enable_hostname: bool = False):
+        self.sink = sink
+        self.service = service
+        self.hostname = hostname or socket.gethostname()
+        self.enable_hostname = enable_hostname
+
+    def _key(self, key: Key) -> Key:
+        parts: List[str] = [self.service]
+        if self.enable_hostname:
+            parts.append(self.hostname)
+        return tuple(parts) + tuple(key)
+
+    def set_gauge(self, key: Key, value: float) -> None:
+        self.sink.set_gauge(self._key(key), value)
+
+    def incr_counter(self, key: Key, value: float = 1.0) -> None:
+        self.sink.incr_counter(self._key(key), value)
+
+    def add_sample(self, key: Key, value: float) -> None:
+        self.sink.add_sample(self._key(key), value)
+
+    def measure_since(self, key: Key, start: float) -> None:
+        """Record elapsed ms since ``start`` (a time.perf_counter stamp) —
+        the `defer metrics.MeasureSince` idiom."""
+        self.sink.add_sample(self._key(key), (time.perf_counter() - start) * 1000.0)
+
+
+_global_lock = threading.Lock()
+_global: Optional[Metrics] = None
+
+
+def set_global(m: Metrics) -> Metrics:
+    global _global
+    with _global_lock:
+        _global = m
+    return m
+
+
+def get_global() -> Metrics:
+    """The process-wide registry; defaults to an in-memory sink."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = Metrics(InmemSink())
+        return _global
+
+
+def set_gauge(key: Key, value: float) -> None:
+    get_global().set_gauge(key, value)
+
+
+def incr_counter(key: Key, value: float = 1.0) -> None:
+    get_global().incr_counter(key, value)
+
+
+def add_sample(key: Key, value: float) -> None:
+    get_global().add_sample(key, value)
+
+
+def measure_since(key: Key, start: float) -> None:
+    get_global().measure_since(key, start)
+
+
+def setup_signal_dump(sink: InmemSink, signum: int = signal.SIGUSR1) -> None:
+    """Dump all retained intervals to stderr on ``signum``
+    (go-metrics inmem_signal.go wired at command/agent/command.go:492-497)."""
+
+    def _dump(_sig, _frame):  # pragma: no cover - signal path
+        sink.dump(out=sys.stderr)
+
+    signal.signal(signum, _dump)
+
+
+def build_sink(
+    statsite_addr: str = "",
+    statsd_addr: str = "",
+    interval: float = 10.0,
+    retain: float = 60.0,
+) -> Tuple[InmemSink, object]:
+    """Agent telemetry wiring (command/agent/command.go:486-520): always an
+    in-memory sink; fan out to statsite/statsd when configured. Returns
+    (inmem, sink-to-use)."""
+    inmem = InmemSink(interval=interval, retain=retain)
+    sinks: List = []
+    if statsite_addr:
+        sinks.append(StatsiteSink(statsite_addr))
+    if statsd_addr:
+        sinks.append(StatsdSink(statsd_addr))
+    if sinks:
+        sinks.append(inmem)
+        return inmem, FanoutSink(sinks)
+    return inmem, inmem

@@ -1,0 +1,280 @@
+"""Auxiliary subsystems: telemetry sinks, log plumbing, agent config files.
+
+Reference patterns: go-metrics inmem tests, command/agent/config_test.go
+merge tests, command/agent/log_writer_test.go ring semantics.
+"""
+
+import json
+import logging
+import time
+
+import pytest
+
+from nomad_tpu import telemetry
+from nomad_tpu.agent_config import (
+    FileConfig,
+    default_config,
+    dev_config,
+    load_config_path,
+    parse_config,
+)
+from nomad_tpu.logbuf import GatedHandler, LogWriter, setup_agent_logging
+
+
+# -- telemetry --------------------------------------------------------------
+
+
+def test_inmem_sink_aggregates():
+    sink = telemetry.InmemSink(interval=10.0)
+    sink.set_gauge(("nomad", "broker", "depth"), 3)
+    sink.incr_counter(("nomad", "rpc", "query"), 1)
+    sink.incr_counter(("nomad", "rpc", "query"), 1)
+    sink.add_sample(("nomad", "worker", "invoke"), 12.0)
+    sink.add_sample(("nomad", "worker", "invoke"), 8.0)
+
+    cur = sink.intervals[-1]
+    assert cur.gauges["nomad.broker.depth"] == 3
+    assert cur.counters["nomad.rpc.query"].count == 2
+    agg = cur.samples["nomad.worker.invoke"]
+    assert agg.count == 2
+    assert agg.min == 8.0 and agg.max == 12.0
+    assert agg.mean == 10.0
+
+    text = sink.dump()
+    assert "nomad.broker.depth" in text
+    assert "[G]" in text and "[C]" in text and "[S]" in text
+
+
+def test_metrics_front_prefix_and_measure_since():
+    sink = telemetry.InmemSink()
+    m = telemetry.Metrics(sink, service="nomad", enable_hostname=False)
+    start = time.perf_counter()
+    m.measure_since(("plan", "evaluate"), start)
+    m.incr_counter(("rpc", "query"))
+    cur = sink.intervals[-1]
+    assert "nomad.plan.evaluate" in cur.samples
+    assert cur.samples["nomad.plan.evaluate"].max < 1000.0
+    assert "nomad.rpc.query" in cur.counters
+
+
+def test_fanout_and_build_sink():
+    a, b = telemetry.InmemSink(), telemetry.InmemSink()
+    fan = telemetry.FanoutSink([a, b])
+    fan.set_gauge(("x",), 1.0)
+    assert a.intervals[-1].gauges["x"] == 1.0
+    assert b.intervals[-1].gauges["x"] == 1.0
+
+    inmem, sink = telemetry.build_sink()
+    assert sink is inmem
+    inmem2, sink2 = telemetry.build_sink(statsd_addr="127.0.0.1:9")
+    assert isinstance(sink2, telemetry.FanoutSink)
+    # fire-and-forget: must not raise even with nothing listening
+    sink2.incr_counter(("nomad", "test"), 1.0)
+
+
+def test_global_metrics_registry():
+    sink = telemetry.InmemSink()
+    telemetry.set_global(telemetry.Metrics(sink, enable_hostname=False))
+    telemetry.incr_counter(("global", "hit"))
+    assert "nomad.global.hit" in sink.intervals[-1].counters
+
+
+# -- log plumbing -----------------------------------------------------------
+
+
+def _record(msg: str, level=logging.INFO) -> logging.LogRecord:
+    return logging.LogRecord("nomad_tpu.test", level, __file__, 1, msg, (), None)
+
+
+def test_log_writer_ring_and_stream():
+    w = LogWriter(buf_size=4)
+    for i in range(6):
+        w.emit(_record(f"line-{i}"))
+    tail = w.tail()
+    assert len(tail) == 4
+    assert tail[0].endswith("line-2") and tail[-1].endswith("line-5")
+
+    got = []
+    w.register_sink(got.append)
+    assert len(got) == 4  # backlog replayed first
+    w.emit(_record("live"))
+    assert got[-1].endswith("live")
+    w.deregister_sink(got.append)
+    w.emit(_record("after"))
+    assert not got[-1].endswith("after")
+
+
+def test_gated_handler_buffers_until_flush():
+    lines = []
+
+    class Sink(logging.Handler):
+        def emit(self, record):
+            lines.append(record.getMessage())
+
+    g = GatedHandler(Sink())
+    g.emit(_record("early"))
+    assert lines == []
+    g.flush_through()
+    assert lines == ["early"]
+    g.emit(_record("late"))
+    assert lines == ["early", "late"]
+
+
+def test_setup_agent_logging_idempotent():
+    logger = logging.getLogger("nomad_tpu")
+    before = len(logger.handlers)
+    w1 = setup_agent_logging("INFO")
+    w2 = setup_agent_logging("DEBUG")
+    after = len(
+        [h for h in logger.handlers if isinstance(h, LogWriter)]
+    )
+    assert after == 1
+    logger.removeHandler(w2)
+    del w1
+    assert len(logger.handlers) <= before + 1
+
+
+# -- agent config files -----------------------------------------------------
+
+
+HCL_CONFIG = '''
+region = "eu1"
+datacenter = "dc2"
+data_dir = "/var/nomad"
+log_level = "DEBUG"
+enable_syslog = true
+
+ports {
+    http = 5646
+}
+
+server {
+    enabled = true
+    bootstrap_expect = 3
+    num_schedulers = 4
+}
+
+client {
+    enabled = true
+    servers = ["10.0.0.1:4647"]
+    meta {
+        rack = "r1"
+    }
+    options {
+        "driver.exec.enable" = "1"
+    }
+}
+
+telemetry {
+    statsd_address = "127.0.0.1:8125"
+    disable_hostname = true
+}
+
+atlas {
+    infrastructure = "acme/prod"
+}
+'''
+
+
+def test_parse_hcl_agent_config():
+    cfg = parse_config(HCL_CONFIG)
+    assert cfg.region == "eu1"
+    assert cfg.datacenter == "dc2"
+    assert cfg.log_level == "DEBUG"
+    assert cfg.enable_syslog is True
+    assert cfg.ports.http == 5646
+    assert cfg.ports.rpc == 4647  # untouched default
+    assert cfg.server.enabled and cfg.server.bootstrap_expect == 3
+    assert cfg.server.num_schedulers == 4
+    assert cfg.client.enabled
+    assert cfg.client.servers == ["10.0.0.1:4647"]
+    assert cfg.client.meta == {"rack": "r1"}
+    assert cfg.client.options == {"driver.exec.enable": "1"}
+    assert cfg.telemetry.statsd_address == "127.0.0.1:8125"
+    assert cfg.telemetry.disable_hostname is True
+    assert cfg.atlas.infrastructure == "acme/prod"
+
+
+def test_parse_json_agent_config():
+    cfg = parse_config(json.dumps({
+        "region": "ap1",
+        "ports": {"http": 7000},
+        "server": {"enabled": True},
+    }))
+    assert cfg.region == "ap1"
+    assert cfg.ports.http == 7000
+    assert cfg.server.enabled
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown agent config key"):
+        parse_config('bogus_key = true')
+
+
+def test_merge_semantics():
+    base = default_config()
+    override = parse_config(HCL_CONFIG)
+    merged = base.merge(override)
+    assert merged.region == "eu1"
+    assert merged.bind_addr == "127.0.0.1"  # kept from base
+    assert merged.ports.http == 5646
+
+    # second-level override: later file wins field-by-field, maps merge
+    second = parse_config('''
+client {
+    meta {
+        rack = "r2"
+        zone = "z1"
+    }
+}
+log_level = "WARN"
+''')
+    final = merged.merge(second)
+    assert final.log_level == "WARN"
+    assert final.region == "eu1"
+    assert final.client.meta == {"rack": "r2", "zone": "z1"}
+    assert final.client.servers == ["10.0.0.1:4647"]
+
+
+def test_load_config_dir(tmp_path):
+    (tmp_path / "a.hcl").write_text('region = "r-a"\nlog_level = "DEBUG"')
+    (tmp_path / "b.json").write_text('{"region": "r-b"}')
+    (tmp_path / "ignored.txt").write_text("not config")
+    cfg = load_config_path(str(tmp_path))
+    # sorted order: a.hcl then b.json -> b wins region, a's log level kept
+    assert cfg.region == "r-b"
+    assert cfg.log_level == "DEBUG"
+
+
+def test_dev_config_and_agent_conversion():
+    from nomad_tpu.agent import AgentConfig
+
+    fc = dev_config()
+    ac = AgentConfig.from_file_config(fc)
+    assert ac.server_enabled and ac.client_enabled
+    assert ac.client_options.get("driver.raw_exec.enable") == "1"
+    assert ac.http_port == 4646
+
+    fc2 = fc.merge(parse_config(HCL_CONFIG))
+    ac2 = AgentConfig.from_file_config(fc2)
+    assert ac2.num_schedulers == 4
+    assert ac2.statsd_addr == "127.0.0.1:8125"
+    assert ac2.enable_syslog
+
+
+def test_cli_parses_new_commands():
+    from nomad_tpu.cli import make_parser
+
+    parser = make_parser()
+    args = parser.parse_args(
+        ["agent", "-dev", "-config", "/tmp/x.hcl", "-config", "/tmp/d"]
+    )
+    assert args.config == ["/tmp/x.hcl", "/tmp/d"]
+    for argv in (
+        ["server-join", "127.0.0.1:4648"],
+        ["server-force-leave", "node1"],
+        ["client-config", "-servers"],
+        ["spawn-daemon", "{}"],
+    ):
+        args = parser.parse_args(argv)
+        assert callable(args.func)
