@@ -114,12 +114,14 @@ def _register_builtins() -> None:
     from nomad_tpu.client.driver.mock_driver import MockDriver
     from nomad_tpu.client.driver.qemu import QemuDriver
     from nomad_tpu.client.driver.raw_exec import RawExecDriver
+    from nomad_tpu.client.driver.rkt import RktDriver
 
     register_driver("docker", DockerDriver)
     register_driver("exec", ExecDriver)
     register_driver("raw_exec", RawExecDriver)
     register_driver("java", JavaDriver)
     register_driver("qemu", QemuDriver)
+    register_driver("rkt", RktDriver)
     register_driver("mock_driver", MockDriver)
 
 
@@ -135,6 +137,7 @@ def builtin_driver_classes():
     from nomad_tpu.client.driver.mock_driver import MockDriver
     from nomad_tpu.client.driver.qemu import QemuDriver
     from nomad_tpu.client.driver.raw_exec import RawExecDriver
+    from nomad_tpu.client.driver.rkt import RktDriver
 
     return {
         "docker": DockerDriver,
@@ -142,5 +145,6 @@ def builtin_driver_classes():
         "raw_exec": RawExecDriver,
         "java": JavaDriver,
         "qemu": QemuDriver,
+        "rkt": RktDriver,
         "mock_driver": MockDriver,
     }

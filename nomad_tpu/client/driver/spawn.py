@@ -43,8 +43,10 @@ def spawn_detached(
         "stderr": stderr_path,
         "state_prefix": state_prefix,
     }
+    from nomad_tpu.discover import spawn_daemon_command
+
     proc = subprocess.Popen(
-        [sys.executable, "-m", "nomad_tpu.client.driver.spawn", json.dumps(spec)],
+        spawn_daemon_command(json.dumps(spec)),
         start_new_session=True,
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,

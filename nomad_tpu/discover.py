@@ -1,0 +1,31 @@
+"""Locate the runnable nomad-tpu entrypoint for re-exec.
+
+Reference: /root/reference/helper/discover/discover.go — finds the nomad
+binary (argv[0], $GOPATH/bin, CWD) so the spawn daemon can re-exec it.
+Here the "binary" is the interpreter + module invocation; drivers use this
+to build the ``spawn-daemon`` command line regardless of how the agent was
+started (console script, ``python -m nomad_tpu``, or a test process).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+from typing import List
+
+
+def nomad_command() -> List[str]:
+    """Command prefix that reaches the nomad-tpu CLI from a fresh process."""
+    # A console script on PATH wins (discover.go checks the executable path
+    # first); fall back to the module entrypoint of this interpreter.
+    script = shutil.which("nomad-tpu")
+    if script and os.access(script, os.X_OK):
+        return [script]
+    return [sys.executable, "-m", "nomad_tpu"]
+
+
+def spawn_daemon_command(spec_json: str) -> List[str]:
+    """Command line for the spawn-daemon plumbing command
+    (command/spawn_daemon.go re-exec via helper/discover)."""
+    return nomad_command() + ["spawn-daemon", spec_json]

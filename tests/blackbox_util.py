@@ -1,0 +1,93 @@
+"""Black-box test server: fork the real CLI agent and speak HTTP to it.
+
+Reference: /root/reference/testutil/server.go — forks the ``nomad`` binary
+found on $PATH with a generated config in dev mode, auto-increments ports
+on bind conflicts, and waits on ``/v1/agent/self`` for a leader before
+handing the server to the test (server.go:NewTestServer, :105-107 skips
+when the binary is absent).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_next_port = [20646]
+
+
+def _alloc_port() -> int:
+    _next_port[0] += 1
+    return _next_port[0]
+
+
+class ForkedAgent:
+    """Forked ``nomad-tpu agent -dev`` with its own HTTP port."""
+
+    def __init__(self, timeout: float = 60.0):
+        from nomad_tpu.discover import nomad_command
+
+        self.port = _alloc_port()
+        self.addr = f"http://127.0.0.1:{self.port}"
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "PYTHONPATH": repo_root, "JAX_PLATFORMS": "cpu"}
+        self.proc = subprocess.Popen(
+            nomad_command()
+            + [
+                "agent", "-dev",
+                "-http-port", str(self.port),
+                "-scheduler-backend", "host",
+                "-log-level", "WARN",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        self._wait_ready(timeout)
+
+    def _wait_ready(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        last_err = None
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                out = self.proc.stdout.read() if self.proc.stdout else ""
+                raise RuntimeError(
+                    f"agent exited early ({self.proc.returncode}): {out[-2000:]}"
+                )
+            try:
+                info = self.http_get("/v1/agent/self")
+                if info.get("stats", {}).get("server"):
+                    return
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                last_err = e
+            time.sleep(0.2)
+        self.stop()
+        raise TimeoutError(f"agent not ready after {timeout}s: {last_err}")
+
+    def http_get(self, path: str):
+        with urllib.request.urlopen(self.addr + path, timeout=5) as resp:
+            return json.loads(resp.read().decode())
+
+    def http_put(self, path: str, body) -> dict:
+        req = urllib.request.Request(
+            self.addr + path,
+            data=json.dumps(body).encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read().decode())
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5)

@@ -1,0 +1,122 @@
+"""Black-box tests: the api SDK against a forked real agent process.
+
+Reference pattern: api/*_test.go run against testutil/server.go's forked
+binary; the whole module skips when the agent cannot be forked (the
+reference skips when the nomad binary is off $PATH, testutil/server.go:105).
+"""
+
+import time
+
+import pytest
+
+from blackbox_util import ForkedAgent
+
+
+@pytest.fixture(scope="module")
+def agent():
+    try:
+        proc = ForkedAgent()
+    except (RuntimeError, TimeoutError, OSError) as e:
+        pytest.skip(f"cannot fork black-box agent: {e}")
+    yield proc
+    proc.stop()
+
+
+@pytest.fixture()
+def client(agent):
+    from nomad_tpu.api import ApiClient
+
+    return ApiClient(address=agent.addr)
+
+
+def _example_job(job_id: str):
+    from nomad_tpu import structs
+    from nomad_tpu.structs import Job, Resources, RestartPolicy, Task, TaskGroup
+
+    return Job(
+        region="global",
+        id=job_id,
+        name=job_id,
+        type=structs.JOB_TYPE_BATCH,
+        priority=50,
+        datacenters=["dc1"],
+        task_groups=[
+            TaskGroup(
+                name="grp",
+                count=1,
+                restart_policy=RestartPolicy(attempts=0, interval=60.0, delay=1.0),
+                tasks=[
+                    Task(
+                        name="sleepy",
+                        driver="mock_driver",
+                        config={"run_for": "0.1"},
+                        resources=Resources(cpu=100, memory_mb=64),
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def test_agent_self_and_members(agent, client):
+    info = client.agent().self_info()
+    assert info["config"]["server_enabled"] or info["stats"].get("server")
+    members = client.agent().members()
+    assert len(members) == 1
+    leader = client.status().leader()
+    assert leader
+
+
+def test_register_job_and_monitor_to_running(agent, client):
+    job = _example_job("bb-job")
+    eval_id, _ = client.jobs().register(job)
+    assert eval_id
+
+    deadline = time.monotonic() + 30
+    status = None
+    while time.monotonic() < deadline:
+        ev, _ = client.evaluations().info(eval_id)
+        status = ev.status
+        if status in ("complete", "failed"):
+            break
+        time.sleep(0.2)
+    assert status == "complete"
+
+    allocs, _ = client.jobs().allocations("bb-job")
+    assert len(allocs) == 1
+    assert allocs[0]["desired_status"] == "run"
+
+    jobs, _ = client.jobs().list()
+    assert any(j["id"] == "bb-job" for j in jobs)
+
+
+def test_node_listed_and_ready(agent, client):
+    nodes, _ = client.nodes().list()
+    assert len(nodes) == 1
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        nodes, _ = client.nodes().list()
+        if nodes and nodes[0]["status"] == "ready":
+            break
+        time.sleep(0.2)
+    assert nodes[0]["status"] == "ready"
+
+
+def test_agent_logs_endpoint(agent):
+    out = agent.http_get("/v1/agent/logs")
+    assert "lines" in out
+
+
+def test_deregister_job(agent, client):
+    job = _example_job("bb-stop")
+    client.jobs().register(job)
+    eval_id, _ = client.jobs().deregister("bb-stop")
+    assert eval_id
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        jobs, _ = client.jobs().list()
+        if not any(j["id"] == "bb-stop" for j in jobs):
+            break
+        time.sleep(0.2)
+    jobs, _ = client.jobs().list()
+    assert not any(j["id"] == "bb-stop" for j in jobs)
