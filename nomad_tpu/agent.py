@@ -48,6 +48,9 @@ class AgentConfig:
     syslog_facility: str = "LOCAL0"
     leave_on_interrupt: bool = False
     leave_on_terminate: bool = False
+    rpc_host: str = ""
+    rpc_port: int = 4647
+    start_join: List[str] = field(default_factory=list)
 
     @classmethod
     def dev(cls) -> "AgentConfig":
@@ -95,6 +98,9 @@ class AgentConfig:
             syslog_facility=fc.syslog_facility,
             leave_on_interrupt=fc.leave_on_interrupt,
             leave_on_terminate=fc.leave_on_terminate,
+            rpc_host=fc.addresses.rpc or fc.bind_addr or "127.0.0.1",
+            rpc_port=fc.ports.rpc,
+            start_join=list(fc.server.start_join),
         )
 
 
@@ -106,6 +112,7 @@ class Agent:
         self.server: Optional[Server] = None
         self.client: Optional[Client] = None
         self.http: Optional[object] = None
+        self.client_config: Optional[ClientConfig] = None
 
         if config.server_enabled:
             self._setup_server()
@@ -115,7 +122,9 @@ class Agent:
             raise ValueError("must have at least client or server mode enabled")
 
     def _setup_server(self) -> None:
-        """agent.go:153-173"""
+        """agent.go:153-173. Dev mode runs the in-process server (the
+        reference's raft.NewInmemStore posture, server.go:420-427); otherwise
+        a ClusterServer with network RPC + Raft + membership."""
         server_config = ServerConfig(
             region=self.config.region,
             datacenter=self.config.datacenter,
@@ -128,14 +137,38 @@ class Agent:
             server_config.enabled_schedulers = list(
                 self.config.enabled_schedulers
             )
-        self.server = Server(server_config, logger=self.logger.getChild("server"))
+        if self.config.dev_mode:
+            self.server = Server(
+                server_config, logger=self.logger.getChild("server")
+            )
+            return
+
+        from nomad_tpu.server.cluster import ClusterConfig, ClusterServer
+
+        data_dir = self.config.data_dir or "/tmp/nomad-tpu-agent"
+        cluster = ClusterConfig(
+            node_id=server_config.node_name,
+            bind_host=self.config.rpc_host or "127.0.0.1",
+            bind_port=self.config.rpc_port,
+            raft_data_dir=os.path.join(data_dir, "raft"),
+            bootstrap_expect=self.config.bootstrap_expect,
+            start_join=list(self.config.start_join),
+            # Production-profile raft timing (dev/test clusters tighten
+            # these like server_test.go:12-16 does).
+            heartbeat_interval=0.5,
+            election_timeout_min=1.0,
+            election_timeout_max=2.0,
+        )
+        self.server = ClusterServer(
+            server_config, cluster, logger=self.logger.getChild("server")
+        )
 
     def _setup_client(self) -> None:
         """agent.go:175-201"""
-        if self.server is None:
+        if self.server is None and not self.config.client_servers:
             raise ValueError(
-                "client mode requires a server in-process until the network "
-                "RPC layer lands"
+                "client-only mode requires a servers list in the client "
+                "config block"
             )
         data_dir = self.config.data_dir or "/tmp/nomad-tpu-agent"
         self.client_config = ClientConfig(
@@ -151,6 +184,7 @@ class Agent:
             node_meta=dict(self.config.node_meta),
             options=dict(self.config.client_options),
             rpc_handler=self.server,
+            servers=list(self.config.client_servers),
         )
 
     def setup_telemetry(self) -> None:
@@ -235,6 +269,8 @@ class Agent:
     def members(self) -> List[Dict]:
         if self.server is None:
             return []
+        if hasattr(self.server, "members"):
+            return self.server.members()
         return [
             {
                 "name": self.server.config.node_name,
@@ -245,17 +281,32 @@ class Agent:
         ]
 
     def server_addrs(self) -> List[str]:
+        if self.server is not None and hasattr(self.server, "rpc_addr"):
+            return [self.server.rpc_addr]
+        if self.client_config is not None and self.client_config.servers:
+            return list(self.client_config.servers)
         return [self.http.addr] if self.http and self.server else []
 
     def leader_addr(self) -> str:
+        if self.server is not None and hasattr(self.server, "raft"):
+            leader = getattr(self.server.raft, "leader_addr", "")
+            if leader:
+                return leader
         return self.http.addr if self.http and self.server else ""
 
     def peer_addrs(self) -> List[str]:
+        if self.server is not None and hasattr(self.server, "cluster"):
+            return sorted(self.server.cluster.peers.values())
         return self.server_addrs()
 
     def join(self, addr: str) -> int:
+        if self.server is not None and hasattr(self.server, "join"):
+            return self.server.join(addr)
         self.logger.warning("agent join is a no-op in single-process mode")
         return 0
 
     def force_leave(self, node: str) -> None:
+        if self.server is not None and hasattr(self.server, "force_leave"):
+            self.server.force_leave(node)
+            return
         self.logger.warning("agent force-leave is a no-op in single-process mode")

@@ -72,6 +72,7 @@ class ServerBlock:
     protocol_version: int = 0
     num_schedulers: int = 0
     enabled_schedulers: List[str] = field(default_factory=list)
+    start_join: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -180,6 +181,10 @@ class FileConfig:
             enabled_schedulers=(
                 other.server.enabled_schedulers or self.server.enabled_schedulers
             ),
+            start_join=self.server.start_join + [
+                a for a in other.server.start_join
+                if a not in self.server.start_join
+            ],
         )
         out.telemetry = Telemetry(
             statsite_address=(
@@ -265,8 +270,8 @@ def _from_mapping(data: dict) -> FileConfig:
                     setattr(cfg.client, k, v)
         elif key == "server":
             for k, v in value.items():
-                if k == "enabled_schedulers":
-                    cfg.server.enabled_schedulers = list(v)
+                if k in ("enabled_schedulers", "start_join"):
+                    setattr(cfg.server, k, list(v))
                 elif k in ("bootstrap_expect", "protocol_version", "num_schedulers"):
                     setattr(cfg.server, k, int(v))
                 else:

@@ -23,7 +23,6 @@ from nomad_tpu.client.alloc_runner import AllocRunner
 from nomad_tpu.client.config import ClientConfig
 from nomad_tpu.client.driver.driver import builtin_driver_classes
 from nomad_tpu.client.fingerprint import BUILTIN_FINGERPRINTS
-from nomad_tpu.state.store import item_alloc_node
 from nomad_tpu.structs import Allocation, Node, Resources, generate_uuid
 
 REGISTER_RETRY_INTERVAL = 1.0
@@ -60,9 +59,18 @@ class Client:
                  logger: Optional[logging.Logger] = None):
         self.config = config
         self.logger = logger or logging.getLogger("nomad_tpu.client")
-        self.server = config.rpc_handler
-        if self.server is None:
-            raise ValueError("client requires an rpc_handler (server) for now")
+        from nomad_tpu.client.servers import InProcessEndpoint, RemoteEndpoint
+
+        if config.rpc_handler is not None:
+            # In-process short-circuit (config.go:44-46 RPCHandler)
+            self.endpoint = InProcessEndpoint(config.rpc_handler)
+        elif config.servers:
+            self.endpoint = RemoteEndpoint(config.servers)
+        else:
+            raise ValueError(
+                "client requires an rpc_handler (in-process server) or a "
+                "non-empty servers list"
+            )
 
         self.node: Optional[Node] = None
         self.alloc_runners: Dict[str, AllocRunner] = {}
@@ -150,17 +158,19 @@ class Client:
                 runners = list(self.alloc_runners.values())
             for runner in runners:
                 runner.destroy()
+        if hasattr(self.endpoint, "shutdown"):
+            self.endpoint.shutdown()
 
     # -- registration + heartbeats (client.go:509-611) -----------------------
 
     def _register_node(self) -> None:
         while not self._shutdown.is_set():
             try:
-                reply = self.server.node_register(self.node)
+                reply = self.endpoint.node_register(self.node)
                 self._heartbeat_ttl = reply.get("heartbeat_ttl", 1.0) or 1.0
                 self.logger.info("node registration complete")
                 # Transition to ready
-                self.server.node_update_status(
+                self.endpoint.node_update_status(
                     self.node.id, structs.NODE_STATUS_READY
                 )
                 return
@@ -175,7 +185,7 @@ class Client:
             if self._shutdown.wait(wait):
                 return
             try:
-                ttl = self.server.node_heartbeat(self.node.id)
+                ttl = self.endpoint.node_heartbeat(self.node.id)
                 if ttl:
                     self._heartbeat_ttl = ttl
             except Exception:
@@ -184,27 +194,24 @@ class Client:
     # -- alloc watch + runner plumbing (client.go:629-756) -------------------
 
     def _watch_allocations(self) -> None:
-        """Long-poll the server for this node's allocations. In-process the
-        blocking query is the state watch that powers the reference's
-        Node.GetAllocs blocking RPC (node_endpoint.go:328)."""
-        last_view = None
-        store = self.server.state_store
+        """Long-poll the server for this node's allocations via the endpoint
+        (client.go:629-675; server side node_endpoint.go:328 Node.GetAllocs).
+        The cursor is endpoint-specific: an (id, modify_index) view for the
+        in-process watch, a MinQueryIndex for the network path."""
+        cursor = None
         while not self._shutdown.is_set():
-            event = threading.Event()
-            item = item_alloc_node(self.node.id)
-            store.watch.watch([item], event)
             try:
-                allocs = store.allocs_by_node(self.node.id)
-                # Compare the full (id, modify_index) view so deletions
-                # (eval GC) are observed, not just index growth.
-                view = frozenset((a.id, a.modify_index) for a in allocs)
-                if view == last_view:
-                    event.wait(timeout=0.5)
-                    continue
-                last_view = view
-                self._run_allocs(allocs)
-            finally:
-                store.watch.stop_watch([item], event)
+                allocs, cursor = self.endpoint.get_allocs_blocking(
+                    self.node.id, cursor, timeout=0.5
+                )
+            except Exception:
+                self.logger.exception("alloc watch failed; retrying")
+                if self._shutdown.wait(1.0):
+                    return
+                continue
+            if allocs is None:
+                continue
+            self._run_allocs(allocs)
 
     def _run_allocs(self, updated: List[Allocation]) -> None:
         """Diff and apply alloc changes (client.go:678-756)."""
@@ -248,7 +255,7 @@ class Client:
     def _update_alloc_status(self, alloc: Allocation) -> None:
         """client.go:614-626 -> Node.UpdateAlloc"""
         try:
-            self.server.update_allocs_from_client([alloc])
+            self.endpoint.update_allocs([alloc])
         except Exception:
             self.logger.exception("failed to update alloc status")
 
@@ -276,9 +283,12 @@ class Client:
                 state = json.load(f)
         except (OSError, ValueError):
             return
-        store = self.server.state_store
         for alloc_id, alloc_state in state.items():
-            alloc = store.alloc_by_id(alloc_id)
+            try:
+                alloc = self.endpoint.alloc_by_id(alloc_id)
+            except Exception:
+                self.logger.exception("restore: alloc %s fetch failed", alloc_id)
+                continue
             if alloc is None or alloc.terminal_status():
                 continue
             runner = AllocRunner(

@@ -1,0 +1,145 @@
+"""Client→server endpoints: in-process short-circuit or network RPC.
+
+The reference client talks to servers through one seam, ``Client.RPC``
+(/root/reference/client/client.go:210-214): either a test/in-process
+``RPCHandler`` (client/config/config.go:44-46) or msgpack-RPC over the
+connection pool to a configured server list with failover
+(client.go:226-253 picks a random server, rotates on failure).
+
+``InProcessEndpoint`` is the RPCHandler posture; ``RemoteEndpoint`` is the
+network posture. Both expose the same surface, including the blocking
+allocation watch that powers client.go:629-675 (server side:
+Node.GetAllocs with MinQueryIndex, node_endpoint.go:328).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import List, Optional, Tuple
+
+from nomad_tpu.api.codec import from_dict, to_dict
+from nomad_tpu.rpc import ConnPool, RPCError
+from nomad_tpu.structs import Allocation, Node
+
+WATCH_POLL_LIMIT = 10.0  # max single blocking-query duration
+
+
+class InProcessEndpoint:
+    """Direct method calls into an in-process Server (dev mode / tests)."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def node_register(self, node: Node) -> dict:
+        return self.server.node_register(node)
+
+    def node_update_status(self, node_id: str, status: str) -> dict:
+        return self.server.node_update_status(node_id, status)
+
+    def node_heartbeat(self, node_id: str) -> float:
+        return self.server.node_heartbeat(node_id)
+
+    def update_allocs(self, allocs: List[Allocation]) -> int:
+        return self.server.update_allocs_from_client(allocs)
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self.server.state_store.alloc_by_id(alloc_id)
+
+    def get_allocs_blocking(
+        self, node_id: str, cursor, timeout: float
+    ) -> Tuple[Optional[List[Allocation]], object]:
+        """Blocking alloc query against the local state watch. ``cursor`` is
+        an opaque change marker; returns (allocs|None-if-unchanged, cursor)."""
+        from nomad_tpu.state.store import item_alloc_node
+
+        store = self.server.state_store
+        event = threading.Event()
+        item = item_alloc_node(node_id)
+        store.watch.watch([item], event)
+        try:
+            allocs = store.allocs_by_node(node_id)
+            view = frozenset((a.id, a.modify_index) for a in allocs)
+            if view == cursor:
+                event.wait(timeout=timeout)
+                return None, cursor
+            return allocs, view
+        finally:
+            store.watch.stop_watch([item], event)
+
+
+class RemoteEndpoint:
+    """Network RPC to a server list with rotation on failure
+    (client.go:226-253; pool: nomad/pool.go)."""
+
+    def __init__(self, servers: List[str], timeout: float = 5.0):
+        if not servers:
+            raise ValueError("RemoteEndpoint requires at least one server addr")
+        self.servers = list(servers)
+        random.shuffle(self.servers)
+        self.pool = ConnPool(timeout=timeout)
+        # Long-poll traffic rides its own connection so blocking queries
+        # don't serialize behind control traffic.
+        self.longpoll_pool = ConnPool(timeout=timeout)
+
+    def shutdown(self) -> None:
+        self.pool.shutdown()
+        self.longpoll_pool.shutdown()
+
+    def _call(self, method: str, args: dict, pool: Optional[ConnPool] = None,
+              timeout: Optional[float] = None):
+        last: Optional[Exception] = None
+        for _ in range(len(self.servers)):
+            addr = self.servers[0]
+            try:
+                return (pool or self.pool).call(
+                    addr, method, args, timeout=timeout
+                )
+            except RPCError as e:
+                last = e
+                # Rotate the failed server to the back (client.go:246-252)
+                self.servers.append(self.servers.pop(0))
+        raise last if last is not None else RPCError("no servers")
+
+    def node_register(self, node: Node) -> dict:
+        return self._call("Node.Register", {"node": to_dict(node)})
+
+    def node_update_status(self, node_id: str, status: str) -> dict:
+        return self._call(
+            "Node.UpdateStatus", {"node_id": node_id, "status": status}
+        )
+
+    def node_heartbeat(self, node_id: str) -> float:
+        reply = self.node_update_status(node_id, "ready")
+        return float(reply.get("heartbeat_ttl", 0.0) or 0.0)
+
+    def update_allocs(self, allocs: List[Allocation]) -> int:
+        return self._call(
+            "Node.UpdateAlloc", {"allocs": [to_dict(a) for a in allocs]}
+        )
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        out = self._call("Alloc.GetAlloc", {"alloc_id": alloc_id})
+        if out is None:
+            return None
+        return from_dict(Allocation, out)
+
+    def get_allocs_blocking(
+        self, node_id: str, cursor, timeout: float
+    ) -> Tuple[Optional[List[Allocation]], object]:
+        """Node.GetAllocs with MinQueryIndex (node_endpoint.go:328): the
+        server holds the request until the allocs table passes the cursor
+        index or the timeout lapses."""
+        min_index = int(cursor or 0)
+        timeout = min(timeout, WATCH_POLL_LIMIT)
+        out = self._call(
+            "Node.GetAllocs",
+            {"node_id": node_id, "min_index": min_index, "timeout": timeout},
+            pool=self.longpoll_pool,
+            timeout=timeout + 5.0,
+        )
+        index = int(out.get("index", 0))
+        if out.get("allocs") is None:
+            return None, max(min_index, index)
+        allocs = [from_dict(Allocation, a) for a in out["allocs"]]
+        return allocs, index

@@ -49,6 +49,10 @@ class RaftConfig:
     election_timeout_min: float = 0.15
     election_timeout_max: float = 0.30
     data_dir: str = ""
+    # Do not run elections until this many members are known — the
+    # reference's bootstrap_expect posture (nomad/serf.go:76-134
+    # maybeBootstrap: servers idle until the expected count joins).
+    bootstrap_expect: int = 1
 
 
 @dataclass
@@ -278,6 +282,10 @@ class RaftNode:
             time.sleep(0.01)
             with self._lock:
                 if self.role == LEADER:
+                    continue
+                if len(self.config.peers) < self.config.bootstrap_expect:
+                    # Not yet bootstrapped: wait for peers to join.
+                    self._election_deadline = self._random_deadline()
                     continue
                 if time.monotonic() < self._election_deadline:
                     continue

@@ -44,6 +44,12 @@ class ClusterConfig:
     heartbeat_interval: float = 0.05
     election_timeout_min: float = 0.15
     election_timeout_max: float = 0.30
+    # Hold elections until this many members are known (serf.go:76-134
+    # maybeBootstrap). 0/1 = bootstrap immediately (single-server / dev).
+    bootstrap_expect: int = 1
+    # Addresses to Serf.Join at startup (retry-join posture,
+    # command/agent/command.go retry_join handling).
+    start_join: List[str] = field(default_factory=list)
 
 
 class ClusterServer(Server):
@@ -77,6 +83,7 @@ class ClusterServer(Server):
                 election_timeout_min=self.cluster.election_timeout_min,
                 election_timeout_max=self.cluster.election_timeout_max,
                 data_dir=self.cluster.raft_data_dir,
+                bootstrap_expect=max(self.cluster.bootstrap_expect, 1),
             ),
             self.fsm,
             self.rpc,
@@ -98,6 +105,12 @@ class ClusterServer(Server):
             return
         self._started = True
         self.rpc.start()
+        for addr in self.cluster.start_join:
+            try:
+                n = self.join(addr)
+                self.logger.info("cluster: joined %d peers via %s", n, addr)
+            except RPCError as e:
+                self.logger.warning("cluster: start_join %s failed: %s", addr, e)
         self.raft.start()
         self.plan_applier.start()
         from nomad_tpu.server.worker import Worker
@@ -265,6 +278,10 @@ class ClusterServer(Server):
         r("Node.UpdateAlloc", lambda a: self.update_allocs_from_client(
             [from_dict(Allocation, x) for x in a["allocs"]]
         ))
+        r("Node.GetAllocs", self._rpc_node_get_allocs)
+        r("Alloc.GetAlloc", self._rpc_alloc_get)
+        r("Serf.Join", self._rpc_serf_join)
+        r("Serf.PeerUpdate", self._rpc_serf_peer_update)
 
     def _rpc_eval_dequeue(self, args: dict):
         ev, token = self.eval_dequeue(
@@ -285,6 +302,101 @@ class ClusterServer(Server):
     def _rpc_job_deregister(self, args: dict):
         eval_id, index = self.job_deregister(args["job_id"])
         return {"eval_id": eval_id, "index": index}
+
+    def _rpc_node_get_allocs(self, args: dict):
+        """Blocking Node.GetAllocs (node_endpoint.go:328 + rpc.go:270-335):
+        hold until the allocs table passes min_index or the timeout lapses.
+        Served from local (possibly follower) state — the stale-read path."""
+        from nomad_tpu.state.store import item_alloc_node
+
+        node_id = args["node_id"]
+        min_index = int(args.get("min_index", 0))
+        timeout = min(float(args.get("timeout", 0.5)), 10.0)
+        store = self.state_store
+
+        import time as _time
+
+        end = _time.monotonic() + timeout
+        while True:
+            index = store.get_index("allocs")
+            if index > min_index:
+                allocs = store.allocs_by_node(node_id)
+                return {
+                    "allocs": [to_dict(a) for a in allocs],
+                    "index": index,
+                }
+            remaining = end - _time.monotonic()
+            if remaining <= 0:
+                return {"allocs": None, "index": index}
+            event = threading.Event()
+            item = item_alloc_node(node_id)
+            store.watch.watch([item], event)
+            try:
+                if store.get_index("allocs") <= min_index:
+                    event.wait(timeout=remaining)
+            finally:
+                store.watch.stop_watch([item], event)
+
+    def _rpc_alloc_get(self, args: dict):
+        alloc = self.state_store.alloc_by_id(args["alloc_id"])
+        return None if alloc is None else to_dict(alloc)
+
+    # -- membership (serf-lite; reference: nomad/serf.go + hashicorp/serf) ----
+
+    def join(self, addr: str) -> int:
+        """Join an existing cluster member at ``addr`` (serf gossip join →
+        nodeJoin → Raft peer add, serf.go:76-134). Returns servers joined."""
+        out = self.pool.call(
+            addr, "Serf.Join",
+            {"node_id": self.cluster.node_id, "addr": self.rpc_addr},
+        )
+        peers = out.get("peers", {})
+        self._merge_peers(peers)
+        return len(peers)
+
+    def force_leave(self, node_id: str) -> None:
+        """Remove a member and broadcast the removal (serf.go nodeFailed /
+        server-force-leave)."""
+        self.cluster.peers.pop(node_id, None)
+        self._broadcast_peers()
+
+    def members(self):
+        return [
+            {
+                "name": pid,
+                "addr": addr,
+                "status": "alive",
+                "leader": addr == self.raft.leader_addr,
+            }
+            for pid, addr in sorted(self.cluster.peers.items())
+        ]
+
+    def _merge_peers(self, peers: Dict[str, str]) -> None:
+        before = dict(self.cluster.peers)
+        self.cluster.peers.update(peers)
+        if self.cluster.peers != before:
+            self.logger.info(
+                "cluster: peer set now %s", sorted(self.cluster.peers)
+            )
+
+    def _broadcast_peers(self) -> None:
+        snapshot = dict(self.cluster.peers)
+        for pid, addr in list(snapshot.items()):
+            if pid == self.cluster.node_id:
+                continue
+            try:
+                self.pool.call(addr, "Serf.PeerUpdate", {"peers": snapshot})
+            except RPCError:
+                pass  # gossip is best-effort; next join/update converges
+
+    def _rpc_serf_join(self, args: dict):
+        self._merge_peers({args["node_id"]: args["addr"]})
+        self._broadcast_peers()
+        return {"peers": dict(self.cluster.peers)}
+
+    def _rpc_serf_peer_update(self, args: dict):
+        self._merge_peers(dict(args.get("peers", {})))
+        return {}
 
 
 def form_cluster(

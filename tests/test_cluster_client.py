@@ -1,0 +1,173 @@
+"""Network client + cluster membership tests.
+
+Covers the client->server network RPC path (reference: client/client.go:
+210-253 server list with rotation, node_endpoint.go:328 blocking GetAllocs)
+and the serf-lite membership layer (join/force-leave/bootstrap_expect,
+reference: nomad/serf.go).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server import ServerConfig
+from nomad_tpu.server.cluster import (
+    ClusterConfig,
+    ClusterServer,
+    form_cluster,
+    wait_for_leader,
+)
+from nomad_tpu.structs import Job, Resources, RestartPolicy, Task, TaskGroup
+
+
+def _wait_until(fn, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _mock_job(job_id: str, count: int = 1) -> Job:
+    return Job(
+        region="global",
+        id=job_id,
+        name=job_id,
+        type=structs.JOB_TYPE_BATCH,
+        priority=50,
+        datacenters=["dc1"],
+        task_groups=[
+            TaskGroup(
+                name="grp",
+                count=count,
+                restart_policy=RestartPolicy(attempts=0, interval=60.0, delay=1.0),
+                tasks=[
+                    Task(
+                        name="m",
+                        driver="mock_driver",
+                        config={"run_for": 0.1, "exit_code": 0},
+                        resources=Resources(cpu=100, memory_mb=64),
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def test_network_client_end_to_end(tmp_path):
+    """A client with only a server address list registers over RPC, watches
+    allocations via blocking Node.GetAllocs, runs the task, and syncs the
+    terminal status back over Node.UpdateAlloc."""
+    (srv,) = form_cluster(
+        1, ServerConfig(scheduler_backend="host", num_schedulers=1,
+                        min_heartbeat_ttl=30.0)
+    )
+    try:
+        wait_for_leader([srv])
+        client = Client(
+            ClientConfig(
+                state_dir=str(tmp_path / "state"),
+                alloc_dir=str(tmp_path / "allocs"),
+                node_name="net-client",
+                servers=[srv.rpc_addr],
+                options={"driver.mock_driver.enable": "1"},
+            )
+        )
+        client.start()
+        try:
+            assert _wait_until(
+                lambda: (
+                    srv.state_store.node_by_id(client.node.id) is not None
+                    and srv.state_store.node_by_id(client.node.id).status
+                    == structs.NODE_STATUS_READY
+                )
+            ), "client never became ready over the network path"
+
+            job = _mock_job("net-job")
+            eval_id, _ = srv.job_register(job)
+            ev = srv.wait_for_eval(eval_id, timeout=15.0)
+            assert ev.status == structs.EVAL_STATUS_COMPLETE
+
+            allocs = srv.state_store.allocs_by_job(job.id)
+            assert len(allocs) == 1
+            assert allocs[0].node_id == client.node.id
+
+            assert _wait_until(
+                lambda: srv.state_store.allocs_by_job(job.id)[0].client_status
+                == structs.ALLOC_CLIENT_STATUS_DEAD,
+                timeout=20.0,
+            ), srv.state_store.allocs_by_job(job.id)[0]
+        finally:
+            client.shutdown(destroy_allocs=True)
+    finally:
+        srv.shutdown()
+
+
+def test_runtime_join_grows_cluster():
+    """A server started with an empty peer set joins at runtime and
+    participates in replication (serf join -> peer add, serf.go:76-134)."""
+    (first,) = form_cluster(
+        1, ServerConfig(scheduler_backend="host", num_schedulers=0)
+    )
+    second = None
+    try:
+        wait_for_leader([first])
+        cfg = ServerConfig(scheduler_backend="host", num_schedulers=0)
+        cfg.node_name = "joiner"
+        second = ClusterServer(cfg, ClusterConfig(node_id="joiner"))
+        second.start()
+        n = second.join(first.rpc_addr)
+        assert n >= 1
+        assert "joiner" in first.cluster.peers
+        assert set(second.cluster.peers) == set(first.cluster.peers)
+
+        # Replication reaches the joiner
+        node = mock.node()
+        first.node_register(node)
+        assert _wait_until(
+            lambda: second.state_store.node_by_id(node.id) is not None
+        ), "replicated state never reached the joined server"
+
+        # Force-leave removes it everywhere
+        first.force_leave("joiner")
+        assert "joiner" not in first.cluster.peers
+    finally:
+        if second is not None:
+            second.shutdown()
+        first.shutdown()
+
+
+def test_bootstrap_expect_holds_elections():
+    """bootstrap_expect=3 keeps a lone server from electing itself
+    (serf.go maybeBootstrap)."""
+    cfg = ServerConfig(scheduler_backend="host", num_schedulers=0)
+    cfg.node_name = "lonely"
+    srv = ClusterServer(
+        cfg, ClusterConfig(node_id="lonely", bootstrap_expect=3)
+    )
+    srv.start()
+    try:
+        time.sleep(1.0)
+        assert not srv.raft.is_leader
+
+        # Two more join -> quorum possible -> leadership emerges
+        others = []
+        for i in range(2):
+            ocfg = ServerConfig(scheduler_backend="host", num_schedulers=0)
+            ocfg.node_name = f"peer-{i}"
+            other = ClusterServer(
+                ocfg,
+                ClusterConfig(node_id=f"peer-{i}", bootstrap_expect=3),
+            )
+            other.start()
+            other.join(srv.rpc_addr)
+            others.append(other)
+        leader = wait_for_leader([srv] + others, timeout=15.0)
+        assert leader is not None
+    finally:
+        for other in others:
+            other.shutdown()
+        srv.shutdown()
