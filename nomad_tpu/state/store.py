@@ -27,6 +27,7 @@ from nomad_tpu.structs import (
     Evaluation,
     Job,
     Node,
+    generate_uuid,
 )
 
 # A watch item is a (kind, key) tuple, e.g. ("table", "nodes"),
@@ -203,8 +204,13 @@ class StateSnapshot(_StateView):
     private to their creator so this never races.
     """
 
-    def __init__(self, tables: _Tables):
+    def __init__(self, tables: _Tables, store_uid: str = ""):
         self._t = tables
+        # Identity of the originating live store: device-mirror caches key
+        # on (store_uid, table index) so snapshots of one store share warm
+        # tensors while distinct stores never collide (SURVEY.md §7
+        # "state mirror keyed by a state-store generation").
+        self.store_uid = store_uid
 
     # The plan applier attaches allocs optimistically; reuse the same
     # write-side helpers against the snapshot's private tables.
@@ -286,12 +292,13 @@ class StateStore(_StateView):
         self._lock = threading.RLock()
         self._t = _Tables()
         self.watch = _Watch()
+        self.store_uid = generate_uuid()
 
     # -- snapshot/restore -------------------------------------------------
 
     def snapshot(self) -> StateSnapshot:
         with self._lock:
-            return StateSnapshot(self._t.copy())
+            return StateSnapshot(self._t.copy(), store_uid=self.store_uid)
 
     def restore(self) -> StateRestore:
         return StateRestore(self)

@@ -146,3 +146,54 @@ class NodeMirror:
             jnp.asarray(tg_count),
             jnp.asarray(bw_used),
         )
+
+
+class MirrorCache:
+    """Device-mirror registry keyed by state generation.
+
+    SURVEY.md §7: "maintain on-device arrays keyed by a state-store
+    generation". A snapshot's (store_uid, nodes-table index) names one
+    immutable node set; all evals scheduled against it (across workers and
+    retries) share a single NodeMirror — node tensors stay resident on the
+    device and host-side driver/constraint masks stay warm. Any node write
+    bumps the table index and naturally invalidates.
+    """
+
+    def __init__(self, capacity: int = 8):
+        import collections
+        import threading
+
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, state, datacenters: List[str]):
+        """Return (nodes, mirror) for the ready nodes of ``state`` in
+        ``datacenters``; builds and caches on miss."""
+        from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+
+        uid = getattr(state, "store_uid", "")
+        key = (uid, state.get_index("nodes"), tuple(sorted(datacenters)))
+        if uid:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return entry
+        nodes = ready_nodes_in_dcs(state, datacenters)
+        mirror = NodeMirror(nodes)
+        if uid:
+            with self._lock:
+                self.misses += 1
+                self._entries[key] = (nodes, mirror)
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+        return nodes, mirror
+
+
+# Process-wide cache shared by every TPU scheduler instance (the workers
+# all schedule against snapshots of the same FSM store).
+GLOBAL_MIRROR_CACHE = MirrorCache()

@@ -58,7 +58,7 @@ from nomad_tpu.structs import (
     generate_uuid,
     generate_uuids,
 )
-from nomad_tpu.tpu.mirror import NodeMirror
+from nomad_tpu.tpu.mirror import GLOBAL_MIRROR_CACHE, NodeMirror
 
 
 # A placement out of a batched solve: (node, task_resources). Plain tuples:
@@ -124,6 +124,12 @@ class TPUStack:
     def set_nodes(self, nodes: List[Node]) -> None:
         # No shuffle needed: the solve is a global argmax, not a sampled scan.
         self.mirror = NodeMirror(nodes)
+
+    def set_mirror(self, mirror: NodeMirror) -> None:
+        """Adopt a cached mirror (MirrorCache): node tensors already on
+        device, mask caches warm from earlier evals of the same state
+        generation."""
+        self.mirror = mirror
 
     def set_job(self, job: Job) -> None:
         self.job = job
@@ -307,8 +313,8 @@ class TPUGenericScheduler(GenericScheduler):
         task group instead of one Select per missing alloc. Host-side object
         assembly is lean: uuid batches overlap the device round-trip and
         Allocations are stamped from a shared field template."""
-        nodes = ready_nodes_in_dcs(self.state, self.job.datacenters)
-        self.stack.set_nodes(nodes)
+        _nodes, mirror = GLOBAL_MIRROR_CACHE.get(self.state, self.job.datacenters)
+        self.stack.set_mirror(mirror)
 
         # Group the missing allocs by task group. Diff output arrives in
         # materialization order (all copies of one group contiguous), so
