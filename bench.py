@@ -130,12 +130,13 @@ def run_once(state, job):
         plan = None
 
         def submit_plan(self, plan):
+            # Real leader-side verification (plan_apply.go evaluatePlan via
+            # the native bulk verifier); the raft commit itself is elided.
+            from nomad_tpu.server.plan_apply import evaluate_plan
+
             _Planner.plan = plan
-            result = PlanResult(
-                node_update=plan.node_update,
-                node_allocation=plan.node_allocation,
-                alloc_index=N_NODES + 2,
-            )
+            result = evaluate_plan(state.snapshot(), plan)
+            result.alloc_index = N_NODES + 2
             return result, None
 
         def update_eval(self, ev):

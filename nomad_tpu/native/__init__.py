@@ -1,0 +1,127 @@
+"""ctypes loader for the native control-plane kernels.
+
+Builds ``libnomad_native.so`` on demand with the in-tree Makefile (g++) the
+first time a kernel is requested, memoizes the handle, and degrades to
+numpy equivalents when no toolchain or prebuilt library is available — the
+numpy path is the correctness oracle in tests.
+
+API surface (all take/return numpy arrays):
+  scatter_add(idx, vals, n_out)  -> [n_out, D] int32 row sums
+  fit_check(used, total)         -> (fit bool[N], exhausted_dim int32[N])
+  bincount(idx, n_out)           -> int32[n_out]
+  available()                    -> bool (native .so loaded)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libnomad_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR],
+                    capture_output=True, timeout=120, check=True,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.nt_scatter_add_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
+        lib.nt_fit_check_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.nt_bincount_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def scatter_add(idx: np.ndarray, vals: np.ndarray, n_out: int) -> np.ndarray:
+    """Row-sum ``vals`` grouped by ``idx`` into an [n_out, D] matrix."""
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    vals = np.ascontiguousarray(vals, dtype=np.int32)
+    n, d = vals.shape
+    out = np.zeros((n_out, d), dtype=np.int32)
+    lib = _load()
+    if lib is not None and n:
+        lib.nt_scatter_add_i32(
+            _i32p(idx), _i32p(vals), n, d, _i32p(out), n_out
+        )
+        return out
+    # numpy fallback: bincount per dimension (np.add.at is far slower)
+    for j in range(d):
+        out[:, j] = np.bincount(idx, weights=vals[:, j], minlength=n_out)[
+            :n_out
+        ].astype(np.int32)
+    return out
+
+
+def fit_check(used: np.ndarray, total: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row superset check (funcs.go:56-71): (fit, first exhausted dim)."""
+    used = np.ascontiguousarray(used, dtype=np.int32)
+    total = np.ascontiguousarray(total, dtype=np.int32)
+    n, d = used.shape
+    lib = _load()
+    if lib is not None and n:
+        fit = np.empty(n, dtype=np.uint8)
+        exhausted = np.empty(n, dtype=np.int32)
+        lib.nt_fit_check_i32(
+            _i32p(used), _i32p(total), n, d, _u8p(fit), _i32p(exhausted)
+        )
+        return fit.astype(bool), exhausted
+    over = used > total
+    fit = ~over.any(axis=1)
+    exhausted = np.where(fit, -1, over.argmax(axis=1)).astype(np.int32)
+    return fit, exhausted
+
+
+def bincount(idx: np.ndarray, n_out: int) -> np.ndarray:
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    lib = _load()
+    if lib is not None and idx.size:
+        out = np.zeros(n_out, dtype=np.int32)
+        lib.nt_bincount_i32(_i32p(idx), idx.size, _i32p(out), n_out)
+        return out
+    return np.bincount(idx, minlength=n_out)[:n_out].astype(np.int32)

@@ -48,6 +48,128 @@ def evaluate_node_plan(snap, plan: Plan, node_id: str) -> bool:
     return fit
 
 
+# Plans below this many placements verify with the per-node scalar loop;
+# larger ones go through the native bulk verifier first.
+FAST_VERIFY_THRESHOLD = 64
+
+
+def _res_vec(res) -> "np.ndarray":
+    import numpy as np
+
+    if res is None:
+        return np.zeros(4, dtype=np.int64)
+    return np.array(res.as_vector(), dtype=np.int64)
+
+
+def _prevaluate_nodes_bulk(snap, plan: Plan):
+    """Bulk-verify the network-free nodes of a large plan with the native
+    kernels (nomad_tpu.native): one scatter-add of every placement's
+    resource row + one vectorized superset check, instead of per-node
+    AllocsFit object walks. Nodes with any network asks (port collisions
+    need the sequential NetworkIndex, funcs.go:73-86) or that fail here in
+    a way the scalar path must diagnose stay out of the returned map and
+    fall through to evaluate_node_plan. Returns {node_id: fit}.
+    """
+    import numpy as np
+
+    from nomad_tpu import native
+
+    out = {}
+    ids = [nid for nid, placed in plan.node_allocation.items() if placed]
+
+    totals_rows = []
+    base_rows = []
+    kept = []  # node ids eligible for the bulk check, in row order
+
+    # Shared-object caches: the TPU scheduler's lean path aliases one
+    # Resources / task_resources object across a task group's allocs, so
+    # these collapse 100k attribute walks into dict hits.
+    vec_cache = {}
+    net_cache = {}
+
+    def alloc_row(alloc):
+        """(vec, has_networks) for one allocation, cached by identity."""
+        key = id(alloc.resources)
+        vec = vec_cache.get(key)
+        if vec is None:
+            vec = _res_vec(alloc.resources)
+            vec_cache[key] = vec
+        nkey = (key, id(alloc.task_resources))
+        has_net = net_cache.get(nkey)
+        if has_net is None:
+            has_net = bool(alloc.resources is not None and alloc.resources.networks)
+            if not has_net and alloc.task_resources:
+                has_net = any(
+                    tr is not None and tr.networks
+                    for tr in alloc.task_resources.values()
+                )
+            net_cache[nkey] = has_net
+        return vec, has_net
+
+    for nid in ids:
+        node = snap.node_by_id(nid)
+        if node is None or node.status != "ready" or node.drain:
+            out[nid] = False
+            continue
+        if node.reserved is not None and node.reserved.networks:
+            continue  # reserved-port semantics: scalar path
+        placements = plan.node_allocation[nid]
+
+        base = _res_vec(node.reserved)
+        existing = filter_terminal_allocs(snap.allocs_by_node(nid))
+        bail = False
+        if existing:
+            removed = {a.id for a in plan.node_update.get(nid, [])}
+            removed.update(a.id for a in placements)
+            for alloc in existing:
+                if alloc.id in removed:
+                    continue
+                vec, has_net = alloc_row(alloc)
+                if has_net:
+                    bail = True
+                    break
+                base = base + vec
+        if bail:
+            continue
+
+        # Placements overwhelmingly alias a handful of Resources objects
+        # (one per task group); count per distinct object, then one
+        # multiply-accumulate per distinct ask shape.
+        counts = {}
+        for alloc in placements:
+            key = (id(alloc.resources), id(alloc.task_resources))
+            n = counts.get(key)
+            if n is None:
+                vec, has_net = alloc_row(alloc)
+                if has_net:
+                    bail = True
+                    break
+                counts[key] = 1
+            else:
+                counts[key] = n + 1
+        if bail:
+            continue
+        ask = base
+        for key, n in counts.items():
+            ask = ask + vec_cache[key[0]] * n
+
+        kept.append(nid)
+        totals_rows.append(_res_vec(node.resources))
+        base_rows.append(ask)
+
+    if not kept:
+        return out
+
+    used = np.asarray(base_rows, dtype=np.int64)
+    fit, _exhausted = native.fit_check(
+        np.minimum(used, 2**31 - 1).astype(np.int32),
+        np.asarray(totals_rows, dtype=np.int32),
+    )
+    for nid, ok in zip(kept, fit.tolist()):
+        out[nid] = ok
+    return out
+
+
 def evaluate_plan(snap, plan: Plan) -> PlanResult:
     """Determine the committable subset of a plan (plan_apply.go:164-227)."""
     result = PlanResult(
@@ -56,9 +178,16 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
         failed_allocs=plan.failed_allocs,
     )
 
+    bulk_fit = {}
+    n_placements = sum(len(v) for v in plan.node_allocation.values())
+    if n_placements >= FAST_VERIFY_THRESHOLD:
+        bulk_fit = _prevaluate_nodes_bulk(snap, plan)
+
     node_ids = set(plan.node_update) | set(plan.node_allocation)
     for node_id in node_ids:
-        fit = evaluate_node_plan(snap, plan, node_id)
+        fit = bulk_fit.get(node_id)
+        if fit is None:
+            fit = evaluate_node_plan(snap, plan, node_id)
         if not fit:
             # Stale scheduler data: force a refresh to the latest view.
             result.refresh_index = max(
