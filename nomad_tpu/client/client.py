@@ -116,8 +116,10 @@ class Client:
         )
 
     def _fingerprint(self) -> None:
-        """client.go:438-477"""
+        """client.go:438-477; periodic fingerprints re-run on their own
+        interval once the client starts (fingerprintPeriodic :461-477)."""
         applied = []
+        self._periodic_fingerprints = []
         for fp_cls in BUILTIN_FINGERPRINTS:
             fp = fp_cls(self.logger)
             try:
@@ -125,7 +127,17 @@ class Client:
                     applied.append(fp.name)
             except Exception:
                 self.logger.exception("fingerprint %s failed", fp.name)
+            enabled, interval = fp.periodic()
+            if enabled:
+                self._periodic_fingerprints.append((fp, interval))
         self.logger.debug("applied fingerprints: %s", applied)
+
+    def _periodic_fingerprint_loop(self, fp, interval: float) -> None:
+        while not self._shutdown.wait(interval):
+            try:
+                fp.fingerprint(self.config, self.node)
+            except Exception:
+                self.logger.exception("periodic fingerprint %s failed", fp.name)
 
     def _setup_drivers(self) -> None:
         """client.go:480-498"""
@@ -143,6 +155,13 @@ class Client:
     def start(self) -> None:
         self._restore_state()
         self._register_node()
+        for fp, interval in getattr(self, "_periodic_fingerprints", []):
+            t = threading.Thread(
+                target=self._periodic_fingerprint_loop, args=(fp, interval),
+                daemon=True, name=f"fingerprint-{fp.name}",
+            )
+            t.start()
+            self._threads.append(t)
         for target in (self._heartbeat_loop, self._watch_allocations,
                        self._periodic_snapshot):
             t = threading.Thread(target=target, daemon=True,

@@ -171,11 +171,144 @@ class NetworkFingerprint(Fingerprint):
             return "127.0.0.1"
 
 
+class ConsulFingerprint(Fingerprint):
+    """Local consul agent probe, re-checked periodically
+    (fingerprint/consul.go: 15s period)."""
+
+    name = "consul"
+
+    def periodic(self) -> Tuple[bool, float]:
+        return True, 15.0
+
+    def fingerprint(self, config, node: Node) -> bool:
+        import json
+        import urllib.request
+
+        addr = "127.0.0.1:8500"
+        if config is not None and getattr(config, "read", None):
+            addr = config.read("consul.address") or addr
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/v1/agent/self", timeout=0.5
+            ) as resp:
+                info = json.loads(resp.read().decode())
+        except (OSError, ValueError):
+            # Periodic: clear stale attributes when the agent goes away
+            for key in list(node.attributes):
+                if key.startswith("consul."):
+                    del node.attributes[key]
+            node.links.pop("consul", None)
+            return False
+        cfg = info.get("Config", {})
+        node.attributes["consul.server"] = str(cfg.get("Server", False)).lower()
+        node.attributes["consul.version"] = cfg.get("Version", "")
+        node.attributes["consul.revision"] = cfg.get("Revision", "")
+        node.attributes["consul.name"] = cfg.get("NodeName", "")
+        node.attributes["consul.datacenter"] = cfg.get("Datacenter", "")
+        node.links["consul"] = (
+            f"{cfg.get('Datacenter', '')}.{cfg.get('NodeName', '')}"
+        )
+        return True
+
+
+class _MetadataFingerprint(Fingerprint):
+    """Cloud metadata probe base (fingerprint/env_aws.go, env_gce.go): a
+    fast-timeout HTTP query against the link-local metadata service, keyed
+    attributes on success, silent inapplicability off-cloud."""
+
+    metadata_url = ""
+    headers: Dict[str, str] = {}
+    attr_prefix = "platform"
+    keys: List[str] = []
+
+    def _get(self, path: str) -> Optional[str]:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.metadata_url + path, headers=self.headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=0.3) as resp:
+                return resp.read().decode()
+        except (OSError, ValueError):
+            return None
+
+    def fingerprint(self, config, node: Node) -> bool:
+        probe = self._get(self.keys[0])
+        if probe is None:
+            return False
+        node.attributes[f"{self.attr_prefix}.{self.keys[0]}"] = probe
+        for key in self.keys[1:]:
+            value = self._get(key)
+            if value is not None:
+                node.attributes[f"{self.attr_prefix}.{key}"] = value
+        return True
+
+
+class EnvAWSFingerprint(_MetadataFingerprint):
+    """fingerprint/env_aws.go (instance metadata incl. type/placement)."""
+
+    name = "env_aws"
+    metadata_url = "http://169.254.169.254/latest/meta-data/"
+    attr_prefix = "platform.aws"
+    keys = [
+        "instance-type", "ami-id", "hostname", "instance-id",
+        "local-hostname", "local-ipv4", "public-hostname", "public-ipv4",
+        "placement/availability-zone",
+    ]
+
+
+class EnvGCEFingerprint(_MetadataFingerprint):
+    """fingerprint/env_gce.go."""
+
+    name = "env_gce"
+    metadata_url = "http://169.254.169.254/computeMetadata/v1/instance/"
+    headers = {"Metadata-Flavor": "Google"}
+    attr_prefix = "platform.gce"
+    keys = ["machine-type", "hostname", "id", "zone"]
+
+
+class TPUFingerprint(Fingerprint):
+    """TPU-native extension: surface attached TPU devices as schedulable
+    node attributes (no reference analog — the device tier is this
+    framework's point). Gated on ``fingerprint.tpu.enable`` because
+    initializing the accelerator runtime on every CPU-only client agent
+    costs seconds."""
+
+    name = "tpu"
+
+    def fingerprint(self, config, node: Node) -> bool:
+        enabled = False
+        if config is not None and getattr(config, "read_bool_default", None):
+            enabled = config.read_bool_default("fingerprint.tpu.enable", False)
+        if not enabled:
+            return False
+        try:
+            import jax
+
+            devices = [d for d in jax.devices() if d.platform != "cpu"]
+        except Exception:
+            return False
+        if not devices:
+            return False
+        node.attributes["tpu.count"] = str(len(devices))
+        node.attributes["tpu.platform"] = devices[0].platform
+        node.attributes["tpu.device_kind"] = getattr(
+            devices[0], "device_kind", ""
+        )
+        node.attributes["driver.tpu"] = "1"
+        return True
+
+
 BUILTIN_FINGERPRINTS: List[Callable[..., Fingerprint]] = [
     ArchFingerprint,
-    HostFingerprint,
+    ConsulFingerprint,
     CPUFingerprint,
+    EnvAWSFingerprint,
+    EnvGCEFingerprint,
+    HostFingerprint,
     MemoryFingerprint,
     StorageFingerprint,
     NetworkFingerprint,
+    TPUFingerprint,
 ]

@@ -73,6 +73,13 @@ class ClusterServer(Server):
         if not self.cluster.node_id:
             self.cluster.node_id = self.config.node_name
         self.cluster.peers.setdefault(self.cluster.node_id, self.rpc_addr)
+        # Cross-region federation table: region -> {node_id: rpc_addr}.
+        # Raft membership stays per-region (the reference replicates within
+        # a region and WAN-gossips across, server.go:503-538); only the
+        # same-region branch of a join touches cluster.peers.
+        self.region_peers: Dict[str, Dict[str, str]] = {
+            self.config.region: self.cluster.peers
+        }
 
         # Replace the in-process replication layer with Raft
         self.raft = RaftNode(
@@ -213,6 +220,13 @@ class ClusterServer(Server):
         return from_dict(PlanResult, out)
 
     def job_register(self, job: Job):
+        # Cross-region submissions route to the owning region first
+        # (rpc.go:163-177 forward: region mismatch -> forwardRegion).
+        if job.region and job.region != self.config.region:
+            out = self.forward_region(
+                job.region, "Job.Register", {"job": to_dict(job)}
+            )
+            return out["eval_id"], out["index"]
         if self.raft.is_leader:
             return super().job_register(job)
         out = self._forward("Job.Register", {"job": to_dict(job)})
@@ -258,6 +272,7 @@ class ClusterServer(Server):
         r("Status.Leader", lambda args: self.raft.leader_addr)
         r("Status.Peers", lambda args: list(self.cluster.peers.values()))
         r("Status.Stats", lambda args: {**self.stats(), **self.raft.stats()})
+        r("Status.Regions", lambda args: self.regions())
 
         r("Eval.Dequeue", self._rpc_eval_dequeue)
         r("Eval.Ack", lambda a: self.eval_ack(a["eval_id"], a["token"]))
@@ -345,14 +360,24 @@ class ClusterServer(Server):
 
     def join(self, addr: str) -> int:
         """Join an existing cluster member at ``addr`` (serf gossip join →
-        nodeJoin → Raft peer add, serf.go:76-134). Returns servers joined."""
+        nodeJoin → Raft peer add, serf.go:76-134). Joining a server of
+        another region federates (region table only); same region adds
+        raft peers. Returns servers joined."""
         out = self.pool.call(
             addr, "Serf.Join",
-            {"node_id": self.cluster.node_id, "addr": self.rpc_addr},
+            {
+                "node_id": self.cluster.node_id,
+                "addr": self.rpc_addr,
+                "region": self.config.region,
+            },
         )
         peers = out.get("peers", {})
         self._merge_peers(peers)
-        return len(peers)
+        self._merge_region_peers(out.get("regions", {}))
+        return len(peers) + sum(
+            len(m) for r, m in out.get("regions", {}).items()
+            if r != self.config.region
+        )
 
     def force_leave(self, node_id: str) -> None:
         """Remove a member and broadcast the removal (serf.go nodeFailed /
@@ -379,23 +404,81 @@ class ClusterServer(Server):
                 "cluster: peer set now %s", sorted(self.cluster.peers)
             )
 
+    def _merge_region_peers(self, regions: Dict[str, Dict[str, str]]) -> None:
+        for region, members in regions.items():
+            if region == self.config.region:
+                continue  # own region raft membership only moves via joins
+            self.region_peers.setdefault(region, {}).update(members)
+
+    def _region_table(self) -> Dict[str, Dict[str, str]]:
+        return {region: dict(m) for region, m in self.region_peers.items()}
+
+    def regions(self) -> List[str]:
+        """Known federated regions (reference: region tables built from serf
+        tags, nomad/serf.go nodeJoin)."""
+        return sorted(self.region_peers)
+
+    def forward_region(self, region: str, method: str, args: dict):
+        """RPC to any server of another region (rpc.go:204-228
+        forwardRegion picks a random server from the region table)."""
+        import random as _random
+
+        members = self.region_peers.get(region)
+        if not members:
+            raise RPCError(f"no path to region {region!r}")
+        addrs = list(members.values())
+        _random.shuffle(addrs)
+        last: Optional[Exception] = None
+        for addr in addrs:
+            try:
+                return self.pool.call(addr, method, args)
+            except RPCError as e:
+                last = e
+        raise last
+
     def _broadcast_peers(self) -> None:
         snapshot = dict(self.cluster.peers)
-        for pid, addr in list(snapshot.items()):
+        regions = self._region_table()
+        targets = dict(snapshot)
+        for members in regions.values():
+            targets.update(members)
+        for pid, addr in list(targets.items()):
             if pid == self.cluster.node_id:
                 continue
             try:
-                self.pool.call(addr, "Serf.PeerUpdate", {"peers": snapshot})
+                self.pool.call(
+                    addr, "Serf.PeerUpdate",
+                    {"peers": snapshot, "regions": regions,
+                     "region": self.config.region},
+                )
             except RPCError:
                 pass  # gossip is best-effort; next join/update converges
 
     def _rpc_serf_join(self, args: dict):
-        self._merge_peers({args["node_id"]: args["addr"]})
+        joiner_region = args.get("region", self.config.region)
+        if joiner_region == self.config.region:
+            self._merge_peers({args["node_id"]: args["addr"]})
+        else:
+            self.region_peers.setdefault(joiner_region, {})[
+                args["node_id"]
+            ] = args["addr"]
         self._broadcast_peers()
-        return {"peers": dict(self.cluster.peers)}
+        return {
+            "peers": dict(self.cluster.peers)
+            if joiner_region == self.config.region
+            else {},
+            "regions": self._region_table(),
+        }
 
     def _rpc_serf_peer_update(self, args: dict):
-        self._merge_peers(dict(args.get("peers", {})))
+        sender_region = args.get("region", self.config.region)
+        if sender_region == self.config.region:
+            self._merge_peers(dict(args.get("peers", {})))
+        else:
+            self.region_peers.setdefault(sender_region, {}).update(
+                args.get("peers", {})
+            )
+        self._merge_region_peers(dict(args.get("regions", {})))
         return {}
 
 

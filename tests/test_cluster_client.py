@@ -171,3 +171,45 @@ def test_bootstrap_expect_holds_elections():
         for other in others:
             other.shutdown()
         srv.shutdown()
+
+
+def test_multi_region_federation():
+    """Two regions federate via a cross-region join: raft stays per-region,
+    region tables converge, and a job whose region differs from the
+    receiving server forwards to the owning region (rpc.go:204-228)."""
+    cfg_a = ServerConfig(scheduler_backend="host", num_schedulers=1,
+                         region="global", min_heartbeat_ttl=30.0)
+    cfg_a.node_name = "a-1"
+    srv_a = ClusterServer(cfg_a, ClusterConfig(node_id="a-1"))
+    cfg_b = ServerConfig(scheduler_backend="host", num_schedulers=1,
+                         region="eu", min_heartbeat_ttl=30.0)
+    cfg_b.node_name = "b-1"
+    srv_b = ClusterServer(cfg_b, ClusterConfig(node_id="b-1"))
+    srv_a.start()
+    srv_b.start()
+    try:
+        wait_for_leader([srv_a])
+        wait_for_leader([srv_b])
+        srv_b.join(srv_a.rpc_addr)
+
+        # Raft membership stays per-region
+        assert "b-1" not in srv_a.cluster.peers
+        assert "a-1" not in srv_b.cluster.peers
+        assert srv_a.regions() == ["eu", "global"]
+        assert _wait_until(lambda: srv_b.regions() == ["eu", "global"])
+
+        # Register the eu node on the eu server, then submit an eu job
+        # to the GLOBAL server: it must land in eu's state.
+        node = mock.node()
+        srv_b.node_register(node)
+        job = _mock_job("federated")
+        job.region = "eu"
+        eval_id, _ = srv_a.job_register(job)
+        assert eval_id
+        assert srv_a.state_store.job_by_id("federated") is None
+        assert srv_b.state_store.job_by_id("federated") is not None
+        ev = srv_b.wait_for_eval(eval_id, timeout=15.0)
+        assert ev.status == structs.EVAL_STATUS_COMPLETE
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
