@@ -159,21 +159,25 @@ class HTTPServer:
         if min_index == 0:
             return
         wait = parse_duration(query.get("wait", "5m"))
-        store = self.agent.server.state_store
-        deadline = threading.Event()
-        store.watch.watch([item_table(table)], deadline)
-        try:
-            import time as _time
+        import time as _time
 
-            end = _time.monotonic() + wait
-            while store.get_index(table) <= min_index:
-                remaining = end - _time.monotonic()
-                if remaining <= 0:
-                    return
-                deadline.wait(min(remaining, 0.5))
-                deadline.clear()
-        finally:
-            store.watch.stop_watch([item_table(table)], deadline)
+        end = _time.monotonic() + wait
+        while True:
+            # Re-read per pass: a raft snapshot install rebinds fsm.state,
+            # orphaning any watch parked on the previous store.
+            store = self.agent.server.state_store
+            if store.get_index(table) > min_index:
+                return
+            remaining = end - _time.monotonic()
+            if remaining <= 0:
+                return
+            event = threading.Event()
+            store.watch.watch([item_table(table)], event)
+            try:
+                if store.get_index(table) <= min_index:
+                    event.wait(min(remaining, 0.5))
+            finally:
+                store.watch.stop_watch([item_table(table)], event)
 
     def _srv(self):
         if self.agent.server is None:

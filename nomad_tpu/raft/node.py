@@ -2,18 +2,27 @@
 
 A compact, correct Raft core (Ongaro & Ousterhout's algorithm) over the
 framework RPC layer. Scope notes vs the paper:
-- log compaction/InstallSnapshot: not yet (logs are bounded by GC upstream;
-  snapshot shipping lands with WAN federation)
+- log compaction via FSM snapshots (paper §7): each node snapshots its own
+  FSM every ``snapshot_threshold`` applied entries and truncates the log
+  prefix; lagging followers catch up through the InstallSnapshot RPC. The
+  reference keeps its log in BoltDB and snapshots through
+  raft.FileSnapshotStore retaining 2 (nomad/server.go:437,453); we retain
+  ``snapshot_retain`` snapshot files the same way.
 - membership change: static peer set per cluster (the reference's
   bootstrap_expect posture, nomad/serf.go:76-134)
 
-Persistence: term/vote/log journal to ``data_dir`` when set, replayed on
-restart; in-memory otherwise (the reference's DevMode InmemStore,
-server.go:420-427).
+Persistence: term/vote/log journal + snapshot files to ``data_dir`` when
+set; on restart the newest valid snapshot is restored into the FSM and the
+log tail replayed (fsm.go:313-410 posture). In-memory otherwise (the
+reference's DevMode InmemStore, server.go:420-427).
+
+Log indexing is absolute: ``self.log[k]`` holds entry ``snapshot_index+k+1``.
 """
 
 from __future__ import annotations
 
+import base64
+import glob
 import json
 import logging
 import os
@@ -53,6 +62,11 @@ class RaftConfig:
     # reference's bootstrap_expect posture (nomad/serf.go:76-134
     # maybeBootstrap: servers idle until the expected count joins).
     bootstrap_expect: int = 1
+    # Take an FSM snapshot and truncate the log prefix after this many
+    # applied entries past the last snapshot (raft.FileSnapshotStore
+    # posture, nomad/server.go:453). Snapshot files retained: snapshot_retain.
+    snapshot_threshold: int = 8192
+    snapshot_retain: int = 2
 
 
 @dataclass
@@ -67,6 +81,25 @@ class _Entry:
     @staticmethod
     def from_wire(d: dict) -> "_Entry":
         return _Entry(d["term"], d["type"], d["payload"])
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """Crash-consistent file replace: write tmp, flush+fsync, rename, fsync
+    the directory so the rename itself is durable."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
 
 
 class RaftNode:
@@ -88,7 +121,13 @@ class RaftNode:
         # Persistent state
         self.current_term = 0
         self.voted_for: Optional[str] = None
-        self.log: List[_Entry] = []  # 1-indexed via helpers
+        self.log: List[_Entry] = []  # log[k] is entry snapshot_index+k+1
+        # Compaction state: everything at or below snapshot_index lives in
+        # the FSM snapshot, not the log.
+        self.snapshot_index = 0
+        self.snapshot_term = 0
+        self._snap_data: Optional[bytes] = None
+        self._compacting = False
 
         # Volatile
         self.commit_index = 0
@@ -109,6 +148,7 @@ class RaftNode:
         self._load_persistent()
         rpc.register("Raft.RequestVote", self._handle_request_vote)
         rpc.register("Raft.AppendEntries", self._handle_append_entries)
+        rpc.register("Raft.InstallSnapshot", self._handle_install_snapshot)
 
         self._threads: List[threading.Thread] = []
 
@@ -162,7 +202,7 @@ class RaftNode:
                 self.current_term, msg_type, encode_payload(msg_type, payload)
             )
             self.log.append(entry)
-            index = len(self.log)
+            index = self.snapshot_index + len(self.log)
             self._apply_futures[index] = future
             self._persist_entry(index, entry)
             if len(self.config.peers) == 1:
@@ -183,7 +223,8 @@ class RaftNode:
                 "leader_id": self.leader_id,
                 "commit_index": self.commit_index,
                 "applied_index": self.last_applied,
-                "last_log_index": len(self.log),
+                "last_log_index": self.snapshot_index + len(self.log),
+                "snapshot_index": self.snapshot_index,
                 "num_peers": len(self.config.peers) - 1,
             }
 
@@ -197,10 +238,9 @@ class RaftNode:
         if not self.config.data_dir:
             return
         meta_path, _ = self._paths()
-        tmp = meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"term": self.current_term, "voted_for": self.voted_for}, f)
-        os.replace(tmp, meta_path)
+        _atomic_write(meta_path, json.dumps(
+            {"term": self.current_term, "voted_for": self.voted_for}
+        ))
 
     def _persist_entry(self, index: int, entry: _Entry) -> None:
         if not self.config.data_dir:
@@ -213,9 +253,36 @@ class RaftNode:
         if not self.config.data_dir:
             return
         _, log_path = self._paths()
-        with open(log_path, "w") as f:
-            for i, entry in enumerate(self.log, start=1):
-                f.write(json.dumps({"index": i, **entry.to_wire()}) + "\n")
+        _atomic_write(log_path, "".join(
+            json.dumps({"index": i, **entry.to_wire()}) + "\n"
+            for i, entry in enumerate(self.log, start=self.snapshot_index + 1)
+        ))
+
+    def _snap_path(self, index: int) -> str:
+        return os.path.join(self.config.data_dir, f"raft-snap-{index:016d}.json")
+
+    def _write_snapshot_file(self, index: int, term: int, data: bytes) -> None:
+        """Write a snapshot to disk, retaining the newest
+        ``snapshot_retain`` files (raft.FileSnapshotStore, server.go:453)."""
+        if not self.config.data_dir:
+            return
+        path = self._snap_path(index)
+        _atomic_write(path, json.dumps({
+            "index": index,
+            "term": term,
+            "data": base64.b64encode(data).decode("ascii"),
+        }))
+        self._prune_snapshots()
+
+    def _prune_snapshots(self) -> None:
+        snaps = sorted(glob.glob(
+            os.path.join(self.config.data_dir, "raft-snap-*.json")
+        ))
+        for old in snaps[: -self.config.snapshot_retain]:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
 
     def _load_persistent(self) -> None:
         if not self.config.data_dir:
@@ -229,10 +296,46 @@ class RaftNode:
             self.voted_for = meta.get("voted_for")
         except (OSError, ValueError):
             pass
+        # Newest valid snapshot first (fall back through retained copies),
+        # restored into the FSM before the log tail replays over it. Restore
+        # failures of any kind (corrupt file, truncated pickle, …) fall
+        # through to the older retained copy — that is what retain=2 is for.
+        snaps = sorted(glob.glob(
+            os.path.join(self.config.data_dir, "raft-snap-*.json")
+        ), reverse=True)
+        for path in snaps:
+            try:
+                with open(path) as f:
+                    snap = json.load(f)
+                data = base64.b64decode(snap["data"])
+                self.fsm.restore_bytes(data)
+            except Exception:
+                self.logger.warning("raft: skipping unreadable snapshot %s", path)
+                continue
+            self.snapshot_index = snap["index"]
+            self.snapshot_term = snap["term"]
+            self._snap_data = data
+            self.commit_index = self.last_applied = self.snapshot_index
+            break
+        # Replay the log tail only if it joins the snapshot contiguously:
+        # log[k] must hold entry snapshot_index+k+1. A gap (e.g. the newest
+        # snapshot was unreadable and we fell back to an older one whose
+        # successor entries were already compacted away) would mis-index
+        # every entry, so the tail is discarded and re-fetched from the
+        # leader instead.
         try:
             with open(log_path) as f:
                 for line in f:
                     d = json.loads(line)
+                    if d["index"] <= self.snapshot_index:
+                        continue
+                    if d["index"] != self.snapshot_index + len(self.log) + 1:
+                        self.logger.warning(
+                            "raft: discarding log from non-contiguous "
+                            "index %d (expected %d)",
+                            d["index"], self.snapshot_index + len(self.log) + 1,
+                        )
+                        break
                     self.log.append(_Entry.from_wire(d))
         except (OSError, ValueError):
             pass
@@ -246,8 +349,16 @@ class RaftNode:
 
     def _last_log(self) -> Tuple[int, int]:
         if not self.log:
-            return 0, 0
-        return len(self.log), self.log[-1].term
+            return self.snapshot_index, self.snapshot_term
+        return self.snapshot_index + len(self.log), self.log[-1].term
+
+    def _entry_at(self, index: int) -> _Entry:
+        return self.log[index - self.snapshot_index - 1]
+
+    def _term_at(self, index: int) -> int:
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        return self._entry_at(index).term
 
     def _other_peers(self) -> Dict[str, str]:
         return {
@@ -420,10 +531,22 @@ class RaftNode:
                 return
             term = self.current_term
             next_idx = self.next_index.get(pid, 1)
-            prev_idx = next_idx - 1
-            prev_term = self.log[prev_idx - 1].term if prev_idx > 0 else 0
-            entries = [e.to_wire() for e in self.log[next_idx - 1:]]
+            if next_idx <= self.snapshot_index:
+                # The entries this follower needs were compacted away:
+                # ship the snapshot instead (paper §7 InstallSnapshot).
+                snap = (self.snapshot_index, self.snapshot_term, self._snap_data)
+            else:
+                snap = None
+                prev_idx = next_idx - 1
+                prev_term = self._term_at(prev_idx) if prev_idx > 0 else 0
+                entries = [
+                    e.to_wire()
+                    for e in self.log[next_idx - self.snapshot_index - 1:]
+                ]
             commit = self.commit_index
+        if snap is not None:
+            self._send_snapshot(pid, addr, term, *snap)
+            return
         try:
             resp = self.pool.call(addr, "Raft.AppendEntries", {
                 "term": term,
@@ -453,12 +576,81 @@ class RaftNode:
                 )
                 self._replicate_now.set()
 
+    def _send_snapshot(self, pid: str, addr: str, term: int,
+                       snap_index: int, snap_term: int,
+                       data: Optional[bytes]) -> None:
+        if data is None:
+            return
+        try:
+            resp = self.pool.call(addr, "Raft.InstallSnapshot", {
+                "term": term,
+                "leader_id": self.config.node_id,
+                "last_included_index": snap_index,
+                "last_included_term": snap_term,
+                "data": base64.b64encode(data).decode("ascii"),
+            }, timeout=10.0)
+        except (RPCError, RemoteError):
+            return
+        with self._lock:
+            if resp["term"] > self.current_term:
+                self._become_follower(resp["term"], None)
+                return
+            if self.role != LEADER or self.current_term != term:
+                return
+            self.match_index[pid] = max(self.match_index.get(pid, 0), snap_index)
+            self.next_index[pid] = snap_index + 1
+        self._replicate_now.set()
+
+    def _handle_install_snapshot(self, args: dict) -> dict:
+        # Decode outside the lock: the payload can be MBs and is a pure
+        # function of the request. (FSM restore + file writes stay under the
+        # lock: they must be ordered against concurrent AppendEntries.)
+        decoded = base64.b64decode(args["data"])
+        with self._lock:
+            term = args["term"]
+            if term < self.current_term:
+                return {"term": self.current_term}
+            if term > self.current_term or self.role != FOLLOWER:
+                self._become_follower(term, args["leader_id"])
+            self.leader_id = args["leader_id"]
+            self._election_deadline = self._random_deadline()
+
+            snap_index = args["last_included_index"]
+            snap_term = args["last_included_term"]
+            if snap_index <= self.commit_index:
+                # Stale snapshot: we already have (and applied) everything
+                # it contains.
+                return {"term": self.current_term}
+            data = decoded
+            self.fsm.restore_bytes(data)
+            # Paper §7: retain any log suffix that extends past the snapshot
+            # and agrees with it; otherwise discard the whole log.
+            last_idx, _ = self._last_log()
+            if (last_idx > snap_index
+                    and snap_index > self.snapshot_index
+                    and self._term_at(snap_index) == snap_term):
+                del self.log[: snap_index - self.snapshot_index]
+            else:
+                self.log = []
+            self.snapshot_index = snap_index
+            self.snapshot_term = snap_term
+            self._snap_data = data
+            self.commit_index = max(self.commit_index, snap_index)
+            self.last_applied = max(self.last_applied, snap_index)
+            self._write_snapshot_file(snap_index, snap_term, data)
+            self._truncate_persisted_log()
+            self.logger.info(
+                "raft: node %s installed snapshot at index %d",
+                self.config.node_id, snap_index,
+            )
+            return {"term": self.current_term}
+
     def _advance_commit_locked(self) -> None:
         """Advance commit index over majority-matched entries of the current
         term (paper §5.4.2), then apply."""
         last_idx, _ = self._last_log()
         for n in range(last_idx, self.commit_index, -1):
-            if self.log[n - 1].term != self.current_term:
+            if self._term_at(n) != self.current_term:
                 break
             votes = 1 + sum(
                 1 for pid in self._other_peers() if self.match_index.get(pid, 0) >= n
@@ -471,7 +663,7 @@ class RaftNode:
     def _apply_committed_locked(self) -> None:
         while self.last_applied < self.commit_index:
             index = self.last_applied + 1
-            entry = self.log[index - 1]
+            entry = self._entry_at(index)
             try:
                 if entry.msg_type != "_noop":
                     self.fsm.apply(
@@ -488,6 +680,51 @@ class RaftNode:
                     future.set_result(index)
                 else:
                     future.set_exception(error)
+        if (self.last_applied - self.snapshot_index
+                >= self.config.snapshot_threshold and not self._compacting):
+            self._compacting = True
+            threading.Thread(
+                target=self._compact_async, daemon=True,
+                name=f"raft-compact-{self.config.node_id}",
+            ).start()
+
+    def _compact_async(self) -> None:
+        """Snapshot the FSM and drop the log prefix (paper §7). The
+        expensive parts — FSM serialization and the snapshot file write —
+        run off the node lock so replication and elections aren't stalled
+        (the reference snapshots in a background goroutine the same way).
+        Only a cheap copy-on-write handle is taken under the lock."""
+        try:
+            with self._lock:
+                idx = self.last_applied
+                snap_term = self._term_at(idx)
+                cow = getattr(self.fsm, "snapshot_cow", None)
+                serialize = getattr(self.fsm, "serialize_cow", None)
+                if cow is not None and serialize is not None:
+                    handle = cow()
+                    data = None
+                else:
+                    # FSMs without a COW snapshot serialize under the lock
+                    data = self.fsm.snapshot_bytes()
+            if data is None:
+                data = serialize(handle)
+            # Durability order: the snapshot file must hit disk before the
+            # log prefix it replaces is truncated.
+            self._write_snapshot_file(idx, snap_term, data)
+            with self._lock:
+                if idx <= self.snapshot_index:
+                    return  # an InstallSnapshot overtook us
+                del self.log[: idx - self.snapshot_index]
+                self.snapshot_index = idx
+                self.snapshot_term = snap_term
+                self._snap_data = data
+                self._truncate_persisted_log()
+            self.logger.info(
+                "raft: node %s compacted log through index %d "
+                "(%d bytes snapshot)", self.config.node_id, idx, len(data),
+            )
+        finally:
+            self._compacting = False
 
     def _handle_append_entries(self, args: dict) -> dict:
         with self._lock:
@@ -502,27 +739,38 @@ class RaftNode:
 
             prev_idx = args["prev_log_index"]
             prev_term = args["prev_log_term"]
-            if prev_idx > 0:
-                if len(self.log) < prev_idx:
+            entries = args["entries"]
+            if prev_idx < self.snapshot_index:
+                # Everything at or below our snapshot index is committed and
+                # matches the leader by definition; skip the overlap.
+                skip = self.snapshot_index - prev_idx
+                entries = entries[skip:]
+                prev_idx = self.snapshot_index
+                prev_term = self.snapshot_term
+            last_idx, _ = self._last_log()
+            if prev_idx > self.snapshot_index:
+                if last_idx < prev_idx:
                     return {"term": self.current_term, "success": False,
-                            "conflict_index": len(self.log) + 1}
-                if self.log[prev_idx - 1].term != prev_term:
+                            "conflict_index": last_idx + 1}
+                if self._term_at(prev_idx) != prev_term:
                     # Find the first index of the conflicting term
-                    conflict_term = self.log[prev_idx - 1].term
+                    conflict_term = self._term_at(prev_idx)
                     first = prev_idx
-                    while first > 1 and self.log[first - 2].term == conflict_term:
+                    while (first > self.snapshot_index + 1
+                           and self._term_at(first - 1) == conflict_term):
                         first -= 1
                     return {"term": self.current_term, "success": False,
                             "conflict_index": first}
 
             # Append any new entries, truncating conflicts
             changed = False
-            for i, wire in enumerate(args["entries"]):
+            for i, wire in enumerate(entries):
                 idx = prev_idx + 1 + i
                 entry = _Entry.from_wire(wire)
-                if len(self.log) >= idx:
-                    if self.log[idx - 1].term != entry.term:
-                        del self.log[idx - 1:]
+                pos = idx - self.snapshot_index - 1
+                if len(self.log) > pos:
+                    if self.log[pos].term != entry.term:
+                        del self.log[pos:]
                         self.log.append(entry)
                         changed = True
                 else:
@@ -532,6 +780,7 @@ class RaftNode:
                 self._truncate_persisted_log()
 
             if args["leader_commit"] > self.commit_index:
-                self.commit_index = min(args["leader_commit"], len(self.log))
+                last_idx, _ = self._last_log()
+                self.commit_index = min(args["leader_commit"], last_idx)
                 self._apply_committed_locked()
             return {"term": self.current_term, "success": True}

@@ -50,6 +50,10 @@ class ClusterConfig:
     # Addresses to Serf.Join at startup (retry-join posture,
     # command/agent/command.go retry_join handling).
     start_join: List[str] = field(default_factory=list)
+    # FSM snapshot / log-compaction cadence (raft.FileSnapshotStore retains
+    # 2 at nomad/server.go:453).
+    snapshot_threshold: int = 8192
+    snapshot_retain: int = 2
 
 
 class ClusterServer(Server):
@@ -91,6 +95,8 @@ class ClusterServer(Server):
                 election_timeout_max=self.cluster.election_timeout_max,
                 data_dir=self.cluster.raft_data_dir,
                 bootstrap_expect=max(self.cluster.bootstrap_expect, 1),
+                snapshot_threshold=self.cluster.snapshot_threshold,
+                snapshot_retain=self.cluster.snapshot_retain,
             ),
             self.fsm,
             self.rpc,
@@ -327,12 +333,15 @@ class ClusterServer(Server):
         node_id = args["node_id"]
         min_index = int(args.get("min_index", 0))
         timeout = min(float(args.get("timeout", 0.5)), 10.0)
-        store = self.state_store
 
         import time as _time
 
         end = _time.monotonic() + timeout
         while True:
+            # Re-read the store each pass: a raft snapshot install rebinds
+            # fsm.state, and a watch parked on the orphaned store would
+            # never fire again.
+            store = self.state_store
             index = store.get_index("allocs")
             if index > min_index:
                 allocs = store.allocs_by_node(node_id)
@@ -348,7 +357,7 @@ class ClusterServer(Server):
             store.watch.watch([item], event)
             try:
                 if store.get_index("allocs") <= min_index:
-                    event.wait(timeout=remaining)
+                    event.wait(timeout=min(remaining, 0.5))
             finally:
                 store.watch.stop_watch([item], event)
 

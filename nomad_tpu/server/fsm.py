@@ -101,11 +101,20 @@ class FSM:
 
     # -- snapshot/restore (fsm.go:299-593) ---------------------------------
 
+    def snapshot_cow(self):
+        """Cheap copy-on-write snapshot handle, safe to take under the raft
+        lock; serialization happens off-lock via serialize_cow (the
+        reference's nomadSnapshot holds a StateSnapshot the same way,
+        fsm.go:299-311)."""
+        return self.state.snapshot()
+
     def snapshot_bytes(self) -> bytes:
         """Serialize the full FSM state. The reference streams msgpack with
         type tags (fsm.go:414-593); we serialize table dumps (internal
         format, not a wire protocol)."""
-        snap = self.state.snapshot()
+        return self.serialize_cow(self.snapshot_cow())
+
+    def serialize_cow(self, snap) -> bytes:
         payload = {
             "nodes": snap.nodes(),
             "jobs": snap.jobs(),

@@ -229,14 +229,17 @@ class PlanApplier(threading.Thread):
         plan_queue: PlanQueue,
         eval_broker: EvalBroker,
         raft,
-        state_store,
+        fsm,
         logger: Optional[logging.Logger] = None,
     ):
         super().__init__(daemon=True, name="plan-applier")
         self.plan_queue = plan_queue
         self.eval_broker = eval_broker
         self.raft = raft
-        self.state_store = state_store
+        # Hold the FSM, not its StateStore: a raft snapshot restore rebinds
+        # fsm.state to a fresh store (fsm.go:313-410 posture), and plans must
+        # be verified against the live one.
+        self.fsm = fsm
         self.logger = logger or logging.getLogger("nomad_tpu.plan_apply")
         self._stop = threading.Event()
 
@@ -271,7 +274,7 @@ class PlanApplier(threading.Thread):
                 snap = None
 
             if wait_event is None or snap is None:
-                snap = self.state_store.snapshot()
+                snap = self.fsm.state.snapshot()
 
             t0 = time.perf_counter()
             result = evaluate_plan(snap, pending.plan)
@@ -284,7 +287,7 @@ class PlanApplier(threading.Thread):
             # Bound snapshot staleness: wait for any in-flight apply
             if wait_event is not None:
                 wait_event.wait()
-                snap = self.state_store.snapshot()
+                snap = self.fsm.state.snapshot()
                 # Re-evaluate against fresh state? The reference keeps the
                 # earlier verification (bounded staleness); so do we.
 

@@ -97,7 +97,7 @@ class Server:
         self.time_table = TimeTable()
         self.heartbeat = HeartbeatManager(self)
         self.plan_applier = PlanApplier(
-            self.plan_queue, self.eval_broker, self.raft, self.state_store,
+            self.plan_queue, self.eval_broker, self.raft, self.fsm,
             self.logger,
         )
         self.workers: List[Worker] = []
