@@ -96,15 +96,17 @@ class _TimingStack:
     def install(cls):
         from nomad_tpu.tpu.solver import TPUStack
 
-        orig = TPUStack.solve_group
+        def wrap(orig):
+            def timed(self, tg, count, overlap=None):
+                start = time.perf_counter()
+                out = orig(self, tg, count, overlap=overlap)
+                cls.solve_times.append(time.perf_counter() - start)
+                return out
 
-        def timed(self, tg, count, overlap=None):
-            start = time.perf_counter()
-            out = orig(self, tg, count, overlap=overlap)
-            cls.solve_times.append(time.perf_counter() - start)
-            return out
+            return timed
 
-        TPUStack.solve_group = timed
+        TPUStack.solve_group = wrap(TPUStack.solve_group)
+        TPUStack.solve_group_counts = wrap(TPUStack.solve_group_counts)
 
 
 def build_state(nodes, job):
@@ -161,6 +163,7 @@ def run_once(state, job):
 
     plan = _Planner.plan
     placed = sum(len(v) for v in plan.node_allocation.values())
+    placed += sum(b.n for b in plan.alloc_batches)
     return e2e, placed
 
 

@@ -15,7 +15,11 @@ _HINT_CACHE: Dict[type, Dict[str, Any]] = {}
 
 
 def to_dict(obj: Any) -> Any:
-    """Recursively convert dataclasses/lists/dicts to JSON-able values."""
+    """Recursively convert dataclasses/lists/dicts to JSON-able values.
+    Columnar types (AllocBatch) serialize through their own to_wire —
+    columns stay columns on the wire."""
+    if hasattr(type(obj), "to_wire"):
+        return obj.to_wire()
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {}
         for f in dataclasses.fields(obj):
@@ -68,6 +72,8 @@ def _convert(hint: Any, value: Any) -> Any:
         args = get_args(hint)
         value_type = args[1] if len(args) == 2 else Any
         return {k: _convert(value_type, v) for k, v in value.items()}
+    if hasattr(hint, "from_wire"):
+        return hint.from_wire(value)
     if dataclasses.is_dataclass(hint):
         return from_dict(hint, value)
     return value

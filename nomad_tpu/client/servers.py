@@ -51,21 +51,30 @@ class InProcessEndpoint:
     ) -> Tuple[Optional[List[Allocation]], object]:
         """Blocking alloc query against the local state watch. ``cursor`` is
         an opaque change marker; returns (allocs|None-if-unchanged, cursor)."""
+        import time as _time
+
         from nomad_tpu.state.store import item_alloc_node
 
-        store = self.server.state_store
-        event = threading.Event()
         item = item_alloc_node(node_id)
-        store.watch.watch([item], event)
-        try:
+        end = _time.monotonic() + timeout
+        while True:
+            # Re-read the store each pass: a raft snapshot install rebinds
+            # fsm.state, and a watch parked on the orphaned store would
+            # never fire again.
+            store = self.server.state_store
             allocs = store.allocs_by_node(node_id)
             view = frozenset((a.id, a.modify_index) for a in allocs)
-            if view == cursor:
-                event.wait(timeout=timeout)
+            if view != cursor:
+                return allocs, view
+            remaining = end - _time.monotonic()
+            if remaining <= 0:
                 return None, cursor
-            return allocs, view
-        finally:
-            store.watch.stop_watch([item], event)
+            event = threading.Event()
+            store.watch.watch([item], event)
+            try:
+                event.wait(timeout=min(remaining, 0.5))
+            finally:
+                store.watch.stop_watch([item], event)
 
 
 class RemoteEndpoint:

@@ -40,6 +40,28 @@ def bucket(n: int, floor: int = 8) -> int:
     return b
 
 
+_DEVICE_CONST_CACHE: dict = {}
+
+
+def device_const(kind: str, value):
+    """Small device-resident constants (ask vectors, penalties, bandwidth
+    asks). On a remote device every host->device transfer pays tunnel
+    latency, so even 16-byte uploads are worth caching across evals."""
+    key = (kind, value)
+    cached = _DEVICE_CONST_CACHE.get(key)
+    if cached is None:
+        if kind == "ask":
+            cached = jnp.asarray(list(value), dtype=jnp.int32)
+        elif kind == "i32":
+            cached = jnp.int32(value)
+        else:
+            cached = jnp.float32(value)
+        if len(_DEVICE_CONST_CACHE) > 512:
+            _DEVICE_CONST_CACHE.clear()
+        _DEVICE_CONST_CACHE[key] = cached
+    return cached
+
+
 @partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
 def _greedy_step_state(
     total, sched_cap, used, job_count, tg_count, bw_avail, bw_used,
@@ -279,7 +301,7 @@ def solve_many_async(
         idxs, oks, _scores = solve_greedy(
             total, sched_cap, used0, job_count0, tg_count0, bw_avail,
             bw_used0, eligible, ask, bw_ask, active,
-            jnp.float32(penalty), k, job_distinct, tg_distinct,
+            device_const("f32", penalty), k, job_distinct, tg_distinct,
         )
 
         def fetch_exact():
@@ -293,8 +315,8 @@ def solve_many_async(
     # copy on nodes without same-scope allocs, zero otherwise.
     counts_dev, _remaining = solve_waterfill(
         total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
-        eligible, ask, bw_ask, jnp.int32(count), jnp.float32(penalty),
-        job_distinct, tg_distinct,
+        eligible, ask, bw_ask, jnp.int32(count),
+        device_const("f32", penalty), job_distinct, tg_distinct,
     )
 
     def fetch_fused():
@@ -308,6 +330,30 @@ def solve_many_async(
         return out_idx, oks
 
     return fetch_fused
+
+
+def solve_counts_async(
+    total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+    eligible, ask, bw_ask, count: int, penalty: float,
+    job_distinct: bool = False, tg_distinct: bool = False,
+):
+    """Water-fill dispatch returning per-node placement *counts* — the
+    columnar form consumed by AllocBatch. One device round-trip; no
+    per-placement expansion at all. fetch() -> (counts[N] np.int32,
+    n_unplaced int)."""
+    import numpy as np
+
+    counts_dev, remaining_dev = solve_waterfill(
+        total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+        eligible, ask, bw_ask, jnp.int32(count),
+        device_const("f32", penalty), job_distinct, tg_distinct,
+    )
+
+    def fetch_counts():
+        counts, remaining = jax.device_get((counts_dev, remaining_dev))
+        return np.asarray(counts), int(remaining)
+
+    return fetch_counts
 
 
 def solve_many(

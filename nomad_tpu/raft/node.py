@@ -278,7 +278,8 @@ class RaftNode:
         snaps = sorted(glob.glob(
             os.path.join(self.config.data_dir, "raft-snap-*.json")
         ))
-        for old in snaps[: -self.config.snapshot_retain]:
+        retain = max(1, self.config.snapshot_retain)
+        for old in snaps[:-retain]:
             try:
                 os.remove(old)
             except OSError:

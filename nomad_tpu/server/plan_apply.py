@@ -26,10 +26,12 @@ from nomad_tpu.structs import (
 )
 
 
-def evaluate_node_plan(snap, plan: Plan, node_id: str) -> bool:
+def evaluate_node_plan(snap, plan: Plan, node_id: str,
+                       batch_res=None) -> bool:
     """Check one node's placements against the snapshot
-    (plan_apply.go:229-277)."""
-    if not plan.node_allocation.get(node_id):
+    (plan_apply.go:229-277). ``batch_res`` carries the summed Resources of
+    any columnar (AllocBatch) placements on this node."""
+    if not plan.node_allocation.get(node_id) and batch_res is None:
         # Evict-only plans always fit.
         return True
 
@@ -43,6 +45,9 @@ def evaluate_node_plan(snap, plan: Plan, node_id: str) -> bool:
     remove.extend(plan.node_allocation.get(node_id, []))
     proposed = remove_allocs(existing, remove)
     proposed = proposed + plan.node_allocation.get(node_id, [])
+    if batch_res is not None:
+        pseudo = Allocation(resources=batch_res)
+        proposed = proposed + [pseudo]
 
     fit, _, _ = allocs_fit(node, proposed)
     return fit
@@ -61,21 +66,25 @@ def _res_vec(res) -> "np.ndarray":
     return np.array(res.as_vector(), dtype=np.int64)
 
 
-def _prevaluate_nodes_bulk(snap, plan: Plan):
+def _prevaluate_nodes_bulk(snap, plan: Plan, batch_ask=None):
     """Bulk-verify the network-free nodes of a large plan with the native
     kernels (nomad_tpu.native): one scatter-add of every placement's
     resource row + one vectorized superset check, instead of per-node
     AllocsFit object walks. Nodes with any network asks (port collisions
     need the sequential NetworkIndex, funcs.go:73-86) or that fail here in
     a way the scalar path must diagnose stay out of the returned map and
-    fall through to evaluate_node_plan. Returns {node_id: fit}.
+    fall through to evaluate_node_plan. ``batch_ask`` maps node_id to the
+    summed int64 resource vector of columnar (AllocBatch) placements.
+    Returns {node_id: fit}.
     """
     import numpy as np
 
     from nomad_tpu import native
 
+    batch_ask = batch_ask or {}
     out = {}
     ids = [nid for nid, placed in plan.node_allocation.items() if placed]
+    ids.extend(nid for nid in batch_ask if nid not in plan.node_allocation)
 
     totals_rows = []
     base_rows = []
@@ -113,9 +122,12 @@ def _prevaluate_nodes_bulk(snap, plan: Plan):
             continue
         if node.reserved is not None and node.reserved.networks:
             continue  # reserved-port semantics: scalar path
-        placements = plan.node_allocation[nid]
+        placements = plan.node_allocation.get(nid, ())
 
         base = _res_vec(node.reserved)
+        extra = batch_ask.get(nid)
+        if extra is not None:
+            base = base + extra
         existing = filter_terminal_allocs(snap.allocs_by_node(nid))
         bail = False
         if existing:
@@ -171,23 +183,52 @@ def _prevaluate_nodes_bulk(snap, plan: Plan):
 
 
 def evaluate_plan(snap, plan: Plan) -> PlanResult:
-    """Determine the committable subset of a plan (plan_apply.go:164-227)."""
+    """Determine the committable subset of a plan (plan_apply.go:164-227).
+
+    Columnar batches verify without expansion: each batch contributes
+    ``count x resource-vector`` per node run, folded into the same per-node
+    fit checks as the object placements; committed batches are the runs on
+    fitting nodes."""
+    import numpy as np
+
     result = PlanResult(
         node_update={},
         node_allocation={},
         failed_allocs=plan.failed_allocs,
     )
 
+    # Per-node resource ask of the columnar placements.
+    batch_ask = {}
+    for b in plan.alloc_batches:
+        vec = np.asarray(b.resource_vector(), dtype=np.int64)
+        for nid, cnt in zip(b.node_ids, b.node_counts):
+            prev = batch_ask.get(nid)
+            batch_ask[nid] = vec * cnt if prev is None else prev + vec * cnt
+
     bulk_fit = {}
     n_placements = sum(len(v) for v in plan.node_allocation.values())
+    n_placements += sum(b.n for b in plan.alloc_batches)
     if n_placements >= FAST_VERIFY_THRESHOLD:
-        bulk_fit = _prevaluate_nodes_bulk(snap, plan)
+        bulk_fit = _prevaluate_nodes_bulk(snap, plan, batch_ask)
 
-    node_ids = set(plan.node_update) | set(plan.node_allocation)
+    def batch_res(node_id):
+        vec = batch_ask.get(node_id)
+        if vec is None:
+            return None
+        from nomad_tpu.structs import Resources
+
+        return Resources(
+            cpu=int(vec[0]), memory_mb=int(vec[1]),
+            disk_mb=int(vec[2]), iops=int(vec[3]),
+        )
+
+    fits = {}
+    node_ids = set(plan.node_update) | set(plan.node_allocation) | set(batch_ask)
     for node_id in node_ids:
         fit = bulk_fit.get(node_id)
         if fit is None:
-            fit = evaluate_node_plan(snap, plan, node_id)
+            fit = evaluate_node_plan(snap, plan, node_id, batch_res(node_id))
+        fits[node_id] = fit
         if not fit:
             # Stale scheduler data: force a refresh to the latest view.
             result.refresh_index = max(
@@ -202,6 +243,10 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
             result.node_update[node_id] = plan.node_update[node_id]
         if plan.node_allocation.get(node_id):
             result.node_allocation[node_id] = plan.node_allocation[node_id]
+    for b in plan.alloc_batches:
+        kept = b.filter_nodes(fits)
+        if kept.n:
+            result.alloc_batches.append(kept)
     return result
 
 
@@ -211,6 +256,8 @@ def _flatten_result(result: PlanResult) -> list:
         allocs.extend(update_list)
     for alloc_list in result.node_allocation.values():
         allocs.extend(alloc_list)
+    for batch in result.alloc_batches:
+        allocs.extend(batch.materialize())
     allocs.extend(result.failed_allocs)
     return allocs
 

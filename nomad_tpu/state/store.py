@@ -172,6 +172,11 @@ class _StateView:
     def allocs(self) -> List[Allocation]:
         return list(self._t.allocs.values())
 
+    def alloc_count(self) -> int:
+        """Cheap table cardinality (used by the solver's clean-state fast
+        path to skip usage tensorization entirely)."""
+        return len(self._t.allocs)
+
     def allocs_by_job(self, job_id: str) -> List[Allocation]:
         ids = self._t.allocs_by_job.get(job_id, set())
         return [self._t.allocs[i] for i in ids]

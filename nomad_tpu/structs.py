@@ -689,8 +689,163 @@ class Evaluation:
 
 
 @dataclass
+class AllocBatch:
+    """Columnar block of placements sharing one (eval, job, task group).
+
+    The TPU-native alternative to per-Allocation object flow: a big solve
+    returns per-node placement counts, and this block carries them through
+    plan verification and commit as arrays — node runs, name indices, and a
+    single hex block for ids — materializing Allocation objects only at the
+    FSM/state boundary. The reference has no analog (every placement is an
+    individual Allocation, structs.go:1129-1222); semantically a batch is
+    exactly its ``materialize()`` expansion.
+
+    Layout:
+    - ``node_ids``/``node_counts``: run-length encoded placements per node,
+      in solve-output order.
+    - ``name_idx``: per-placement index into the task group's count
+      expansion (util.go:19-34 names ``job.tg[i]``), aligned with the
+      run expansion order.
+    - ``ids_hex``: 32 hex chars per placement; alloc ids are formatted
+      lazily from slices.
+    """
+
+    __slots__ = (
+        "eval_id", "job", "tg_name", "resources", "task_resources",
+        "metrics", "node_ids", "node_counts", "name_idx", "ids_hex",
+    )
+
+    def __init__(self, eval_id="", job=None, tg_name="", resources=None,
+                 task_resources=None, metrics=None, node_ids=None,
+                 node_counts=None, name_idx=None, ids_hex=""):
+        self.eval_id = eval_id
+        self.job = job
+        self.tg_name = tg_name
+        self.resources = resources
+        self.task_resources = task_resources or {}
+        self.metrics = metrics
+        self.node_ids: List[str] = node_ids or []
+        self.node_counts: List[int] = node_counts or []
+        self.name_idx = name_idx  # numpy int array or list
+        self.ids_hex = ids_hex
+
+    @property
+    def n(self) -> int:
+        return len(self.name_idx) if self.name_idx is not None else 0
+
+    def alloc_id(self, i: int) -> str:
+        h = self.ids_hex[32 * i: 32 * i + 32]
+        return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
+
+    def resource_vector(self) -> List[int]:
+        if self.resources is None:
+            return [0, 0, 0, 0]
+        return self.resources.as_vector()
+
+    def filter_nodes(self, fit: Dict[str, bool]) -> "AllocBatch":
+        """Committable subset: keep only runs on nodes with fit=True.
+        Per-placement columns stay aligned because runs are contiguous."""
+        if all(fit.get(nid, False) for nid in self.node_ids):
+            return self
+        node_ids: List[str] = []
+        node_counts: List[int] = []
+        keep_slices = []
+        pos = 0
+        for nid, cnt in zip(self.node_ids, self.node_counts):
+            if fit.get(nid, False):
+                node_ids.append(nid)
+                node_counts.append(cnt)
+                keep_slices.append((pos, pos + cnt))
+            pos += cnt
+        name_idx = [v for s, e in keep_slices for v in self.name_idx[s:e]]
+        ids_hex = "".join(
+            self.ids_hex[32 * s: 32 * e] for s, e in keep_slices
+        )
+        return AllocBatch(
+            eval_id=self.eval_id, job=self.job, tg_name=self.tg_name,
+            resources=self.resources, task_resources=self.task_resources,
+            metrics=self.metrics, node_ids=node_ids, node_counts=node_counts,
+            name_idx=name_idx, ids_hex=ids_hex,
+        )
+
+    def materialize(self) -> List["Allocation"]:
+        """Expand to Allocation objects (the FSM/state-boundary form)."""
+        job_name = self.job.name if self.job is not None else ""
+        job_id = self.job.id if self.job is not None else ""
+        template = {
+            "id": "", "eval_id": self.eval_id, "name": "", "node_id": "",
+            "job_id": job_id, "job": self.job, "task_group": self.tg_name,
+            "resources": self.resources,
+            "task_resources": self.task_resources, "metrics": self.metrics,
+            "desired_status": ALLOC_DESIRED_STATUS_RUN,
+            "desired_description": "",
+            "client_status": ALLOC_CLIENT_STATUS_PENDING,
+            "client_description": "", "create_index": 0, "modify_index": 0,
+        }
+        out: List[Allocation] = []
+        new = object.__new__
+        copy_t = template.copy
+        hexs = self.ids_hex
+        pos = 0
+        prefix = f"{job_name}.{self.tg_name}["
+        for nid, cnt in zip(self.node_ids, self.node_counts):
+            for i in range(pos, pos + cnt):
+                h = hexs[32 * i: 32 * i + 32]
+                d = copy_t()
+                d["id"] = (
+                    f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
+                )
+                d["name"] = f"{prefix}{self.name_idx[i]}]"
+                d["node_id"] = nid
+                alloc = new(Allocation)
+                alloc.__dict__ = d
+                out.append(alloc)
+            pos += cnt
+        return out
+
+    def to_wire(self) -> dict:
+        from nomad_tpu.api.codec import to_dict
+
+        return {
+            "eval_id": self.eval_id,
+            "job": to_dict(self.job),
+            "tg_name": self.tg_name,
+            "resources": to_dict(self.resources),
+            "task_resources": to_dict(self.task_resources),
+            "metrics": to_dict(self.metrics),
+            "node_ids": list(self.node_ids),
+            "node_counts": [int(c) for c in self.node_counts],
+            "name_idx": [int(i) for i in self.name_idx],
+            "ids_hex": self.ids_hex,
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "AllocBatch":
+        from nomad_tpu.api.codec import from_dict
+
+        return AllocBatch(
+            eval_id=d.get("eval_id", ""),
+            job=from_dict(Job, d.get("job")),
+            tg_name=d.get("tg_name", ""),
+            resources=from_dict(Resources, d.get("resources")),
+            metrics=from_dict(AllocMetric, d.get("metrics")),
+            task_resources={
+                k: from_dict(Resources, v)
+                for k, v in (d.get("task_resources") or {}).items()
+            },
+            node_ids=d.get("node_ids") or [],
+            node_counts=d.get("node_counts") or [],
+            name_idx=d.get("name_idx") or [],
+            ids_hex=d.get("ids_hex", ""),
+        )
+
+
+@dataclass
 class Plan:
-    """Commit plan for task allocations (reference: structs.go:1462-1532)."""
+    """Commit plan for task allocations (reference: structs.go:1462-1532).
+
+    ``alloc_batches`` extends the reference's per-node Allocation lists with
+    columnar placement blocks (AllocBatch) for large solves."""
 
     eval_id: str = ""
     eval_token: str = ""
@@ -699,6 +854,7 @@ class Plan:
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     failed_allocs: List[Allocation] = field(default_factory=list)
+    alloc_batches: List[AllocBatch] = field(default_factory=list)
 
     def append_update(self, alloc: Allocation, status: str, desc: str) -> None:
         new_alloc = alloc.copy()
@@ -716,6 +872,9 @@ class Plan:
     def append_alloc(self, alloc: Allocation) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
 
+    def append_batch(self, batch: AllocBatch) -> None:
+        self.alloc_batches.append(batch)
+
     def append_failed(self, alloc: Allocation) -> None:
         self.failed_allocs.append(alloc)
 
@@ -724,6 +883,7 @@ class Plan:
             not self.node_update
             and not self.node_allocation
             and not self.failed_allocs
+            and not self.alloc_batches
         )
 
 
@@ -734,6 +894,7 @@ class PlanResult:
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     failed_allocs: List[Allocation] = field(default_factory=list)
+    alloc_batches: List[AllocBatch] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
 
@@ -742,6 +903,7 @@ class PlanResult:
             not self.node_update
             and not self.node_allocation
             and not self.failed_allocs
+            and not self.alloc_batches
         )
 
     def full_commit(self, plan: Plan) -> Tuple[bool, int, int]:
@@ -750,6 +912,8 @@ class PlanResult:
         for node_id, alloc_list in plan.node_allocation.items():
             expected += len(alloc_list)
             actual += len(self.node_allocation.get(node_id, []))
+        expected += sum(b.n for b in plan.alloc_batches)
+        actual += sum(b.n for b in self.alloc_batches)
         return actual == expected, expected, actual
 
 

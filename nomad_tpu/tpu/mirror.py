@@ -78,6 +78,12 @@ class NodeMirror:
 
         self._driver_mask_cache: Dict[frozenset, np.ndarray] = {}
         self._constraint_mask_cache: Dict[Tuple, np.ndarray] = {}
+        # Device-resident combined eligibility masks and clean-state usage
+        # tensors: per-eval uploads are pure tunnel latency on remote
+        # devices, so anything reusable across evals of one state
+        # generation stays on device.
+        self._device_mask_cache: Dict[Tuple, "jnp.ndarray"] = {}
+        self._clean_usage_dev = None
 
     # -- eligibility masks -------------------------------------------------
 
@@ -122,12 +128,54 @@ class NodeMirror:
         self._constraint_mask_cache[key] = mask
         return mask
 
+    def device_mask(self, ctx, drivers: Set[str], job_constraints,
+                    tg_constraints) -> "jnp.ndarray":
+        """Combined eligibility mask, resident on device, plus the filtered
+        node count for AllocMetric. Cached per (drivers, job constraints,
+        tg constraints) for the mirror's lifetime — repeat evals against
+        one state generation upload nothing. Returns (device_mask,
+        n_filtered)."""
+        key = (
+            frozenset(drivers),
+            tuple((c.l_target, c.operand, c.r_target)
+                  for c in (job_constraints or ())),
+            tuple((c.l_target, c.operand, c.r_target)
+                  for c in (tg_constraints or ())),
+        )
+        cached = self._device_mask_cache.get(key)
+        if cached is not None:
+            return cached
+        mask = self.driver_mask(drivers)
+        if job_constraints:
+            mask = mask & self.constraint_mask(ctx, job_constraints)
+        if tg_constraints:
+            mask = mask & self.constraint_mask(ctx, tg_constraints)
+        entry = (jnp.asarray(mask), int(self.n - mask[: self.n].sum()))
+        self._device_mask_cache[key] = entry
+        return entry
+
     # -- utilization tensors ----------------------------------------------
+
+    def clean_usage(self):
+        """Device-resident (used, job_count, tg_count, bw_used) for a state
+        with no allocations and a plan with no placements yet — just the
+        reserved base. The fresh-registration fast path."""
+        if self._clean_usage_dev is None:
+            zeros = jnp.zeros(self.padded, dtype=jnp.int32)
+            self._clean_usage_dev = (
+                jnp.asarray(self.reserved_np), zeros, zeros,
+                jnp.asarray(self.bw_reserved),
+            )
+        return self._clean_usage_dev
 
     def build_usage(self, ctx, job_id: str, tg_name: str):
         """Build (used, job_count, tg_count, bw_used) from the eval context's
         optimistic proposed-alloc view (reference: context.go:103-126 feeding
         rank.go:170-221)."""
+        plan = ctx.plan
+        if (ctx.state.alloc_count() == 0 and not plan.alloc_batches
+                and not plan.node_allocation and not plan.node_update):
+            return self.clean_usage()
         used = self.reserved_np.copy()
         bw_used = self.bw_reserved.copy()
         job_count = np.zeros(self.padded, dtype=np.int32)
@@ -140,6 +188,20 @@ class NodeMirror:
                     job_count[i] += 1
                     if alloc.task_group == tg_name:
                         tg_count[i] += 1
+        # Columnar placements from earlier task groups of this plan
+        # (AllocBatch bypasses proposed_allocs' per-object view).
+        for b in ctx.plan.alloc_batches:
+            vec = np.asarray(b.resource_vector(), dtype=np.int32)
+            b_job = b.job.id if b.job is not None else ""
+            for nid, cnt in zip(b.node_ids, b.node_counts):
+                i = self.index.get(nid)
+                if i is None:
+                    continue
+                used[i] += vec * cnt
+                if b_job == job_id:
+                    job_count[i] += cnt
+                    if b.tg_name == tg_name:
+                        tg_count[i] += cnt
         return (
             jnp.asarray(used),
             jnp.asarray(job_count),

@@ -64,6 +64,7 @@ class Harness:
             result = PlanResult(
                 node_update=plan.node_update,
                 node_allocation=plan.node_allocation,
+                alloc_batches=plan.alloc_batches,
                 alloc_index=index,
             )
 
@@ -72,6 +73,8 @@ class Harness:
                 allocs.extend(update_list)
             for alloc_list in plan.node_allocation.values():
                 allocs.extend(alloc_list)
+            for batch in plan.alloc_batches:
+                allocs.extend(batch.materialize())
             allocs.extend(plan.failed_allocs)
 
             self.state.upsert_allocs(index, allocs)
