@@ -174,8 +174,12 @@ class HTTPServer:
             event = threading.Event()
             store.watch.watch([item_table(table)], event)
             try:
-                if store.get_index(table) <= min_index:
-                    event.wait(min(remaining, 0.5))
+                # Identity re-check closes the register-vs-rebind race; a
+                # rebind after registration fires notify_all on the old
+                # store, so a full-length wait is safe.
+                if (self.agent.server.state_store is store
+                        and store.get_index(table) <= min_index):
+                    event.wait(remaining)
             finally:
                 store.watch.stop_watch([item_table(table)], event)
 

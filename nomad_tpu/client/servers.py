@@ -60,19 +60,24 @@ class InProcessEndpoint:
         while True:
             # Re-read the store each pass: a raft snapshot install rebinds
             # fsm.state, and a watch parked on the orphaned store would
-            # never fire again.
+            # never fire again. Register before reading so a write between
+            # read and wait still fires the event.
             store = self.server.state_store
-            allocs = store.allocs_by_node(node_id)
-            view = frozenset((a.id, a.modify_index) for a in allocs)
-            if view != cursor:
-                return allocs, view
-            remaining = end - _time.monotonic()
-            if remaining <= 0:
-                return None, cursor
             event = threading.Event()
             store.watch.watch([item], event)
             try:
-                event.wait(timeout=min(remaining, 0.5))
+                allocs = store.allocs_by_node(node_id)
+                view = frozenset((a.id, a.modify_index) for a in allocs)
+                if view != cursor:
+                    return allocs, view
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    return None, cursor
+                # Identity re-check closes the register-vs-rebind race; a
+                # rebind after registration fires notify_all on the old
+                # store, so a full-length wait is safe.
+                if self.server.state_store is store:
+                    event.wait(timeout=remaining)
             finally:
                 store.watch.stop_watch([item], event)
 

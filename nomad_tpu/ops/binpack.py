@@ -313,14 +313,14 @@ def solve_many_async(
     # Water-fill solver: one dispatch + one transfer for the whole batch.
     # distinct_hosts needs no special-casing: capacity is clamped to one
     # copy on nodes without same-scope allocs, zero otherwise.
-    counts_dev, _remaining = solve_waterfill(
+    fetch_counts = solve_counts_async(
         total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
-        eligible, ask, bw_ask, jnp.int32(count),
-        device_const("f32", penalty), job_distinct, tg_distinct,
+        eligible, ask, bw_ask, count, penalty,
+        job_distinct=job_distinct, tg_distinct=tg_distinct,
     )
 
     def fetch_fused():
-        counts = np.asarray(jax.device_get(counts_dev))
+        counts, _unplaced = fetch_counts()
         idxs = np.repeat(np.arange(counts.shape[0], dtype=np.int64), counts)
         n_placed = idxs.shape[0]
         out_idx = np.full(count, -1, dtype=np.int64)

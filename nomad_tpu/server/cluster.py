@@ -356,8 +356,12 @@ class ClusterServer(Server):
             item = item_alloc_node(node_id)
             store.watch.watch([item], event)
             try:
-                if store.get_index("allocs") <= min_index:
-                    event.wait(timeout=min(remaining, 0.5))
+                # Identity re-check closes the register-vs-rebind race; a
+                # rebind after registration fires notify_all on the old
+                # store, so a full-length wait is safe.
+                if (self.state_store is store
+                        and store.get_index("allocs") <= min_index):
+                    event.wait(timeout=remaining)
             finally:
                 store.watch.stop_watch([item], event)
 

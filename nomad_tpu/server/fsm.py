@@ -129,6 +129,7 @@ class FSM:
     def restore_bytes(self, data: bytes) -> None:
         """Rebuild a fresh state store from a snapshot (fsm.go:313-410)."""
         payload = pickle.loads(data)
+        old_store = self.state
         self.state = StateStore()
         restore = self.state.restore()
         for node in payload["nodes"]:
@@ -142,6 +143,9 @@ class FSM:
         for table, index in payload["indexes"].items():
             restore.index_restore(table, index)
         restore.commit()
+        # Blocking queries parked on the replaced store would never be
+        # notified again; wake them so they re-check against the live one.
+        old_store.watch.notify_all()
 
 
 class InProcRaft:

@@ -95,6 +95,16 @@ class _Watch:
                 for event in self._waiters.get(item, ()):
                     event.set()
 
+    def notify_all(self) -> None:
+        """Wake every parked watcher. Fired when this store is replaced
+        wholesale (raft snapshot install rebinds fsm.state) so blocking
+        queries re-check against the live store instead of sleeping out
+        their timeout on an orphaned one."""
+        with self._lock:
+            for waiters in self._waiters.values():
+                for event in waiters:
+                    event.set()
+
 
 class _Tables:
     """The raw table containers. Snapshots shallow-copy these dicts."""

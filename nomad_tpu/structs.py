@@ -688,7 +688,6 @@ class Evaluation:
         )
 
 
-@dataclass
 class AllocBatch:
     """Columnar block of placements sharing one (eval, job, task group).
 
@@ -785,16 +784,12 @@ class AllocBatch:
         out: List[Allocation] = []
         new = object.__new__
         copy_t = template.copy
-        hexs = self.ids_hex
         pos = 0
         prefix = f"{job_name}.{self.tg_name}["
         for nid, cnt in zip(self.node_ids, self.node_counts):
             for i in range(pos, pos + cnt):
-                h = hexs[32 * i: 32 * i + 32]
                 d = copy_t()
-                d["id"] = (
-                    f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
-                )
+                d["id"] = self.alloc_id(i)
                 d["name"] = f"{prefix}{self.name_idx[i]}]"
                 d["node_id"] = nid
                 alloc = new(Allocation)

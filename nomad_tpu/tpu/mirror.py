@@ -129,7 +129,7 @@ class NodeMirror:
         return mask
 
     def device_mask(self, ctx, drivers: Set[str], job_constraints,
-                    tg_constraints) -> "jnp.ndarray":
+                    tg_constraints) -> Tuple["jnp.ndarray", int]:
         """Combined eligibility mask, resident on device, plus the filtered
         node count for AllocMetric. Cached per (drivers, job constraints,
         tg constraints) for the mirror's lifetime — repeat evals against

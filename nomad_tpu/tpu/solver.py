@@ -43,6 +43,7 @@ from nomad_tpu.scheduler.system import SystemScheduler
 from nomad_tpu.scheduler.util import (
     AllocTuple,
     ready_nodes_in_dcs,
+    tainted_nodes,
     task_group_constraints,
 )
 from nomad_tpu.structs import (
@@ -343,38 +344,93 @@ class TPUGenericScheduler(GenericScheduler):
         return TPUStack(ctx, batch=self.batch)
 
     def compute_job_allocs(self) -> None:
-        """Fresh-registration fast path: with no existing allocations there
-        is nothing to diff — stop/update/migrate are all empty by definition
-        (util.go:54-131 degenerates to place-everything) — so skip the name
-        materialization entirely and place each big task group as one
-        columnar batch."""
+        """Placement-only fast paths, skipping name materialization:
+
+        - Fresh registration: no existing allocations means stop/update/
+          migrate are empty by definition (util.go:54-131 degenerates to
+          place-everything); each big task group places as one columnar
+          batch over index range [0, count).
+        - Pure scale-up: every existing alloc is an 'ignore' (same job
+          version, group still present, node untainted, index in range) —
+          the missing indices are recovered by parsing the count-expansion
+          names of the *existing* allocs (len(existing) parses instead of
+          count string materializations), and only those place.
+
+        Anything needing stop/migrate/update falls through to the
+        reference-shaped object diff (generic_sched.go:186-243).
+        """
         job = self.job
+        if job is None:
+            return super().compute_job_allocs()
         existing = filter_terminal_allocs(
             self.state.allocs_by_job(self.eval.job_id)
         )
-        if job is None or existing:
-            return super().compute_job_allocs()
+
+        if existing:
+            existing_idx = self._pure_scaleup_indices(existing)
+            if existing_idx is None:
+                return super().compute_job_allocs()
+        else:
+            existing_idx = {}
 
         big, small = [], []
         for tg in job.task_groups:
+            have = existing_idx.get(tg.name)
+            if have:
+                if len(have) >= tg.count:
+                    continue
+                missing = np.setdiff1d(
+                    np.arange(tg.count),
+                    np.fromiter(have, dtype=np.int64, count=len(have)),
+                )
+            else:
+                missing = np.arange(tg.count)
+            if len(missing) == 0:
+                continue
             has_networks = any(
                 t.resources is not None and t.resources.networks
                 for t in tg.tasks
             )
-            if tg.count >= self.BATCH_PLACE_THRESHOLD and not has_networks:
-                big.append(tg)
-            elif tg.count > 0:
-                small.append(tg)
+            if len(missing) >= self.BATCH_PLACE_THRESHOLD and not has_networks:
+                big.append((tg, missing))
+            else:
+                small.append((tg, missing))
 
         if small:
             place = [
                 AllocTuple(f"{job.name}.{tg.name}[{i}]", tg)
-                for tg in small
-                for i in range(tg.count)
+                for tg, missing in small
+                for i in missing
             ]
-            self.compute_placements(place)
-        for tg in big:
-            self._place_batch(tg, np.arange(tg.count))
+            if place:
+                self.compute_placements(place)
+        for tg, missing in big:
+            self._place_batch(tg, missing)
+
+    def _pure_scaleup_indices(self, existing) -> Optional[Dict[str, set]]:
+        """If every existing alloc of this job is an 'ignore' under the
+        five-way diff (util.go:54-131), return {tg_name: occupied index
+        set}; otherwise None (caller takes the full object diff)."""
+        job = self.job
+        tainted = tainted_nodes(self.state, existing)
+        if any(tainted.values()):
+            return None
+        tg_by_name = {tg.name: tg for tg in job.task_groups}
+        out: Dict[str, set] = {}
+        for a in existing:
+            if a.job.modify_index != job.modify_index:
+                return None  # in-place update / rolling path
+            tg = tg_by_name.get(a.task_group)
+            if tg is None:
+                return None  # group removed: stops needed
+            try:
+                idx = int(a.name.rsplit("[", 1)[1].rstrip("]"))
+            except (IndexError, ValueError):
+                return None
+            if idx >= tg.count:
+                return None  # scale-down: stops needed
+            out.setdefault(tg.name, set()).add(idx)
+        return out
 
     def _place_batch(self, tg: TaskGroup, name_indices: "np.ndarray") -> None:
         """Place ``len(name_indices)`` copies of a task group as one

@@ -217,3 +217,66 @@ def test_multi_group_batches_share_capacity():
     for a in placed:
         per_node[a.node_id] = per_node.get(a.node_id, 0) + 1
     assert all(c <= 39 for c in per_node.values())
+
+
+def test_scaleup_uses_batch_path_without_rematerializing():
+    """Scale-up of a healthy job recovers missing indices by parsing the
+    existing allocs' names and places only those, columnar."""
+    h = Harness()
+    _seed(h, n_nodes=20)  # 20 x 39 = 780 cap
+    job = _big_job(count=BATCH)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+    assert len(h.state.allocs_by_job(job.id)) == BATCH
+
+    job.task_groups[0].count = BATCH + 300  # same modify_index: pure scale-up
+    h.state.upsert_job(h.next_index(), job)
+    h.evals.clear()
+    h.process("tpu-batch", _eval_for(job))
+
+    plan = h.plans[-1]
+    assert plan.alloc_batches and not plan.node_allocation
+    assert sum(b.n for b in plan.alloc_batches) == 300
+    placed = [a for a in h.state.allocs_by_job(job.id)
+              if a.desired_status == "run"]
+    assert len(placed) == BATCH + 300
+    assert len({a.name for a in placed}) == BATCH + 300
+
+
+def test_scaledown_falls_back_to_object_diff():
+    """Scale-down needs stops — must take the reference-shaped diff, not
+    the batch path."""
+    h = Harness()
+    _seed(h, n_nodes=10)
+    job = _big_job(count=BATCH)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+
+    job.task_groups[0].count = 10
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+
+    plan = h.plans[-1]
+    stops = sum(len(v) for v in plan.node_update.values())
+    assert stops == BATCH - 10
+    remaining = [a for a in h.state.allocs_by_job(job.id)
+                 if a.desired_status == "run"]
+    assert len(remaining) == 10
+
+
+def test_tainted_node_falls_back_and_migrates():
+    """A drained node forces the object diff: its allocs migrate."""
+    h = Harness()
+    nodes = _seed(h, n_nodes=10)
+    job = _big_job(count=BATCH)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+
+    victim = h.state.allocs_by_job(job.id)[0].node_id
+    h.state.update_node_drain(h.next_index(), victim, True)
+    h.process("tpu-batch", _eval_for(job))
+
+    placed = [a for a in h.state.allocs_by_job(job.id)
+              if a.desired_status == "run"]
+    assert len(placed) == BATCH
+    assert all(a.node_id != victim for a in placed)

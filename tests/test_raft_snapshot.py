@@ -129,7 +129,10 @@ def test_lagging_follower_catches_up_via_install_snapshot():
     node_a.start()
     node_b.start()
     try:
-        _wait(lambda: node_a.is_leader or node_b.is_leader, msg="leadership")
+        # Generous timeout: elections under full-suite CPU contention can
+        # take several rounds.
+        _wait(lambda: node_a.is_leader or node_b.is_leader, timeout=30.0,
+              msg="leadership")
         leader = node_a if node_a.is_leader else node_b
         for i in range(60):
             leader.apply("kv", {"k": f"k{i}", "v": i}).result(5.0)
