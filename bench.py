@@ -167,6 +167,77 @@ def run_once(state, job):
     return e2e, placed
 
 
+COALESCE_EVALS = 8
+
+
+def run_coalesced(nodes):
+    """Aux phase: COALESCE_EVALS jobs evaluated concurrently — worker
+    threads whose device solves stack into vmapped dispatches
+    (ops/coalesce.py), the device analog of the reference's optimistic
+    worker concurrency. Returns (wall_seconds, total_placed)."""
+    import threading
+
+    from nomad_tpu.server.plan_apply import evaluate_plan
+    from nomad_tpu.state import StateStore
+
+    state = StateStore()
+    for i, node in enumerate(nodes):
+        state.upsert_node(i + 1, node)
+    jobs = []
+    for j in range(COALESCE_EVALS):
+        _nodes, job = build_cluster()
+        job.task_groups[0].count = N_TASKS // COALESCE_EVALS
+        jobs.append(job)
+        state.upsert_job(N_NODES + 1 + j, job)
+
+    placed = [0] * len(jobs)
+
+    def one(i):
+        import logging
+
+        from nomad_tpu import structs
+        from nomad_tpu.scheduler import new_scheduler
+        from nomad_tpu.structs import Evaluation, generate_uuid
+
+        class _P:
+            def submit_plan(self, plan):
+                result = evaluate_plan(state.snapshot(), plan)
+                result.alloc_index = N_NODES + 2
+                placed[i] = sum(b.n for b in result.alloc_batches)
+                placed[i] += sum(
+                    len(v) for v in result.node_allocation.values()
+                )
+                return result, None
+
+            def update_eval(self, ev):
+                pass
+
+            def create_eval(self, ev):
+                pass
+
+        ev = Evaluation(
+            id=generate_uuid(), priority=jobs[i].priority, type=jobs[i].type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=jobs[i].id,
+        )
+        sched = new_scheduler(
+            "tpu-batch", state.snapshot(), _P(), logging.getLogger("bench")
+        )
+        sched.process(ev)
+
+    # Warmup compiles the batched program shapes
+    one(0)
+    threads = [
+        threading.Thread(target=one, args=(i,)) for i in range(len(jobs))
+    ]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - start
+    return wall, sum(placed)
+
+
 def main():
     import jax
 
@@ -188,6 +259,8 @@ def main():
     e2e_p50 = statistics.median(e2e_times)
     placements_per_sec = placed / solve_p50
 
+    coalesce_wall, coalesce_placed = run_coalesced(nodes)
+
     print(
         json.dumps(
             {
@@ -202,6 +275,9 @@ def main():
                 "placed": placed,
                 "n_nodes": N_NODES,
                 "n_tasks": N_TASKS,
+                "coalesced_evals": COALESCE_EVALS,
+                "coalesced_wall_ms": round(coalesce_wall * 1000, 2),
+                "coalesced_placed": coalesce_placed,
                 "backend": jax.default_backend(),
             }
         )

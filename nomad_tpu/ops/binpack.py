@@ -340,20 +340,17 @@ def solve_counts_async(
     """Water-fill dispatch returning per-node placement *counts* — the
     columnar form consumed by AllocBatch. One device round-trip; no
     per-placement expansion at all. fetch() -> (counts[N] np.int32,
-    n_unplaced int)."""
-    import numpy as np
+    n_unplaced int).
 
-    counts_dev, remaining_dev = solve_waterfill(
+    Routed through the coalescing engine: concurrent workers' solves stack
+    into a single vmapped dispatch (ops/coalesce.py)."""
+    from nomad_tpu.ops.coalesce import GLOBAL_SOLVER
+
+    return GLOBAL_SOLVER.submit(
         total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
-        eligible, ask, bw_ask, jnp.int32(count),
-        device_const("f32", penalty), job_distinct, tg_distinct,
+        eligible, ask, bw_ask, count, penalty,
+        job_distinct=job_distinct, tg_distinct=tg_distinct,
     )
-
-    def fetch_counts():
-        counts, remaining = jax.device_get((counts_dev, remaining_dev))
-        return np.asarray(counts), int(remaining)
-
-    return fetch_counts
 
 
 def solve_many(

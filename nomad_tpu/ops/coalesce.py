@@ -1,0 +1,197 @@
+"""Coalescing solve engine: many concurrent evals, one device dispatch.
+
+The TPU reformulation of the reference's optimistic concurrency
+(/root/reference/nomad/worker.go:45-125 — N workers schedule simultaneously
+against snapshots; conflicts surface at plan apply). Here concurrent
+workers' counts-solves are stacked on an eval axis and dispatched as ONE
+vmapped water-fill, so K in-flight evaluations cost one device round trip
+instead of K. This is the dispatch half of the broker's coalescing dequeue
+(eval_broker.py dequeue_batch; SURVEY.md §7 "Batched evals").
+
+No artificial batching window: the dispatcher drains whatever is pending
+the moment it wakes, so an idle system pays ~zero added latency while a
+busy one coalesces naturally (submissions arriving during an in-flight
+dispatch pile up for the next one).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nomad_tpu.ops.binpack import solve_waterfill
+
+
+@partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
+def solve_waterfill_batched(
+    total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+    eligible, ask, bw_ask, count, penalty, job_distinct, tg_distinct,
+):
+    """vmap of the closed-form water-fill over the eval axis. Every input
+    is stacked on axis 0 ([B, ...]); evals solve independently against
+    their own optimistic view, like concurrent reference workers."""
+    return jax.vmap(
+        solve_waterfill,
+        in_axes=(0,) * 12 + (None, None),
+    )(
+        total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+        eligible, ask, bw_ask, count, penalty, job_distinct, tg_distinct,
+    )
+
+
+class _Entry:
+    __slots__ = ("args", "event", "group", "index")
+
+    def __init__(self, args):
+        self.args = args
+        self.event = threading.Event()
+        self.group: Optional["_Group"] = None
+        self.index = 0
+
+
+class _Group:
+    """One dispatched batch: device arrays + lazily-fetched host results."""
+
+    __slots__ = ("counts_dev", "remaining_dev", "_fetch_lock", "_host")
+
+    def __init__(self, counts_dev, remaining_dev):
+        self.counts_dev = counts_dev
+        self.remaining_dev = remaining_dev
+        self._fetch_lock = threading.Lock()
+        self._host = None
+
+    def fetch(self, index: int) -> Tuple[np.ndarray, int]:
+        with self._fetch_lock:
+            if self._host is None:
+                counts, remaining = jax.device_get(
+                    (self.counts_dev, self.remaining_dev)
+                )
+                self._host = (np.asarray(counts), np.asarray(remaining))
+        counts, remaining = self._host
+        return counts[index], int(remaining[index])
+
+
+class CoalescingSolver:
+    """Process-wide dispatcher stacking concurrent counts-solves.
+
+    submit(...) returns a fetch() closure with the same contract as
+    binpack.solve_counts_async: () -> (counts[N] np.int32, n_unplaced).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[_Entry] = []
+        self._thread: Optional[threading.Thread] = None
+        # Observability: how many dispatches carried how many evals.
+        self.dispatches = 0
+        self.coalesced = 0
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="solve-coalescer"
+            )
+            self._thread.start()
+
+    def submit(
+        self, total, sched_cap, used0, job_count0, tg_count0, bw_avail,
+        bw_used0, eligible, ask, bw_ask, count: int, penalty: float,
+        job_distinct: bool = False, tg_distinct: bool = False,
+    ):
+        entry = _Entry((
+            total, sched_cap, used0, job_count0, tg_count0, bw_avail,
+            bw_used0, eligible, ask, bw_ask, count, penalty,
+            bool(job_distinct), bool(tg_distinct),
+        ))
+        with self._cond:
+            self._ensure_thread()
+            self._pending.append(entry)
+            self._cond.notify()
+
+        def fetch():
+            entry.event.wait()
+            return entry.group.fetch(entry.index)
+
+        return fetch
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    self._cond.wait()
+                batch = self._pending
+                self._pending = []
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: List[_Entry]) -> None:
+        # Group by (padded node count, static flags): only same-shaped,
+        # same-specialization solves stack into one program.
+        groups: Dict[Tuple, List[_Entry]] = {}
+        for e in batch:
+            total = e.args[0]
+            key = (total.shape[0], e.args[12], e.args[13])
+            groups.setdefault(key, []).append(e)
+
+        for (n, jd, td), entries in groups.items():
+            try:
+                self._dispatch_group(entries, jd, td)
+            except Exception:
+                # Fail open: solve each entry individually so waiters
+                # never hang on a batch-level error.
+                for e in entries:
+                    try:
+                        counts_dev, remaining_dev = solve_waterfill(
+                            *e.args[:10], jnp.int32(e.args[10]),
+                            jnp.float32(e.args[11]), e.args[12], e.args[13],
+                        )
+                        e.group = _Group(counts_dev, remaining_dev)
+                        e.index = 0
+                    finally:
+                        e.event.set()
+
+    def _dispatch_group(self, entries: List[_Entry], jd: bool, td: bool) -> None:
+        self.dispatches += 1
+        if len(entries) == 1:
+            e = entries[0]
+            counts_dev, remaining_dev = solve_waterfill(
+                *e.args[:10], jnp.int32(e.args[10]), jnp.float32(e.args[11]),
+                jd, td,
+            )
+            e.group = _Group(counts_dev[None], remaining_dev[None])
+            e.index = 0
+            e.event.set()
+            return
+
+        self.coalesced += len(entries)
+        # Pad the eval axis to a power-of-two bucket so the jit cache sees
+        # a handful of batch shapes, not one per load level. Padding rows
+        # repeat entry 0 with count=0 (a no-op solve).
+        from nomad_tpu.ops.binpack import bucket
+
+        b = bucket(len(entries), floor=2)
+        rows = [e.args for e in entries]
+        rows.extend([entries[0].args[:10] + (0, 0.0, jd, td)] * (b - len(rows)))
+        cols = list(zip(*(r[:10] for r in rows)))
+        stacked = [jnp.stack(col) for col in cols]
+        counts = jnp.asarray([r[10] for r in rows], dtype=jnp.int32)
+        penalties = jnp.asarray([r[11] for r in rows], dtype=jnp.float32)
+        counts_dev, remaining_dev = solve_waterfill_batched(
+            *stacked, counts, penalties, jd, td,
+        )
+        group = _Group(counts_dev, remaining_dev)
+        for i, e in enumerate(entries):
+            e.group = group
+            e.index = i
+            e.event.set()
+
+
+# Process-wide engine shared by all workers (like GLOBAL_MIRROR_CACHE).
+GLOBAL_SOLVER = CoalescingSolver()

@@ -83,27 +83,60 @@ def _register_builtins() -> None:
 
     # The TPU factories live behind a lazy import so the control plane can
     # run host-only (e.g. on machines without jax). If the device backend
-    # cannot initialize at all, fall back to the host solver instead of
-    # failing every evaluation — same placements, scalar speed.
-    _device_probe: Dict[str, bool] = {}
+    # cannot initialize — or hangs (a wedged remote-device tunnel blocks
+    # inside jax.devices() indefinitely) — fall back to the host solver
+    # instead of wedging every worker thread: same placements, scalar
+    # speed. Unavailability is re-probed after a cooldown so a recovered
+    # device comes back without a restart.
+    import threading as _threading
+    import time as _time
+
+    _device_probe: Dict[str, object] = {}
+    _probe_lock = _threading.Lock()
+    PROBE_TIMEOUT = 15.0
+    PROBE_RETRY = 60.0
 
     def _tpu_solver(logger):
-        """Import + probe once; None if the device path cannot come up."""
-        if "solver" not in _device_probe:
-            try:
-                import jax
+        """Import + probe with a timeout; None while the device path is
+        unavailable (retried after a cooldown)."""
+        with _probe_lock:
+            if "solver" in _device_probe:
+                cached = _device_probe["solver"]
+                if cached is not None:
+                    return cached
+                if _time.monotonic() < _device_probe.get("retry_at", 0):
+                    return None
 
-                jax.devices()
-                from nomad_tpu.tpu import solver
+            box: Dict[str, object] = {}
 
-                _device_probe["solver"] = solver
-            except Exception as e:
+            def probe():
+                try:
+                    import jax
+
+                    jax.devices()
+                    from nomad_tpu.tpu import solver
+
+                    box["solver"] = solver
+                except Exception as e:
+                    box["error"] = e
+
+            t = _threading.Thread(target=probe, daemon=True,
+                                  name="tpu-device-probe")
+            t.start()
+            t.join(PROBE_TIMEOUT)
+            solver = box.get("solver")
+            if solver is None:
+                reason = box.get("error", "probe timed out")
                 logger.warning(
-                    "jax device backend unavailable (%s); "
-                    "TPU factories fall back to the host scheduler", e,
+                    "jax device backend unavailable (%s); TPU factories "
+                    "fall back to the host scheduler for %.0fs",
+                    reason, PROBE_RETRY,
                 )
                 _device_probe["solver"] = None
-        return _device_probe["solver"]
+                _device_probe["retry_at"] = _time.monotonic() + PROBE_RETRY
+                return None
+            _device_probe["solver"] = solver
+            return solver
 
     def _lazy_tpu(variant: str) -> Factory:
         def factory(state, planner, logger):

@@ -155,7 +155,7 @@ class GenericScheduler:
         for e in diff.stop:
             self.plan.append_update(e.alloc, ALLOC_DESIRED_STATUS_STOP, ALLOC_NOT_NEEDED)
 
-        diff.update = inplace_update(self.ctx, self.eval, self.job, self.stack, diff.update)
+        diff.update = self.inplace_updates(diff.update)
 
         limit = [len(diff.update) + len(diff.migrate)]
         if self.job is not None and self.job.update.rolling():
@@ -172,6 +172,12 @@ class GenericScheduler:
         if not diff.place:
             return
         self.compute_placements(diff.place)
+
+    def inplace_updates(self, updates: List[AllocTuple]) -> List[AllocTuple]:
+        """In-place update attempt; returns the updates still needing
+        destructive handling. Seam for the TPU scheduler's columnar
+        variant."""
+        return inplace_update(self.ctx, self.eval, self.job, self.stack, updates)
 
     def compute_placements(self, place: List[AllocTuple]) -> None:
         """Place missing allocations via the stack

@@ -58,6 +58,11 @@ def evaluate_node_plan(snap, plan: Plan, node_id: str,
 FAST_VERIFY_THRESHOLD = 64
 
 
+def _node_live(snap, node_id: str) -> bool:
+    node = snap.node_by_id(node_id)
+    return node is not None and node.status == "ready" and not node.drain
+
+
 def _res_vec(res) -> "np.ndarray":
     import numpy as np
 
@@ -133,14 +138,28 @@ def _prevaluate_nodes_bulk(snap, plan: Plan, batch_ask=None):
         if existing:
             removed = {a.id for a in plan.node_update.get(nid, [])}
             removed.update(a.id for a in placements)
+            # Identity-counted accumulation: existing allocs share a few
+            # Resources objects, so this is dict hits + one multiply-add
+            # per distinct shape instead of a numpy add per alloc. Keyed
+            # by the (resources, task_resources) pair — has_net depends on
+            # both (alloc_row's net_cache key).
+            ex_counts = {}
             for alloc in existing:
                 if alloc.id in removed:
                     continue
-                vec, has_net = alloc_row(alloc)
-                if has_net:
-                    bail = True
-                    break
-                base = base + vec
+                key = (id(alloc.resources), id(alloc.task_resources))
+                n = ex_counts.get(key)
+                if n is None:
+                    _vec, has_net = alloc_row(alloc)
+                    if has_net:
+                        bail = True
+                        break
+                    ex_counts[key] = 1
+                else:
+                    ex_counts[key] = n + 1
+            if not bail:
+                for key, n in ex_counts.items():
+                    base = base + vec_cache[key[0]] * n
         if bail:
             continue
 
@@ -205,9 +224,42 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
             prev = batch_ask.get(nid)
             batch_ask[nid] = vec * cnt if prev is None else prev + vec * cnt
 
+    # In-place update batches contribute their per-node (new - old)
+    # resource delta; delta-free nodes only need a liveness check. Wire-
+    # received batches resolve ids against this snapshot first (stale ids
+    # drop out -> partial commit). Old vectors are identity-counted: a
+    # batch's allocs share a handful of Resources objects, so per-alloc
+    # work is dict hits, not numpy.
+    upd_nodes = set()
+    for b in plan.update_batches:
+        b.resolve(snap)
+        new_vec = np.asarray(b.resource_vector(), dtype=np.int64)
+        counts = {}
+        old_vecs = {}
+        for a in b.allocs:
+            upd_nodes.add(a.node_id)
+            key = (a.node_id, id(a.resources))
+            n = counts.get(key)
+            if n is None:
+                counts[key] = 1
+                old_vecs[key] = (
+                    np.asarray(a.resources.as_vector(), dtype=np.int64)
+                    if a.resources is not None
+                    else np.zeros(4, dtype=np.int64)
+                )
+            else:
+                counts[key] = n + 1
+        for key, cnt in counts.items():
+            delta = (new_vec - old_vecs[key]) * cnt
+            if np.any(delta):
+                nid = key[0]
+                prev = batch_ask.get(nid)
+                batch_ask[nid] = delta if prev is None else prev + delta
+
     bulk_fit = {}
     n_placements = sum(len(v) for v in plan.node_allocation.values())
     n_placements += sum(b.n for b in plan.alloc_batches)
+    n_placements += sum(b.n for b in plan.update_batches)
     if n_placements >= FAST_VERIFY_THRESHOLD:
         bulk_fit = _prevaluate_nodes_bulk(snap, plan, batch_ask)
 
@@ -223,11 +275,22 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
         )
 
     fits = {}
-    node_ids = set(plan.node_update) | set(plan.node_allocation) | set(batch_ask)
+    node_ids = (set(plan.node_update) | set(plan.node_allocation)
+                | set(batch_ask) | upd_nodes)
     for node_id in node_ids:
         fit = bulk_fit.get(node_id)
         if fit is None:
-            fit = evaluate_node_plan(snap, plan, node_id, batch_res(node_id))
+            if (node_id in upd_nodes
+                    and not plan.node_allocation.get(node_id)
+                    and node_id not in batch_ask
+                    and not plan.node_update.get(node_id)):
+                fit = _node_live(snap, node_id)
+            else:
+                fit = evaluate_node_plan(snap, plan, node_id, batch_res(node_id))
+                if fit and node_id in upd_nodes:
+                    # evaluate_node_plan's evict-only shortcut skips the
+                    # liveness check; re-stamped allocs need a live node.
+                    fit = _node_live(snap, node_id)
         fits[node_id] = fit
         if not fit:
             # Stale scheduler data: force a refresh to the latest view.
@@ -247,6 +310,10 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
         kept = b.filter_nodes(fits)
         if kept.n:
             result.alloc_batches.append(kept)
+    for b in plan.update_batches:
+        kept = b.filter_nodes(fits)
+        if kept.n:
+            result.update_batches.append(kept)
     return result
 
 
@@ -257,6 +324,8 @@ def _flatten_result(result: PlanResult) -> list:
     for alloc_list in result.node_allocation.values():
         allocs.extend(alloc_list)
     for batch in result.alloc_batches:
+        allocs.extend(batch.materialize())
+    for batch in result.update_batches:
         allocs.extend(batch.materialize())
     allocs.extend(result.failed_allocs)
     return allocs

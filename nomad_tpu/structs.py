@@ -835,12 +835,131 @@ class AllocBatch:
         )
 
 
+class AllocUpdateBatch:
+    """Columnar in-place update block: re-stamp existing allocations with a
+    new job version without per-allocation device selects or object churn
+    in the scheduler (reference semantics: util.go:316-398 inplaceUpdate).
+    tasksUpdated (util.go:265-302) deliberately ignores cpu/mem changes,
+    so an in-place update may grow or shrink the allocation: feasibility
+    is the per-node sum of (new - old) resource deltas against current
+    usage, checked vectorized by the scheduler and re-checked by plan
+    evaluation.
+
+    Locally the batch holds references to the existing allocations; on the
+    wire it carries only their ids (the receiving server re-resolves them
+    against its own state), plus the shared replacement fields.
+    """
+
+    __slots__ = ("eval_id", "job", "tg_name", "resources", "task_resources",
+                 "metrics", "allocs", "alloc_ids")
+
+    def __init__(self, eval_id="", job=None, tg_name="", resources=None,
+                 task_resources=None, metrics=None, allocs=None,
+                 alloc_ids=None):
+        self.eval_id = eval_id
+        self.job = job
+        self.tg_name = tg_name
+        self.resources = resources
+        self.task_resources = task_resources or {}
+        self.metrics = metrics
+        self.allocs: List[Allocation] = allocs or []
+        # Wire-side form: ids only, resolved via snapshot at materialize.
+        self.alloc_ids: List[str] = alloc_ids or [
+            a.id for a in (allocs or [])
+        ]
+
+    @property
+    def n(self) -> int:
+        return len(self.alloc_ids)
+
+    def node_ids(self) -> List[str]:
+        return [a.node_id for a in self.allocs]
+
+    def resource_vector(self) -> List[int]:
+        if self.resources is None:
+            return [0, 0, 0, 0]
+        return self.resources.as_vector()
+
+    def resolve(self, snap) -> None:
+        """Rebind alloc references from ids against a state snapshot (the
+        wire path). Unknown ids are dropped — they were removed while the
+        plan was in flight, exactly the staleness plan evaluation guards."""
+        if self.allocs and len(self.allocs) == len(self.alloc_ids):
+            return
+        out = []
+        for aid in self.alloc_ids:
+            a = snap.alloc_by_id(aid)
+            if a is not None:
+                out.append(a)
+        self.allocs = out
+        self.alloc_ids = [a.id for a in out]
+
+    def filter_nodes(self, fit: Dict[str, bool]) -> "AllocUpdateBatch":
+        if all(fit.get(a.node_id, False) for a in self.allocs):
+            return self
+        kept = [a for a in self.allocs if fit.get(a.node_id, False)]
+        return AllocUpdateBatch(
+            eval_id=self.eval_id, job=self.job, tg_name=self.tg_name,
+            resources=self.resources, task_resources=self.task_resources,
+            metrics=self.metrics, allocs=kept,
+        )
+
+    def materialize(self) -> List["Allocation"]:
+        out = []
+        for alloc in self.allocs:
+            new_alloc = alloc.copy()
+            new_alloc.eval_id = self.eval_id
+            new_alloc.job = self.job
+            if self.resources is not None:
+                new_alloc.resources = self.resources
+            if self.task_resources:
+                new_alloc.task_resources = self.task_resources
+            new_alloc.metrics = self.metrics
+            new_alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
+            new_alloc.desired_description = ""
+            new_alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+            out.append(new_alloc)
+        return out
+
+    def to_wire(self) -> dict:
+        from nomad_tpu.api.codec import to_dict
+
+        return {
+            "kind": "update",
+            "eval_id": self.eval_id,
+            "job": to_dict(self.job),
+            "tg_name": self.tg_name,
+            "resources": to_dict(self.resources),
+            "task_resources": to_dict(self.task_resources),
+            "metrics": to_dict(self.metrics),
+            "alloc_ids": list(self.alloc_ids),
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "AllocUpdateBatch":
+        from nomad_tpu.api.codec import from_dict
+
+        return AllocUpdateBatch(
+            eval_id=d.get("eval_id", ""),
+            job=from_dict(Job, d.get("job")),
+            tg_name=d.get("tg_name", ""),
+            resources=from_dict(Resources, d.get("resources")),
+            task_resources={
+                k: from_dict(Resources, v)
+                for k, v in (d.get("task_resources") or {}).items()
+            },
+            metrics=from_dict(AllocMetric, d.get("metrics")),
+            alloc_ids=d.get("alloc_ids") or [],
+        )
+
+
 @dataclass
 class Plan:
     """Commit plan for task allocations (reference: structs.go:1462-1532).
 
     ``alloc_batches`` extends the reference's per-node Allocation lists with
-    columnar placement blocks (AllocBatch) for large solves."""
+    columnar placement blocks (AllocBatch) for large solves;
+    ``update_batches`` carries columnar in-place updates."""
 
     eval_id: str = ""
     eval_token: str = ""
@@ -850,6 +969,7 @@ class Plan:
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     failed_allocs: List[Allocation] = field(default_factory=list)
     alloc_batches: List[AllocBatch] = field(default_factory=list)
+    update_batches: List[AllocUpdateBatch] = field(default_factory=list)
 
     def append_update(self, alloc: Allocation, status: str, desc: str) -> None:
         new_alloc = alloc.copy()
@@ -870,6 +990,9 @@ class Plan:
     def append_batch(self, batch: AllocBatch) -> None:
         self.alloc_batches.append(batch)
 
+    def append_update_batch(self, batch: AllocUpdateBatch) -> None:
+        self.update_batches.append(batch)
+
     def append_failed(self, alloc: Allocation) -> None:
         self.failed_allocs.append(alloc)
 
@@ -879,6 +1002,7 @@ class Plan:
             and not self.node_allocation
             and not self.failed_allocs
             and not self.alloc_batches
+            and not self.update_batches
         )
 
 
@@ -890,6 +1014,7 @@ class PlanResult:
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     failed_allocs: List[Allocation] = field(default_factory=list)
     alloc_batches: List[AllocBatch] = field(default_factory=list)
+    update_batches: List[AllocUpdateBatch] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
 
@@ -899,6 +1024,7 @@ class PlanResult:
             and not self.node_allocation
             and not self.failed_allocs
             and not self.alloc_batches
+            and not self.update_batches
         )
 
     def full_commit(self, plan: Plan) -> Tuple[bool, int, int]:
@@ -909,6 +1035,8 @@ class PlanResult:
             actual += len(self.node_allocation.get(node_id, []))
         expected += sum(b.n for b in plan.alloc_batches)
         actual += sum(b.n for b in self.alloc_batches)
+        expected += sum(b.n for b in plan.update_batches)
+        actual += sum(b.n for b in self.update_batches)
         return actual == expected, expected, actual
 
 

@@ -202,6 +202,32 @@ class NodeMirror:
                     job_count[i] += cnt
                     if b.tg_name == tg_name:
                         tg_count[i] += cnt
+        # Columnar in-place updates contribute their (new - old) resource
+        # delta — the existing allocs were already counted at their old
+        # size above. Identity-counted per (node, old resources).
+        for b in ctx.plan.update_batches:
+            new_vec = np.asarray(b.resource_vector(), dtype=np.int64)
+            counts: Dict[Tuple[str, int], int] = {}
+            vecs: Dict[int, np.ndarray] = {}
+            for a in b.allocs:
+                key = (a.node_id, id(a.resources))
+                n = counts.get(key)
+                if n is None:
+                    counts[key] = 1
+                    vecs[id(a.resources)] = (
+                        np.asarray(a.resources.as_vector(), dtype=np.int64)
+                        if a.resources is not None
+                        else np.zeros(4, dtype=np.int64)
+                    )
+                else:
+                    counts[key] = n + 1
+            for (nid, rid), cnt in counts.items():
+                i = self.index.get(nid)
+                if i is None:
+                    continue
+                delta = (new_vec - vecs[rid]) * cnt
+                if delta.any():
+                    used[i] += delta.astype(np.int32)
         return (
             jnp.asarray(used),
             jnp.asarray(job_count),

@@ -344,7 +344,8 @@ class TPUGenericScheduler(GenericScheduler):
         return TPUStack(ctx, batch=self.batch)
 
     def compute_job_allocs(self) -> None:
-        """Placement-only fast paths, skipping name materialization:
+        """Columnar reconcile fast path, skipping name materialization and
+        per-alloc diff objects:
 
         - Fresh registration: no existing allocations means stop/update/
           migrate are empty by definition (util.go:54-131 degenerates to
@@ -355,9 +356,14 @@ class TPUGenericScheduler(GenericScheduler):
           the missing indices are recovered by parsing the count-expansion
           names of the *existing* allocs (len(existing) parses instead of
           count string materializations), and only those place.
+        - Pure in-place update: allocs differ only by job version with
+          tasks_updated false (util.go:265-302) — they re-stamp columnar
+          via AllocUpdateBatch under a per-node delta headroom check,
+          never touching the per-alloc select (util.go:316-398).
 
-        Anything needing stop/migrate/update falls through to the
-        reference-shaped object diff (generic_sched.go:186-243).
+        Anything needing stops, migrations, destructive updates, or
+        network reoffers falls through to the reference-shaped object
+        diff (generic_sched.go:186-243).
         """
         job = self.job
         if job is None:
@@ -367,11 +373,21 @@ class TPUGenericScheduler(GenericScheduler):
         )
 
         if existing:
-            existing_idx = self._pure_scaleup_indices(existing)
-            if existing_idx is None:
+            reconciled = self._fast_reconcile(existing)
+            if reconciled is None:
                 return super().compute_job_allocs()
+            existing_idx, updates_by_tg = reconciled
         else:
-            existing_idx = {}
+            existing_idx, updates_by_tg = {}, {}
+
+        if updates_by_tg:
+            batches, leftovers = self._plan_update_batches(updates_by_tg)
+            if leftovers:
+                # Overflowing nodes need the evict-and-place machinery:
+                # take the full reference-shaped diff instead.
+                return super().compute_job_allocs()
+            for b in batches:
+                self.ctx.plan.append_update_batch(b)
 
         big, small = [], []
         for tg in job.task_groups:
@@ -407,30 +423,235 @@ class TPUGenericScheduler(GenericScheduler):
         for tg, missing in big:
             self._place_batch(tg, missing)
 
-    def _pure_scaleup_indices(self, existing) -> Optional[Dict[str, set]]:
-        """If every existing alloc of this job is an 'ignore' under the
-        five-way diff (util.go:54-131), return {tg_name: occupied index
-        set}; otherwise None (caller takes the full object diff)."""
+    def _constraints_unchanged(self, old_job, old_tg, new_tg) -> bool:
+        """Whether the feasibility criteria (job + tg + per-task
+        constraints, datacenters, drivers) are identical between job
+        versions. tasks_updated ignores these, but they gate whether the
+        in-place node is still eligible."""
+        job = self.job
+        if (old_job.constraints != job.constraints
+                or old_job.datacenters != job.datacenters
+                or old_tg.constraints != new_tg.constraints):
+            return False
+        for nt in new_tg.tasks:
+            ot = old_tg.lookup_task(nt.name)
+            if ot is None or ot.constraints != nt.constraints:
+                return False
+        return True
+
+    def _plan_update_batches(self, updates_by_tg):
+        """Plan one AllocUpdateBatch per task group for columnar in-place
+        updates, admitting per node within delta headroom. Old resource
+        vectors are identity-cached: allocs of one batch share a single
+        Resources object, so this is dict hits, not numpy per alloc.
+        Returns (batches, leftover_allocs) — leftovers exceeded some
+        node's headroom and need the per-alloc path."""
+        from nomad_tpu.structs import AllocUpdateBatch
+
+        state = self.ctx.state
+        vec_cache: Dict[int, np.ndarray] = {}
+
+        def vec(res):
+            key = id(res)
+            v = vec_cache.get(key)
+            if v is None:
+                v = (np.zeros(4, dtype=np.int64) if res is None
+                     else np.asarray(res.as_vector(), dtype=np.int64))
+                vec_cache[key] = v
+            return v
+
+        # Per-node current usage -> headroom, shared across groups.
+        headroom: Dict[str, Optional[np.ndarray]] = {}
+
+        def node_headroom(nid):
+            h = headroom.get(nid, False)
+            if h is not False:
+                return h
+            node = state.node_by_id(nid)
+            if node is None or node.resources is None:
+                headroom[nid] = None
+                return None
+            used = vec(node.reserved).copy()
+            # Identity-counted accumulation over the proposed view
+            counts: Dict[int, int] = {}
+            for a in self.ctx.proposed_allocs(nid):
+                key = id(a.resources)
+                counts[key] = counts.get(key, 0) + 1
+                if key not in vec_cache:
+                    vec(a.resources)
+            for key, n in counts.items():
+                used += vec_cache[key] * n
+            h = vec(node.resources) - used
+            headroom[nid] = h
+            return h
+
+        batches = []
+        all_leftovers = []
+        for tg, allocs in updates_by_tg.values():
+            size = task_group_constraints(tg).size
+            new_vec = np.asarray(size.as_vector(), dtype=np.int64)
+            # Group by (node, old-resources identity): one delta check per
+            # group instead of per alloc.
+            groups: Dict[Tuple[str, int], list] = {}
+            for a in allocs:
+                groups.setdefault((a.node_id, id(a.resources)), []).append(a)
+
+            batch_allocs = []
+            for (nid, _res_key), members in groups.items():
+                h = node_headroom(nid)
+                if h is None:
+                    all_leftovers.extend((tg, a) for a in members)
+                    continue
+                delta = new_vec - vec(members[0].resources)
+                if not delta.any():
+                    batch_allocs.extend(members)
+                    continue
+                # Admit the largest k with h - k*delta >= 0 on growth dims.
+                grow = delta > 0
+                if grow.any():
+                    k = int(np.min(h[grow] // delta[grow]))
+                    k = max(0, min(k, len(members)))
+                else:
+                    k = len(members)
+                if k:
+                    headroom[nid] = h - delta * k
+                    batch_allocs.extend(members[:k])
+                all_leftovers.extend((tg, a) for a in members[k:])
+
+            if batch_allocs:
+                batches.append(AllocUpdateBatch(
+                    eval_id=self.eval.id,
+                    job=self.job,
+                    tg_name=tg.name,
+                    resources=size,
+                    task_resources={t.name: t.resources for t in tg.tasks},
+                    metrics=self.ctx.metrics(),
+                    allocs=batch_allocs,
+                ))
+        return batches, all_leftovers
+
+    def inplace_updates(self, updates):
+        """Columnar in-place updates for the object-diff path: eligible
+        task groups (tasks_updated false, util.go:265-302, and network-
+        free) batch through _plan_update_batches; networks, real task
+        changes, and headroom-overflow leftovers take the reference's
+        per-alloc path (util.go:316-398)."""
+        from nomad_tpu.scheduler.util import tasks_updated
+
+        if len(updates) < self.BATCH_PLACE_THRESHOLD:
+            return super().inplace_updates(updates)
+
+        by_tg: Dict[int, Tuple[TaskGroup, list]] = {}
+        rest = []
+        for u in updates:
+            existing_tg = u.alloc.job.lookup_task_group(u.task_group.name)
+            if (existing_tg is None
+                    or tasks_updated(u.task_group, existing_tg)
+                    or not self._constraints_unchanged(
+                        u.alloc.job, existing_tg, u.task_group)):
+                rest.append(u)
+                continue
+            has_net = any(
+                t.resources is not None and t.resources.networks
+                for t in u.task_group.tasks
+            ) or any(
+                tr is not None and tr.networks
+                for tr in (u.alloc.task_resources or {}).values()
+            )
+            if has_net:
+                rest.append(u)
+                continue
+            by_tg.setdefault(
+                id(u.task_group), (u.task_group, [])
+            )[1].append(u.alloc)
+
+        if not by_tg:
+            return super().inplace_updates(rest) if rest else rest
+
+        batches, leftovers = self._plan_update_batches(by_tg)
+        for b in batches:
+            self.ctx.plan.append_update_batch(b)
+        rest.extend(AllocTuple(a.name, tg, a) for tg, a in leftovers)
+        self.logger.debug(
+            "sched: %s: %d columnar in-place updates of %d",
+            self.eval, sum(b.n for b in batches), len(updates),
+        )
+        return super().inplace_updates(rest) if rest else rest
+
+    def _fast_reconcile(self, existing):
+        """Classify every existing alloc of this job as 'ignore' or
+        'in-place update' under the five-way diff (util.go:54-131).
+        Returns ({tg_name: occupied index set}, {tg_key: (tg, [allocs to
+        update])}); or None when anything needs stops, migrations, or the
+        destructive path — the caller then takes the full object diff.
+        Per-alloc work is dict hits: job-version and task-group checks are
+        cached by identity (allocs share their job/resources objects)."""
+        from nomad_tpu.scheduler.util import tasks_updated
+
         job = self.job
         tainted = tainted_nodes(self.state, existing)
         if any(tainted.values()):
             return None
         tg_by_name = {tg.name: tg for tg in job.task_groups}
-        out: Dict[str, set] = {}
+
+        # One cheap pass: bucket allocs per task-group name.
+        by_tg_name: Dict[str, list] = {}
         for a in existing:
-            if a.job.modify_index != job.modify_index:
-                return None  # in-place update / rolling path
-            tg = tg_by_name.get(a.task_group)
+            group = by_tg_name.get(a.task_group)
+            if group is None:
+                by_tg_name[a.task_group] = group = []
+            group.append(a)
+
+        occupied: Dict[str, set] = {}
+        updates_by_tg: Dict[int, Tuple[TaskGroup, list]] = {}
+        # identity-cached verdicts for (old job, tg name) pairs
+        updatable_cache: Dict[Tuple[int, str], bool] = {}
+        job_mi = job.modify_index
+        for tg_name, allocs in by_tg_name.items():
+            tg = tg_by_name.get(tg_name)
             if tg is None:
                 return None  # group removed: stops needed
-            try:
-                idx = int(a.name.rsplit("[", 1)[1].rstrip("]"))
-            except (IndexError, ValueError):
-                return None
-            if idx >= tg.count:
+            if len(allocs) > tg.count:
                 return None  # scale-down: stops needed
-            out.setdefault(tg.name, set()).add(idx)
-        return out
+            # Indices must be parsed even for a full-looking group: a
+            # terminal low index plus a live out-of-range one gives
+            # len == count while still needing a stop + a placement.
+            occ = set()
+            for a in allocs:
+                try:
+                    idx = int(a.name.rsplit("[", 1)[1].rstrip("]"))
+                except (IndexError, ValueError):
+                    return None
+                if idx >= tg.count:
+                    return None  # scale-down: stops needed
+                occ.add(idx)
+            occupied[tg_name] = occ
+            for a in allocs:
+                if a.job.modify_index == job_mi:
+                    continue  # ignore
+                # In-place candidate: eligibility cached per old-job/tg
+                key = (id(a.job), tg_name)
+                ok = updatable_cache.get(key)
+                if ok is None:
+                    old_tg = a.job.lookup_task_group(tg_name)
+                    # Constraint surfaces must be unchanged too: the batch
+                    # path skips the per-alloc constraint-masked select the
+                    # reference runs (util.go:346-358), which is only sound
+                    # when feasibility criteria didn't move.
+                    ok = (old_tg is not None
+                          and not tasks_updated(tg, old_tg)
+                          and self._constraints_unchanged(a.job, old_tg, tg)
+                          and not any(
+                              t.resources is not None and t.resources.networks
+                              for t in tg.tasks))
+                    updatable_cache[key] = ok
+                if not ok or any(
+                    tr is not None and tr.networks
+                    for tr in (a.task_resources or {}).values()
+                ):
+                    return None  # destructive / network reoffer path
+                updates_by_tg.setdefault(id(tg), (tg, []))[1].append(a)
+        return occupied, updates_by_tg
 
     def _place_batch(self, tg: TaskGroup, name_indices: "np.ndarray") -> None:
         """Place ``len(name_indices)`` copies of a task group as one

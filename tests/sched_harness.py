@@ -65,6 +65,7 @@ class Harness:
                 node_update=plan.node_update,
                 node_allocation=plan.node_allocation,
                 alloc_batches=plan.alloc_batches,
+                update_batches=plan.update_batches,
                 alloc_index=index,
             )
 
@@ -74,6 +75,8 @@ class Harness:
             for alloc_list in plan.node_allocation.values():
                 allocs.extend(alloc_list)
             for batch in plan.alloc_batches:
+                allocs.extend(batch.materialize())
+            for batch in plan.update_batches:
                 allocs.extend(batch.materialize())
             allocs.extend(plan.failed_allocs)
 

@@ -280,3 +280,189 @@ def test_tainted_node_falls_back_and_migrates():
               if a.desired_status == "run"]
     assert len(placed) == BATCH
     assert all(a.node_id != victim for a in placed)
+
+
+def test_inplace_update_batch_path():
+    """Re-registering a big job with unchanged tasks re-stamps every alloc
+    in place via one AllocUpdateBatch — same ids, same nodes, new job
+    version — without touching the per-alloc select path."""
+    h = Harness()
+    _seed(h, n_nodes=10)
+    job = _big_job(count=BATCH)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+    before = {a.id: a for a in h.state.allocs_by_job(job.id)}
+    assert len(before) == BATCH
+
+    # Re-register a distinct copy (as a wire-crossing registration would
+    # be): modify_index bumps, tasks unchanged -> in-place updates
+    import copy
+
+    job2 = copy.deepcopy(job)
+    h.state.upsert_job(h.next_index(), job2)
+    new_index = job2.modify_index
+    h.process("tpu-batch", _eval_for(job2))
+
+    plan = h.plans[-1]
+    assert plan.update_batches, "expected the columnar in-place path"
+    assert sum(b.n for b in plan.update_batches) == BATCH
+    assert not plan.node_allocation
+
+    after = {a.id: a for a in h.state.allocs_by_job(job.id)
+             if a.desired_status == "run"}
+    assert set(after) == set(before)  # same alloc ids
+    for aid, alloc in after.items():
+        assert alloc.node_id == before[aid].node_id
+        assert alloc.job.modify_index == new_index
+
+
+def test_inplace_update_task_change_falls_back_destructive():
+    """Changing a task's driver defeats in-place (tasks_updated true,
+    util.go:265-302): the allocs are evicted and replaced, not
+    batch-updated."""
+    h = Harness()
+    _seed(h, n_nodes=10)
+    job = _big_job(count=BATCH, cpu=100)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+
+    import copy
+
+    job2 = copy.deepcopy(job)
+    job2.task_groups[0].tasks[0].driver = "raw_exec"
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("tpu-batch", _eval_for(job2))
+
+    plan = h.plans[-1]
+    assert not plan.update_batches
+    stops = sum(len(v) for v in plan.node_update.values())
+    assert stops == BATCH  # destructive: every alloc evicted + replaced
+
+
+def test_inplace_update_resource_growth_checked_against_headroom():
+    """tasks_updated ignores cpu changes (util.go:265-302), so a resource
+    grow updates in place — but only within per-node headroom; overflow
+    falls back to the per-alloc path and is evicted/replaced."""
+    h = Harness()
+    _seed(h, n_nodes=10)
+    job = _big_job(count=BATCH, cpu=100)  # 30 per node across 10 nodes
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+
+    import copy
+
+    job2 = copy.deepcopy(job)
+    # 100 -> 120 cpu: 30 allocs/node avg * 120 = 3600 <= 3900: all fit
+    job2.task_groups[0].tasks[0].resources = Resources(cpu=120, memory_mb=128)
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("tpu-batch", _eval_for(job2))
+
+    plan = h.plans[-1]
+    assert plan.update_batches
+    run = [a for a in h.state.allocs_by_job(job.id)
+           if a.desired_status == "run"]
+    assert len(run) == BATCH
+    assert all(a.resources.cpu == 120 for a in run)
+
+
+def test_update_batch_wire_resolves_against_state():
+    """An update batch arriving over the wire carries only alloc ids; plan
+    evaluation resolves them against the snapshot and drops stale ids."""
+    import json
+
+    from nomad_tpu.structs import AllocUpdateBatch
+
+    h = Harness()
+    _seed(h, n_nodes=4)
+    job = _big_job(count=8)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+    allocs = h.state.allocs_by_job(job.id)
+    assert len(allocs) == 8
+
+    batch = AllocUpdateBatch(
+        eval_id="ev9", job=job, tg_name=job.task_groups[0].name,
+        resources=Resources(cpu=100, memory_mb=128),
+        allocs=allocs,
+    )
+    plan = Plan(eval_id="ev9", eval_token="t", priority=50)
+    plan.append_update_batch(batch)
+
+    wire = json.loads(json.dumps(to_dict(plan)))
+    assert wire["update_batches"][0]["alloc_ids"]
+    assert "allocs" not in wire["update_batches"][0]
+    back = from_dict(Plan, wire)
+    # Tamper: one stale id
+    back.update_batches[0].alloc_ids.append("not-a-real-alloc")
+
+    result = evaluate_plan(h.state.snapshot(), back)
+    committed = result.update_batches
+    assert sum(b.n for b in committed) == 8  # stale id dropped
+    materialized = [a for b in committed for a in b.materialize()]
+    assert {a.id for a in materialized} == {a.id for a in allocs}
+    assert all(a.eval_id == "ev9" for a in materialized)
+
+
+def test_scaledown_with_terminal_low_index_not_masked():
+    """A terminal alloc at a low index plus count-1 re-register: the
+    out-of-range alloc must stop and the low index be replaced — the
+    full-group shortcut must not assume occupancy (diff fidelity,
+    util.go:54-131)."""
+    h = Harness()
+    _seed(h, n_nodes=10)
+    job = _big_job(count=BATCH)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+
+    # Kill alloc [0]
+    allocs = h.state.allocs_by_job(job.id)
+    victim = next(a for a in allocs if a.name.endswith("[0]"))
+    dead = victim.copy()
+    dead.desired_status = "stop"
+    dead.client_status = "dead"
+    h.state.upsert_allocs(h.next_index(), [dead])
+
+    import copy
+
+    job2 = copy.deepcopy(job)
+    job2.task_groups[0].count = BATCH - 1
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("tpu-batch", _eval_for(job2))
+
+    run = [a for a in h.state.allocs_by_job(job.id)
+           if a.desired_status == "run"]
+    names = sorted(int(a.name.split("[")[1].rstrip("]")) for a in run)
+    assert len(run) == BATCH - 1
+    assert names == list(range(BATCH - 1))  # [0] replaced, [299] stopped
+
+
+def test_constraint_change_defeats_inplace_batch():
+    """Adding a job constraint the current nodes violate must NOT be
+    re-stamped in place: the reference re-runs the constraint-masked
+    select per alloc (util.go:346-358), failing the node and forcing
+    evict-and-place."""
+    from nomad_tpu.structs import Constraint
+
+    h = Harness()
+    nodes = _seed(h, n_nodes=10)
+    # Half the nodes carry a special attribute
+    for i, n in enumerate(nodes):
+        n.attributes["special"] = "yes" if i < 5 else "no"
+        h.state.upsert_node(h.next_index(), n)
+    job = _big_job(count=BATCH)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+
+    import copy
+
+    job2 = copy.deepcopy(job)
+    job2.constraints = list(job2.constraints) + [
+        Constraint(l_target="$attr.special", r_target="yes", operand="=")
+    ]
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("tpu-batch", _eval_for(job2))
+
+    run = [a for a in h.state.allocs_by_job(job.id)
+           if a.desired_status == "run"]
+    good = {n.id for n in nodes[:5]}
+    assert all(a.node_id in good for a in run), "constraint must be re-applied"

@@ -1,0 +1,131 @@
+"""Coalescing solve engine: concurrent evals stack into one vmapped
+dispatch (the device half of the broker's coalescing dequeue,
+SURVEY.md §7 'Batched evals'; concurrency semantics mirror the
+reference's optimistic worker parallelism, nomad/worker.go:45-125)."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nomad_tpu.ops.binpack import solve_waterfill
+from nomad_tpu.ops.coalesce import CoalescingSolver
+
+N = 64
+
+
+def _inputs(ask_cpu, count):
+    total = np.zeros((N, 4), dtype=np.int32)
+    total[:, 0] = 4000
+    total[:, 1] = 8192
+    total[:, 2] = 100 * 1024
+    total[:, 3] = 150
+    return dict(
+        total=jnp.asarray(total),
+        sched_cap=jnp.asarray(total[:, :2].astype(np.float32)),
+        used0=jnp.zeros((N, 4), dtype=jnp.int32),
+        job_count0=jnp.zeros((N,), dtype=jnp.int32),
+        tg_count0=jnp.zeros((N,), dtype=jnp.int32),
+        bw_avail=jnp.full((N,), 1000, dtype=jnp.int32),
+        bw_used0=jnp.zeros((N,), dtype=jnp.int32),
+        eligible=jnp.ones((N,), dtype=bool),
+        ask=jnp.array([ask_cpu, 128, 0, 0], dtype=jnp.int32),
+        bw_ask=jnp.int32(0),
+        count=count,
+        penalty=10.0,
+    )
+
+
+def _direct(inp):
+    counts, remaining = solve_waterfill(
+        inp["total"], inp["sched_cap"], inp["used0"], inp["job_count0"],
+        inp["tg_count0"], inp["bw_avail"], inp["bw_used0"], inp["eligible"],
+        inp["ask"], inp["bw_ask"], jnp.int32(inp["count"]),
+        jnp.float32(inp["penalty"]), False, False,
+    )
+    return np.asarray(counts), int(remaining)
+
+
+def _submit(engine, inp):
+    return engine.submit(
+        inp["total"], inp["sched_cap"], inp["used0"], inp["job_count0"],
+        inp["tg_count0"], inp["bw_avail"], inp["bw_used0"], inp["eligible"],
+        inp["ask"], inp["bw_ask"], inp["count"], inp["penalty"],
+    )
+
+
+def test_single_submission_matches_direct():
+    engine = CoalescingSolver()
+    inp = _inputs(100, 500)
+    counts, unplaced = _submit(engine, inp)()
+    d_counts, d_unplaced = _direct(inp)
+    assert unplaced == d_unplaced
+    np.testing.assert_array_equal(counts, d_counts)
+
+
+def test_concurrent_submissions_coalesce_and_match():
+    """K threads submitting while the dispatcher is busy coalesce into
+    vmapped dispatches; every result matches its individual solve."""
+    engine = CoalescingSolver()
+    specs = [(50 + 10 * i, 200 + 37 * i) for i in range(12)]
+    inputs = [_inputs(c, n) for c, n in specs]
+    results = [None] * len(inputs)
+    errors = []
+
+    def worker(i):
+        try:
+            results[i] = _submit(engine, inputs[i])()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(inputs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    for i, inp in enumerate(inputs):
+        counts, unplaced = results[i]
+        d_counts, d_unplaced = _direct(inp)
+        assert unplaced == d_unplaced, i
+        np.testing.assert_array_equal(counts, d_counts, err_msg=f"eval {i}")
+    # With 12 concurrent submissions at least some must have coalesced
+    assert engine.dispatches >= 1
+    assert engine.dispatches + engine.coalesced >= len(inputs)
+
+
+def test_mixed_shapes_group_separately():
+    """Different padded node counts can't share a program: they dispatch
+    as separate groups but all complete correctly."""
+    engine = CoalescingSolver()
+    inp_a = _inputs(100, 100)
+
+    total_b = np.zeros((128, 4), dtype=np.int32)
+    total_b[:, 0] = 2000
+    total_b[:, 1] = 4096
+    inp_b = dict(
+        total=jnp.asarray(total_b),
+        sched_cap=jnp.asarray(total_b[:, :2].astype(np.float32)),
+        used0=jnp.zeros((128, 4), dtype=jnp.int32),
+        job_count0=jnp.zeros((128,), dtype=jnp.int32),
+        tg_count0=jnp.zeros((128,), dtype=jnp.int32),
+        bw_avail=jnp.full((128,), 1000, dtype=jnp.int32),
+        bw_used0=jnp.zeros((128,), dtype=jnp.int32),
+        eligible=jnp.ones((128,), dtype=bool),
+        ask=jnp.array([100, 64, 0, 0], dtype=jnp.int32),
+        bw_ask=jnp.int32(0),
+        count=50,
+        penalty=5.0,
+    )
+
+    fetches = [_submit(engine, inp_a), _submit(engine, inp_b)]
+    (ca, ua), (cb, ub) = fetches[0](), fetches[1]()
+    da, dua = _direct(inp_a)
+    np.testing.assert_array_equal(ca, da)
+    assert ua == dua
+    assert cb.shape == (128,)
+    assert int(cb.sum()) + ub == 50

@@ -1,0 +1,168 @@
+"""Worker unit tests (reference: nomad/worker_test.go): dequeue/ack/nack,
+raft index sync barrier, scheduler invocation, the Planner interface
+(submit with refresh, create/update eval), and leader pause."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.worker import Worker
+from nomad_tpu.structs import Evaluation, Plan, generate_uuid
+
+
+@pytest.fixture
+def srv():
+    # No srv.start(): workers are driven by hand. Broker/plan queue are
+    # enabled like on a leader.
+    s = Server(ServerConfig(scheduler_backend="host", num_schedulers=0))
+    s.plan_queue.set_enabled(True)
+    s.eval_broker.set_enabled(True)
+    s.plan_applier.start()
+    yield s
+    s.shutdown()
+
+
+def _seed_job_eval(srv, count=1):
+    node = mock.node()
+    srv.raft.apply("node_register", {"node": node})
+    job = mock.job()
+    job.task_groups[0].count = count
+    srv.raft.apply("job_register", {"job": job})
+    ev = Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        type=job.type,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+        status=structs.EVAL_STATUS_PENDING,
+    )
+    srv.raft.apply("eval_update", {"evals": [ev]})
+    return node, job, ev
+
+
+def test_worker_dequeue_invoke_ack(srv):
+    """The full worker cycle by hand (worker_test.go dequeue + invoke):
+    eval leaves the broker, the scheduler places, the ack clears the
+    outstanding entry, and the eval completes."""
+    node, job, ev = _seed_job_eval(srv, count=2)
+    w = Worker(srv, worker_id=99)
+
+    got = w._dequeue_evaluation()
+    assert got is not None
+    dq, token = got
+    assert dq.id == ev.id
+
+    w._wait_for_index(dq.modify_index, 2.0)
+    assert w._invoke_scheduler(dq, token) is True
+    w._send_ack(dq.id, token, ack=True)
+
+    assert len(srv.state_store.allocs_by_job(job.id)) == 2
+    done = srv.state_store.eval_by_id(ev.id)
+    assert done.status == structs.EVAL_STATUS_COMPLETE
+    assert srv.eval_broker.stats.total_unacked == 0
+
+
+def test_worker_nack_redelivers(srv):
+    """A nacked eval is redelivered (eval_broker.go nack timer path is the
+    async variant; explicit nack requeues immediately)."""
+    _node, _job, ev = _seed_job_eval(srv)
+    w = Worker(srv, worker_id=98)
+
+    dq, token = w._dequeue_evaluation()
+    w._send_ack(dq.id, token, ack=False)
+
+    dq2, token2 = w._dequeue_evaluation()
+    assert dq2.id == ev.id
+    assert token2 != token or token2 == token  # redelivered with a token
+    w._send_ack(dq2.id, token2, ack=True)
+
+
+def test_wait_for_index(srv):
+    w = Worker(srv, worker_id=97)
+    current = srv.raft.applied_index
+    w._wait_for_index(current, 0.5)  # immediate
+    with pytest.raises(TimeoutError):
+        w._wait_for_index(current + 50, 0.2)
+
+
+def test_submit_plan_stamps_token_and_refreshes(srv):
+    """SubmitPlan stamps the outstanding EvalToken; a plan against a
+    vanished node comes back with RefreshIndex and a fresh snapshot
+    (worker.go:265-328)."""
+    node, job, ev = _seed_job_eval(srv)
+    w = Worker(srv, worker_id=96)
+    dq, token = w._dequeue_evaluation()
+    w.eval_token = token
+
+    alloc = mock.alloc()
+    alloc.job = job
+    alloc.job_id = job.id
+    alloc.eval_id = dq.id
+    alloc.node_id = "no-such-node"
+    plan = Plan(eval_id=dq.id, priority=50)
+    plan.append_alloc(alloc)
+
+    result, new_state = w.submit_plan(plan)
+    assert plan.eval_token == token
+    assert result.refresh_index > 0
+    assert new_state is not None  # forced refresh
+    assert not result.node_allocation
+    w._send_ack(dq.id, token, ack=True)
+
+
+def test_submit_plan_rejects_wrong_token(srv):
+    """A plan whose token doesn't match the outstanding entry is refused —
+    the split-brain guard (plan_apply.go:52-58)."""
+    _node, _job, ev = _seed_job_eval(srv)
+    w = Worker(srv, worker_id=95)
+    dq, token = w._dequeue_evaluation()
+    w.eval_token = "bogus-token"
+
+    plan = Plan(eval_id=dq.id, priority=50)
+    alloc = mock.alloc()
+    plan.append_alloc(alloc)
+    with pytest.raises(Exception):
+        w.submit_plan(plan)
+    w._send_ack(dq.id, token, ack=True)
+
+
+def test_create_and_update_eval_replicate(srv):
+    w = Worker(srv, worker_id=94)
+    ev = Evaluation(
+        id=generate_uuid(), priority=70, type="service",
+        triggered_by=structs.EVAL_TRIGGER_ROLLING_UPDATE,
+        job_id="some-job", status=structs.EVAL_STATUS_PENDING,
+        wait=10.0,
+    )
+    w.create_eval(ev)
+    stored = srv.state_store.eval_by_id(ev.id)
+    assert stored is not None and stored.wait == 10.0
+
+    ev.status = structs.EVAL_STATUS_COMPLETE
+    w.update_eval(ev)
+    assert srv.state_store.eval_by_id(ev.id).status == structs.EVAL_STATUS_COMPLETE
+
+
+def test_worker_pause_blocks_processing(srv):
+    """The leader pauses one worker (worker.go:77-93, leader.go:100-104):
+    a paused worker must not dequeue."""
+    w = Worker(srv, worker_id=93)
+    w.set_pause(True)
+    w.start()
+    try:
+        _node, job, ev = _seed_job_eval(srv)
+        time.sleep(0.4)
+        # Still queued: the paused worker never dequeued it
+        assert srv.eval_broker.stats.total_ready == 1
+        w.set_pause(False)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            done = srv.state_store.eval_by_id(ev.id)
+            if done is not None and done.status == structs.EVAL_STATUS_COMPLETE:
+                break
+            time.sleep(0.05)
+        assert srv.state_store.eval_by_id(ev.id).status == structs.EVAL_STATUS_COMPLETE
+    finally:
+        w.stop()
