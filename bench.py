@@ -20,13 +20,51 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 from __future__ import annotations
 
 import json
+import os
 import statistics
+import sys
 import time
+import traceback
 
 N_NODES = 10_000
 N_TASKS = 100_000
 RUNS = 5
 TARGET_PLACEMENTS_PER_SEC = N_TASKS / 0.2  # 100k tasks in 200ms p50
+
+# A cold tunneled TPU can take minutes to answer jax.devices(); the bench
+# REQUIRES the device backend, so it waits generously instead of letting the
+# scheduler factories silently fall back to the host path (round-1 failure
+# mode: 15s probe timeout -> host fallback -> empty timing list -> crash).
+DEVICE_WAIT_S = float(os.environ.get("NOMAD_TPU_BENCH_DEVICE_WAIT", "600"))
+ALLOW_CPU = os.environ.get("NOMAD_TPU_BENCH_ALLOW_CPU", "") == "1"
+
+
+def emit(payload: dict) -> None:
+    """The one-line JSON contract: always printed, even on failure."""
+    print(json.dumps(payload), flush=True)
+
+
+def acquire_device():
+    """Block until the device solver is up; returns the backend name.
+
+    Raises RuntimeError if the backend cannot be acquired or is the CPU
+    (unless NOMAD_TPU_BENCH_ALLOW_CPU=1 for local smoke runs).
+    """
+    from nomad_tpu.scheduler import device_probe_status, wait_for_device
+
+    solver = wait_for_device(timeout=DEVICE_WAIT_S)
+    status = device_probe_status()
+    if solver is None:
+        raise RuntimeError(
+            f"device backend unavailable after {DEVICE_WAIT_S:.0f}s: {status}"
+        )
+    backend = str(status.get("backend", "unknown"))
+    if backend == "cpu" and not ALLOW_CPU:
+        raise RuntimeError(
+            "bench requires a TPU backend but jax initialized on the CPU; "
+            "set NOMAD_TPU_BENCH_ALLOW_CPU=1 to force a local smoke run"
+        )
+    return backend
 
 
 def build_cluster():
@@ -239,30 +277,36 @@ def run_coalesced(nodes):
 
 
 def main():
-    import jax
+    backend = "unknown"
+    try:
+        backend = acquire_device()
 
-    nodes, job = build_cluster()
-    state = build_state(nodes, job)
-    _TimingStack.install()
+        nodes, job = build_cluster()
+        state = build_state(nodes, job)
+        _TimingStack.install()
 
-    # Warmup: compile caches for the shape buckets
-    run_once(state, job)
-    _TimingStack.solve_times.clear()
+        # Warmup: compile caches for the shape buckets
+        run_once(state, job)
+        _TimingStack.solve_times.clear()
 
-    e2e_times = []
-    placed = 0
-    for _ in range(RUNS):
-        e2e, placed = run_once(state, job)
-        e2e_times.append(e2e)
+        e2e_times = []
+        placed = 0
+        for _ in range(RUNS):
+            e2e, placed = run_once(state, job)
+            e2e_times.append(e2e)
 
-    solve_p50 = statistics.median(_TimingStack.solve_times)
-    e2e_p50 = statistics.median(e2e_times)
-    placements_per_sec = placed / solve_p50
+        if not _TimingStack.solve_times:
+            raise RuntimeError(
+                "no device solves recorded — the TPU factories fell back "
+                "to the host scheduler mid-run"
+            )
+        solve_p50 = statistics.median(_TimingStack.solve_times)
+        e2e_p50 = statistics.median(e2e_times)
+        placements_per_sec = placed / solve_p50
 
-    coalesce_wall, coalesce_placed = run_coalesced(nodes)
+        coalesce_wall, coalesce_placed = run_coalesced(nodes)
 
-    print(
-        json.dumps(
+        emit(
             {
                 "metric": "placements_per_sec@10k_nodes_x_100k_tasks",
                 "value": round(placements_per_sec, 1),
@@ -278,10 +322,22 @@ def main():
                 "coalesced_evals": COALESCE_EVALS,
                 "coalesced_wall_ms": round(coalesce_wall * 1000, 2),
                 "coalesced_placed": coalesce_placed,
-                "backend": jax.default_backend(),
+                "backend": backend,
             }
         )
-    )
+    except BaseException as e:  # always emit the JSON line, never a traceback
+        traceback.print_exc(file=sys.stderr)
+        emit(
+            {
+                "metric": "placements_per_sec@10k_nodes_x_100k_tasks",
+                "value": 0,
+                "unit": "placements/s",
+                "vs_baseline": 0,
+                "backend": backend,
+                "error": f"{type(e).__name__}: {e}",
+            }
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

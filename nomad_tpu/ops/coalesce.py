@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nomad_tpu import telemetry
 from nomad_tpu.ops.binpack import solve_waterfill
 
 
@@ -45,13 +46,22 @@ def solve_waterfill_batched(
 
 
 class _Entry:
-    __slots__ = ("args", "event", "group", "index")
+    __slots__ = ("args", "event", "group", "index", "error")
 
     def __init__(self, args):
         self.args = args
         self.event = threading.Event()
         self.group: Optional["_Group"] = None
         self.index = 0
+        self.error: Optional[BaseException] = None
+
+    def result(self) -> Tuple[np.ndarray, int]:
+        """Block for the dispatch, then return (counts[N], n_unplaced) —
+        or re-raise the dispatch failure instead of hanging."""
+        self.event.wait()
+        if self.group is None:
+            raise RuntimeError("coalesced solve failed") from self.error
+        return self.group.fetch(self.index)
 
 
 class _Group:
@@ -113,12 +123,7 @@ class CoalescingSolver:
             self._ensure_thread()
             self._pending.append(entry)
             self._cond.notify()
-
-        def fetch():
-            entry.event.wait()
-            return entry.group.fetch(entry.index)
-
-        return fetch
+        return entry.result
 
     # -- dispatcher ---------------------------------------------------------
 
@@ -145,20 +150,27 @@ class CoalescingSolver:
                 self._dispatch_group(entries, jd, td)
             except Exception:
                 # Fail open: solve each entry individually so waiters
-                # never hang on a batch-level error.
+                # never hang on a batch-level error. An entry whose retry
+                # also fails carries the exception to its fetch() caller.
                 for e in entries:
                     try:
                         counts_dev, remaining_dev = solve_waterfill(
                             *e.args[:10], jnp.int32(e.args[10]),
                             jnp.float32(e.args[11]), e.args[12], e.args[13],
                         )
-                        e.group = _Group(counts_dev, remaining_dev)
+                        e.group = _Group(counts_dev[None], remaining_dev[None])
                         e.index = 0
+                    except Exception as exc:
+                        e.error = exc
                     finally:
                         e.event.set()
 
     def _dispatch_group(self, entries: List[_Entry], jd: bool, td: bool) -> None:
         self.dispatches += 1
+        telemetry.incr_counter(("scheduler", "coalesce", "dispatch"))
+        telemetry.add_sample(
+            ("scheduler", "coalesce", "batch_size"), float(len(entries))
+        )
         if len(entries) == 1:
             e = entries[0]
             counts_dev, remaining_dev = solve_waterfill(

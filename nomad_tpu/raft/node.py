@@ -4,10 +4,13 @@ A compact, correct Raft core (Ongaro & Ousterhout's algorithm) over the
 framework RPC layer. Scope notes vs the paper:
 - log compaction via FSM snapshots (paper §7): each node snapshots its own
   FSM every ``snapshot_threshold`` applied entries and truncates the log
-  prefix; lagging followers catch up through the InstallSnapshot RPC. The
-  reference keeps its log in BoltDB and snapshots through
-  raft.FileSnapshotStore retaining 2 (nomad/server.go:437,453); we retain
-  ``snapshot_retain`` snapshot files the same way.
+  prefix, keeping ``trailing_logs`` entries past the snapshot so followers
+  behind by less than the tail catch up via ordinary AppendEntries (the
+  reference raft library's TrailingLogs behavior); followers further back
+  take the InstallSnapshot RPC. The reference keeps its log in BoltDB and
+  snapshots through raft.FileSnapshotStore retaining 2
+  (nomad/server.go:437,453); we retain ``snapshot_retain`` snapshot files
+  the same way.
 - membership change: static peer set per cluster (the reference's
   bootstrap_expect posture, nomad/serf.go:76-134)
 
@@ -16,7 +19,9 @@ set; on restart the newest valid snapshot is restored into the FSM and the
 log tail replayed (fsm.go:313-410 posture). In-memory otherwise (the
 reference's DevMode InmemStore, server.go:420-427).
 
-Log indexing is absolute: ``self.log[k]`` holds entry ``snapshot_index+k+1``.
+Log indexing is absolute: ``self.log[k]`` holds entry ``log_offset+k+1``,
+where ``log_offset <= snapshot_index`` (the gap is the retained trailing
+tail; they are equal right after restore or InstallSnapshot).
 """
 
 from __future__ import annotations
@@ -67,6 +72,10 @@ class RaftConfig:
     # posture, nomad/server.go:453). Snapshot files retained: snapshot_retain.
     snapshot_threshold: int = 8192
     snapshot_retain: int = 2
+    # Entries retained past the snapshot index at compaction so slightly
+    # lagging followers replicate normally instead of taking a full
+    # InstallSnapshot (hashicorp/raft TrailingLogs posture).
+    trailing_logs: int = 1024
 
 
 @dataclass
@@ -121,11 +130,15 @@ class RaftNode:
         # Persistent state
         self.current_term = 0
         self.voted_for: Optional[str] = None
-        self.log: List[_Entry] = []  # log[k] is entry snapshot_index+k+1
-        # Compaction state: everything at or below snapshot_index lives in
-        # the FSM snapshot, not the log.
+        self.log: List[_Entry] = []  # log[k] is entry log_offset+k+1
+        # Compaction state: everything at or below snapshot_index is covered
+        # by the FSM snapshot; the log itself starts after log_offset, which
+        # trails snapshot_index by up to trailing_logs entries so lagging
+        # followers can catch up without a full snapshot transfer.
         self.snapshot_index = 0
         self.snapshot_term = 0
+        self.log_offset = 0
+        self.log_offset_term = 0
         self._snap_data: Optional[bytes] = None
         self._compacting = False
 
@@ -202,7 +215,7 @@ class RaftNode:
                 self.current_term, msg_type, encode_payload(msg_type, payload)
             )
             self.log.append(entry)
-            index = self.snapshot_index + len(self.log)
+            index = self.log_offset + len(self.log)
             self._apply_futures[index] = future
             self._persist_entry(index, entry)
             if len(self.config.peers) == 1:
@@ -223,7 +236,7 @@ class RaftNode:
                 "leader_id": self.leader_id,
                 "commit_index": self.commit_index,
                 "applied_index": self.last_applied,
-                "last_log_index": self.snapshot_index + len(self.log),
+                "last_log_index": self.log_offset + len(self.log),
                 "snapshot_index": self.snapshot_index,
                 "num_peers": len(self.config.peers) - 1,
             }
@@ -255,7 +268,7 @@ class RaftNode:
         _, log_path = self._paths()
         _atomic_write(log_path, "".join(
             json.dumps({"index": i, **entry.to_wire()}) + "\n"
-            for i, entry in enumerate(self.log, start=self.snapshot_index + 1)
+            for i, entry in enumerate(self.log, start=self.log_offset + 1)
         ))
 
     def _snap_path(self, index: int) -> str:
@@ -317,9 +330,13 @@ class RaftNode:
             self.snapshot_term = snap["term"]
             self._snap_data = data
             self.commit_index = self.last_applied = self.snapshot_index
+            # Any trailing tail persisted before the restart is discarded by
+            # the contiguity rule below; the log restarts at the snapshot.
+            self.log_offset = self.snapshot_index
+            self.log_offset_term = self.snapshot_term
             break
         # Replay the log tail only if it joins the snapshot contiguously:
-        # log[k] must hold entry snapshot_index+k+1. A gap (e.g. the newest
+        # log[k] must hold entry log_offset+k+1. A gap (e.g. the newest
         # snapshot was unreadable and we fell back to an older one whose
         # successor entries were already compacted away) would mis-index
         # every entry, so the tail is discarded and re-fetched from the
@@ -328,13 +345,13 @@ class RaftNode:
             with open(log_path) as f:
                 for line in f:
                     d = json.loads(line)
-                    if d["index"] <= self.snapshot_index:
+                    if d["index"] <= self.log_offset:
                         continue
-                    if d["index"] != self.snapshot_index + len(self.log) + 1:
+                    if d["index"] != self.log_offset + len(self.log) + 1:
                         self.logger.warning(
                             "raft: discarding log from non-contiguous "
                             "index %d (expected %d)",
-                            d["index"], self.snapshot_index + len(self.log) + 1,
+                            d["index"], self.log_offset + len(self.log) + 1,
                         )
                         break
                     self.log.append(_Entry.from_wire(d))
@@ -350,15 +367,15 @@ class RaftNode:
 
     def _last_log(self) -> Tuple[int, int]:
         if not self.log:
-            return self.snapshot_index, self.snapshot_term
-        return self.snapshot_index + len(self.log), self.log[-1].term
+            return self.log_offset, self.log_offset_term
+        return self.log_offset + len(self.log), self.log[-1].term
 
     def _entry_at(self, index: int) -> _Entry:
-        return self.log[index - self.snapshot_index - 1]
+        return self.log[index - self.log_offset - 1]
 
     def _term_at(self, index: int) -> int:
-        if index == self.snapshot_index:
-            return self.snapshot_term
+        if index == self.log_offset:
+            return self.log_offset_term
         return self._entry_at(index).term
 
     def _other_peers(self) -> Dict[str, str]:
@@ -532,9 +549,10 @@ class RaftNode:
                 return
             term = self.current_term
             next_idx = self.next_index.get(pid, 1)
-            if next_idx <= self.snapshot_index:
-                # The entries this follower needs were compacted away:
-                # ship the snapshot instead (paper §7 InstallSnapshot).
+            if next_idx <= self.log_offset:
+                # The entries this follower needs were compacted away (it is
+                # behind even the trailing tail): ship the snapshot instead
+                # (paper §7 InstallSnapshot).
                 snap = (self.snapshot_index, self.snapshot_term, self._snap_data)
             else:
                 snap = None
@@ -542,7 +560,7 @@ class RaftNode:
                 prev_term = self._term_at(prev_idx) if prev_idx > 0 else 0
                 entries = [
                     e.to_wire()
-                    for e in self.log[next_idx - self.snapshot_index - 1:]
+                    for e in self.log[next_idx - self.log_offset - 1:]
                 ]
             commit = self.commit_index
         if snap is not None:
@@ -628,13 +646,15 @@ class RaftNode:
             # and agrees with it; otherwise discard the whole log.
             last_idx, _ = self._last_log()
             if (last_idx > snap_index
-                    and snap_index > self.snapshot_index
+                    and snap_index >= self.log_offset
                     and self._term_at(snap_index) == snap_term):
-                del self.log[: snap_index - self.snapshot_index]
+                del self.log[: snap_index - self.log_offset]
             else:
                 self.log = []
             self.snapshot_index = snap_index
             self.snapshot_term = snap_term
+            self.log_offset = snap_index
+            self.log_offset_term = snap_term
             self._snap_data = data
             self.commit_index = max(self.commit_index, snap_index)
             self.last_applied = max(self.last_applied, snap_index)
@@ -705,7 +725,12 @@ class RaftNode:
                     handle = cow()
                     data = None
                 else:
-                    # FSMs without a COW snapshot serialize under the lock
+                    # FSMs without a COW snapshot serialize under the lock,
+                    # stalling heartbeats/elections for the duration —
+                    # acceptable only for small test FSMs. Production FSMs
+                    # must provide snapshot_cow()/serialize_cow() (the
+                    # server FSM does: server/fsm.py:104-117) so only a
+                    # cheap handle is taken here.
                     data = self.fsm.snapshot_bytes()
             if data is None:
                 data = serialize(handle)
@@ -715,7 +740,15 @@ class RaftNode:
             with self._lock:
                 if idx <= self.snapshot_index:
                     return  # an InstallSnapshot overtook us
-                del self.log[: idx - self.snapshot_index]
+                # Keep a trailing tail of entries past the snapshot so
+                # followers behind by < trailing_logs replicate normally.
+                keep_from = max(
+                    self.log_offset, idx - max(0, self.config.trailing_logs)
+                )
+                if keep_from > self.log_offset:
+                    self.log_offset_term = self._term_at(keep_from)
+                    del self.log[: keep_from - self.log_offset]
+                    self.log_offset = keep_from
                 self.snapshot_index = idx
                 self.snapshot_term = snap_term
                 self._snap_data = data
@@ -757,7 +790,7 @@ class RaftNode:
                     # Find the first index of the conflicting term
                     conflict_term = self._term_at(prev_idx)
                     first = prev_idx
-                    while (first > self.snapshot_index + 1
+                    while (first > self.log_offset + 1
                            and self._term_at(first - 1) == conflict_term):
                         first -= 1
                     return {"term": self.current_term, "success": False,
@@ -768,7 +801,7 @@ class RaftNode:
             for i, wire in enumerate(entries):
                 idx = prev_idx + 1 + i
                 entry = _Entry.from_wire(wire)
-                pos = idx - self.snapshot_index - 1
+                pos = idx - self.log_offset - 1
                 if len(self.log) > pos:
                     if self.log[pos].term != entry.term:
                         del self.log[pos:]

@@ -73,6 +73,191 @@ def new_scheduler(
     return factory(state, planner, logger or logging.getLogger("nomad_tpu.sched"))
 
 
+# ---------------------------------------------------------------------------
+# Device probe.
+#
+# The TPU factories live behind a lazy import so the control plane can run
+# host-only (e.g. on machines without jax). If the device backend cannot
+# initialize — or hangs (a wedged remote-device tunnel blocks inside
+# jax.devices() indefinitely) — fall back to the host solver instead of
+# wedging every worker thread: same placements, scalar speed. Unavailability
+# is re-probed after a cooldown so a recovered device comes back without a
+# restart.
+#
+# The probe runs on its own daemon thread. The caller that *starts* a probe
+# waits up to PROBE_TIMEOUT for it; every concurrent caller sees "probing"
+# and falls back to the host solver immediately rather than queueing on a
+# lock (a cold tunneled-device jax.devices() can take minutes). A probe that
+# outlives the timeout keeps running — if the device eventually comes up,
+# the next eval uses it.
+
+import os as _os
+import threading as _threading
+import time as _time
+
+PROBE_TIMEOUT = float(_os.environ.get("NOMAD_TPU_PROBE_TIMEOUT", "120"))
+PROBE_RETRY = float(_os.environ.get("NOMAD_TPU_PROBE_RETRY", "60"))
+
+_probe_lock = _threading.Lock()
+_probe_done = _threading.Event()
+# status: unprobed | probing | ready | down
+_probe_state: Dict[str, object] = {"status": "unprobed", "fallbacks": 0,
+                                   "generation": 0}
+
+
+def _start_probe_locked(logger: logging.Logger) -> None:
+    """Kick off the async device probe. Caller holds ``_probe_lock``.
+
+    Probes are generation-tagged: a stale probe (superseded after it
+    wedged past its deadline) may still flip the state to ready — the
+    device coming up is good news from any generation — but only the
+    current generation may mark it down, so a late failure can't clobber
+    a newer probe's in-flight state.
+    """
+    gen = int(_probe_state["generation"]) + 1
+    _probe_state["generation"] = gen
+    _probe_state["status"] = "probing"
+    _probe_state["started_at"] = _time.monotonic()
+    _probe_done.clear()
+
+    def probe():
+        try:
+            import jax
+
+            jax.devices()
+            from nomad_tpu.tpu import solver
+
+            with _probe_lock:
+                _probe_state["status"] = "ready"
+                _probe_state["solver"] = solver
+                _probe_state["backend"] = jax.default_backend()
+                _probe_state.pop("error", None)
+        except Exception as e:  # device backend truly unavailable
+            with _probe_lock:
+                if (_probe_state["generation"] == gen
+                        and _probe_state["status"] == "probing"):
+                    _probe_state["status"] = "down"
+                    _probe_state["error"] = f"{type(e).__name__}: {e}"
+                    _probe_state["retry_at"] = _time.monotonic() + PROBE_RETRY
+            logger.warning(
+                "jax device backend unavailable (%s); TPU factories fall "
+                "back to the host scheduler for %.0fs", e, PROBE_RETRY,
+            )
+        finally:
+            _probe_done.set()
+
+    _threading.Thread(target=probe, daemon=True,
+                      name=f"tpu-device-probe-{gen}").start()
+
+
+def _probe_is_stale_locked() -> bool:
+    """True when the in-flight probe has been wedged long past its grace
+    window and a fresh probe should replace it (a recovered tunnel may not
+    unblock the original stuck jax.devices() call)."""
+    return (
+        _probe_state["status"] == "probing"
+        and _time.monotonic() - float(_probe_state.get("started_at", 0))
+        > PROBE_TIMEOUT + PROBE_RETRY
+    )
+
+
+def _tpu_solver(logger: logging.Logger):
+    """The device solver module, or None while the device path is
+    unavailable (host fallback; retried after a cooldown)."""
+    started = False
+    with _probe_lock:
+        st = _probe_state["status"]
+        if st == "ready":
+            return _probe_state["solver"]
+        if (
+            st == "unprobed"
+            or (st == "down"
+                and _time.monotonic() >= _probe_state.get("retry_at", 0))
+            or _probe_is_stale_locked()
+        ):
+            _start_probe_locked(logger)
+            started = True
+        _probe_state["fallbacks"] = int(_probe_state["fallbacks"]) + (
+            0 if started else 1
+        )
+    if not started:
+        # A probe is in flight (or the device is in its down-cooldown):
+        # fall back without blocking behind the prober.
+        return None
+    # The caller that started the probe gives it one timeout's grace —
+    # this keeps single-threaded flows (tests, dev agents) on the device
+    # path without a warm-up blip, while peers fall back concurrently.
+    _probe_done.wait(PROBE_TIMEOUT)
+    with _probe_lock:
+        if _probe_state["status"] == "ready":
+            return _probe_state["solver"]
+        if _probe_state["status"] == "probing":
+            logger.warning(
+                "jax device probe still running after %.0fs; TPU factories "
+                "fall back to the host scheduler until it completes",
+                PROBE_TIMEOUT,
+            )
+        _probe_state["fallbacks"] = int(_probe_state["fallbacks"]) + 1
+        return None
+
+
+def wait_for_device(timeout: float = 600.0,
+                    logger: Optional[logging.Logger] = None):
+    """Block until the device solver is available (or ``timeout``).
+
+    For callers that *require* the device — the bench harness, explicit
+    health checks — rather than preferring graceful fallback. Returns the
+    solver module or None. Honors the down-state retry cooldown (so a
+    fast-failing backend is re-probed every PROBE_RETRY, not hot-looped)
+    and replaces wedged probes once they exceed their grace window.
+    """
+    log = logger or logging.getLogger("nomad_tpu.sched")
+    deadline = _time.monotonic() + timeout
+    while True:
+        sleep_until = None
+        with _probe_lock:
+            st = _probe_state["status"]
+            if st == "ready":
+                return _probe_state["solver"]
+            if st == "unprobed":
+                _start_probe_locked(log)
+            elif st == "down":
+                retry_at = float(_probe_state.get("retry_at", 0))
+                if _time.monotonic() >= retry_at:
+                    _start_probe_locked(log)
+                else:
+                    sleep_until = retry_at
+            elif _probe_is_stale_locked():
+                _start_probe_locked(log)
+        now = _time.monotonic()
+        remaining = deadline - now
+        if remaining <= 0:
+            return None
+        wait = min(remaining, 1.0)
+        if sleep_until is not None:
+            wait = min(remaining, max(sleep_until - now, 0.05))
+            _time.sleep(wait)  # cooldown: _probe_done is already set
+        else:
+            _probe_done.wait(wait)
+
+
+def device_probe_status() -> Dict[str, object]:
+    """Snapshot of the device-probe state for Stats()/agent-info."""
+    with _probe_lock:
+        out = {
+            "status": _probe_state["status"],
+            "fallbacks": int(_probe_state["fallbacks"]),
+        }
+        for k in ("backend", "error"):
+            if k in _probe_state:
+                out[k] = _probe_state[k]
+        if _probe_state["status"] == "probing":
+            out["probing_for_s"] = round(
+                _time.monotonic() - float(_probe_state["started_at"]), 1
+            )
+        return out
+
+
 def _register_builtins() -> None:
     from nomad_tpu.scheduler.generic import new_batch_scheduler, new_service_scheduler
     from nomad_tpu.scheduler.system import new_system_scheduler
@@ -81,67 +266,13 @@ def _register_builtins() -> None:
     register("batch", new_batch_scheduler)
     register("system", new_system_scheduler)
 
-    # The TPU factories live behind a lazy import so the control plane can
-    # run host-only (e.g. on machines without jax). If the device backend
-    # cannot initialize — or hangs (a wedged remote-device tunnel blocks
-    # inside jax.devices() indefinitely) — fall back to the host solver
-    # instead of wedging every worker thread: same placements, scalar
-    # speed. Unavailability is re-probed after a cooldown so a recovered
-    # device comes back without a restart.
-    import threading as _threading
-    import time as _time
-
-    _device_probe: Dict[str, object] = {}
-    _probe_lock = _threading.Lock()
-    PROBE_TIMEOUT = 15.0
-    PROBE_RETRY = 60.0
-
-    def _tpu_solver(logger):
-        """Import + probe with a timeout; None while the device path is
-        unavailable (retried after a cooldown)."""
-        with _probe_lock:
-            if "solver" in _device_probe:
-                cached = _device_probe["solver"]
-                if cached is not None:
-                    return cached
-                if _time.monotonic() < _device_probe.get("retry_at", 0):
-                    return None
-
-            box: Dict[str, object] = {}
-
-            def probe():
-                try:
-                    import jax
-
-                    jax.devices()
-                    from nomad_tpu.tpu import solver
-
-                    box["solver"] = solver
-                except Exception as e:
-                    box["error"] = e
-
-            t = _threading.Thread(target=probe, daemon=True,
-                                  name="tpu-device-probe")
-            t.start()
-            t.join(PROBE_TIMEOUT)
-            solver = box.get("solver")
-            if solver is None:
-                reason = box.get("error", "probe timed out")
-                logger.warning(
-                    "jax device backend unavailable (%s); TPU factories "
-                    "fall back to the host scheduler for %.0fs",
-                    reason, PROBE_RETRY,
-                )
-                _device_probe["solver"] = None
-                _device_probe["retry_at"] = _time.monotonic() + PROBE_RETRY
-                return None
-            _device_probe["solver"] = solver
-            return solver
-
     def _lazy_tpu(variant: str) -> Factory:
         def factory(state, planner, logger):
             solver = _tpu_solver(logger)
             if solver is None:
+                from nomad_tpu import telemetry
+
+                telemetry.incr_counter(("scheduler", "device", "fallback"))
                 return BUILTIN_SCHEDULERS[variant](state, planner, logger)
             return solver.new_tpu_scheduler(variant, state, planner, logger)
 

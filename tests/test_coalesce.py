@@ -129,3 +129,62 @@ def test_mixed_shapes_group_separately():
     assert ua == dua
     assert cb.shape == (128,)
     assert int(cb.sum()) + ub == 50
+
+
+def _entries(inputs):
+    from nomad_tpu.ops.coalesce import _Entry
+
+    return [
+        _Entry((
+            inp["total"], inp["sched_cap"], inp["used0"], inp["job_count0"],
+            inp["tg_count0"], inp["bw_avail"], inp["bw_used0"],
+            inp["eligible"], inp["ask"], inp["bw_ask"], inp["count"],
+            inp["penalty"], False, False,
+        ))
+        for inp in inputs
+    ]
+
+
+def test_batch_failure_falls_open_to_individual_solves(monkeypatch):
+    """A batch-level dispatch error retries each entry individually; the
+    fallback results carry the leading batch axis so fetch() returns the
+    full [N] counts vector, matching the direct solve."""
+    from nomad_tpu.ops import coalesce
+
+    engine = CoalescingSolver()
+    inputs = [_inputs(100, 100), _inputs(120, 200)]
+    entries = _entries(inputs)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("batched program failed")
+
+    monkeypatch.setattr(coalesce, "solve_waterfill_batched", boom)
+    engine._dispatch(entries)
+    for entry, inp in zip(entries, inputs):
+        counts, unplaced = entry.result()
+        d_counts, d_unplaced = _direct(inp)
+        assert counts.shape == d_counts.shape
+        np.testing.assert_array_equal(counts, d_counts)
+        assert unplaced == d_unplaced
+
+
+def test_total_failure_raises_instead_of_hanging(monkeypatch):
+    """If the per-entry retry also fails, waiters get the exception through
+    the real submit() fetch path — not a hang or an AttributeError on a
+    never-set group."""
+    from nomad_tpu.ops import coalesce
+
+    engine = CoalescingSolver()
+
+    def boom(*args, **kwargs):
+        raise ValueError("device is gone")
+
+    monkeypatch.setattr(coalesce, "solve_waterfill_batched", boom)
+    monkeypatch.setattr(coalesce, "solve_waterfill", boom)
+    fetches = [
+        _submit(engine, _inputs(100, 100)), _submit(engine, _inputs(120, 200))
+    ]
+    for fetch in fetches:
+        with pytest.raises(RuntimeError, match="coalesced solve failed") as ei:
+            fetch()
+        assert isinstance(ei.value.__cause__, ValueError)

@@ -42,7 +42,7 @@ def _wait(predicate, timeout=10.0, msg="condition"):
     raise AssertionError(f"timed out waiting for {msg}")
 
 
-def _make_node(node_id, peers, fsm, data_dir="", threshold=20):
+def _make_node(node_id, peers, fsm, data_dir="", threshold=20, trailing=0):
     rpc = RPCServer()
     rpc.start()
     peers[node_id] = rpc.addr
@@ -51,6 +51,7 @@ def _make_node(node_id, peers, fsm, data_dir="", threshold=20):
         peers=peers,
         data_dir=data_dir,
         snapshot_threshold=threshold,
+        trailing_logs=trailing,
         bootstrap_expect=1,
     )
     node = RaftNode(cfg, fsm, rpc, pool=ConnPool(timeout=2.0))
@@ -151,6 +152,51 @@ def test_lagging_follower_catches_up_via_install_snapshot():
         # And it keeps replicating normally afterwards
         leader.apply("kv", {"k": "after", "v": "snap"}).result(5.0)
         _wait(lambda: fsm_c.data.get("after") == "snap", msg="post-snapshot entry")
+    finally:
+        for n in (node_a, node_b, node_c):
+            if n is not None:
+                n.shutdown()
+        for r in (rpc_a, rpc_b, rpc_c):
+            r.shutdown()
+
+
+def test_trailing_logs_let_lagging_follower_replicate_normally():
+    """With trailing_logs, compaction keeps a log tail past the snapshot, so
+    a follower behind by less than the tail catches up through ordinary
+    AppendEntries — no InstallSnapshot transfer (hashicorp/raft TrailingLogs
+    posture)."""
+    peers = {}
+    fsm_a, fsm_b, fsm_c = KVFSM(), KVFSM(), KVFSM()
+    rpc_c = RPCServer()
+    rpc_c.start()
+    node_a, rpc_a = _make_node("a", peers, fsm_a, threshold=20, trailing=1000)
+    node_b, rpc_b = _make_node("b", peers, fsm_b, threshold=20, trailing=1000)
+    peers["c"] = rpc_c.addr
+    node_c = None
+
+    node_a.start()
+    node_b.start()
+    try:
+        _wait(lambda: node_a.is_leader or node_b.is_leader, timeout=30.0,
+              msg="leadership")
+        leader = node_a if node_a.is_leader else node_b
+        for i in range(60):
+            leader.apply("kv", {"k": f"k{i}", "v": i}).result(5.0)
+        _wait(lambda: leader.snapshot_index > 0, msg="compaction")
+        # The snapshot exists but the tail (here: the whole log) is retained
+        assert leader.log_offset < leader.snapshot_index
+        assert leader.log_offset + len(leader.log) >= leader.snapshot_index
+
+        # C joins late, behind the snapshot but within the retained tail:
+        # it must converge via plain replication, never InstallSnapshot.
+        cfg_c = RaftConfig(node_id="c", peers=peers, snapshot_threshold=10_000,
+                           bootstrap_expect=1)
+        node_c = RaftNode(cfg_c, fsm_c, rpc_c, pool=ConnPool(timeout=2.0))
+        node_c.start()
+        _wait(lambda: node_c.applied_index >= leader.applied_index,
+              timeout=15.0, msg="follower log catch-up")
+        assert fsm_c.data == {f"k{i}": i for i in range(60)}
+        assert node_c.snapshot_index == 0  # replayed, never installed
     finally:
         for n in (node_a, node_b, node_c):
             if n is not None:
