@@ -26,10 +26,10 @@ import sys
 import time
 import traceback
 
-N_NODES = 10_000
-N_TASKS = 100_000
-RUNS = 5
-TARGET_PLACEMENTS_PER_SEC = N_TASKS / 0.2  # 100k tasks in 200ms p50
+N_NODES = int(os.environ.get("NOMAD_TPU_BENCH_NODES", 10_000))
+N_TASKS = int(os.environ.get("NOMAD_TPU_BENCH_TASKS", 100_000))
+RUNS = int(os.environ.get("NOMAD_TPU_BENCH_RUNS", 5))
+TARGET_PLACEMENTS_PER_SEC = N_TASKS / 0.2  # the north star: tasks in 200ms p50
 
 # A cold tunneled TPU can take minutes to answer jax.devices(); the bench
 # REQUIRES the device backend, so it waits generously instead of letting the
@@ -209,71 +209,92 @@ COALESCE_EVALS = 8
 
 
 def run_coalesced(nodes):
-    """Aux phase: COALESCE_EVALS jobs evaluated concurrently — worker
-    threads whose device solves stack into vmapped dispatches
-    (ops/coalesce.py), the device analog of the reference's optimistic
-    worker concurrency. Returns (wall_seconds, total_placed)."""
-    import threading
+    """Aux phase through the REAL server pipeline: COALESCE_EVALS jobs
+    enqueued at the broker, drained by batched workers
+    (eval_batch_size, server/worker.py), their device solves stacking into
+    vmapped dispatches (ops/coalesce.py), plans through the plan queue and
+    applier. The broker-path analog of the reference's optimistic worker
+    concurrency (nomad/worker.go:45-125 + eval_broker.go:215-246).
+    Returns (wall_seconds, total_placed, dispatches)."""
+    from nomad_tpu import structs
+    from nomad_tpu.ops.coalesce import GLOBAL_SOLVER
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.structs import Evaluation, generate_uuid
 
-    from nomad_tpu.server.plan_apply import evaluate_plan
-    from nomad_tpu.state import StateStore
+    srv = Server(ServerConfig(
+        scheduler_backend="tpu",
+        num_schedulers=2,
+        eval_batch_size=COALESCE_EVALS,
+        periodic_dispatch=False,
+    ))
+    try:
+        for node in nodes:
+            srv.raft.apply("node_register", {"node": node})
+        jobs = []
+        for _ in range(COALESCE_EVALS + 1):  # +1: dedicated warmup job
+            _nodes, job = build_cluster()
+            job.task_groups[0].count = N_TASKS // COALESCE_EVALS
+            srv.raft.apply("job_register", {"job": job})
+            jobs.append(job)
 
-    state = StateStore()
-    for i, node in enumerate(nodes):
-        state.upsert_node(i + 1, node)
-    jobs = []
-    for j in range(COALESCE_EVALS):
-        _nodes, job = build_cluster()
-        job.task_groups[0].count = N_TASKS // COALESCE_EVALS
-        jobs.append(job)
-        state.upsert_job(N_NODES + 1 + j, job)
-
-    placed = [0] * len(jobs)
-
-    def one(i):
-        import logging
-
-        from nomad_tpu import structs
-        from nomad_tpu.scheduler import new_scheduler
-        from nomad_tpu.structs import Evaluation, generate_uuid
-
-        class _P:
-            def submit_plan(self, plan):
-                result = evaluate_plan(state.snapshot(), plan)
-                result.alloc_index = N_NODES + 2
-                placed[i] = sum(b.n for b in result.alloc_batches)
-                placed[i] += sum(
-                    len(v) for v in result.node_allocation.values()
-                )
-                return result, None
-
-            def update_eval(self, ev):
-                pass
-
-            def create_eval(self, ev):
-                pass
-
-        ev = Evaluation(
-            id=generate_uuid(), priority=jobs[i].priority, type=jobs[i].type,
-            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=jobs[i].id,
+        # Warmup eval compiles the batched program shapes before timing.
+        warm_job = jobs.pop()
+        warm = Evaluation(
+            id=generate_uuid(), priority=warm_job.priority,
+            type=warm_job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+            job_id=warm_job.id, status=structs.EVAL_STATUS_PENDING,
         )
-        sched = new_scheduler(
-            "tpu-batch", state.snapshot(), _P(), logging.getLogger("bench")
-        )
-        sched.process(ev)
+        srv.start()
+        srv.raft.apply("eval_update", {"evals": [warm]})
+        _wait_evals_complete(srv, [warm.id], timeout=300.0)
 
-    # Warmup compiles the batched program shapes
-    one(0)
-    threads = [
-        threading.Thread(target=one, args=(i,)) for i in range(len(jobs))
-    ]
-    start = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - start
-    return wall, sum(placed)
+        evals = [
+            Evaluation(
+                id=generate_uuid(), priority=job.priority, type=job.type,
+                triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+                job_id=job.id, status=structs.EVAL_STATUS_PENDING,
+            )
+            for job in jobs
+        ]
+        dispatches0 = GLOBAL_SOLVER.dispatches
+        start = time.perf_counter()
+        srv.raft.apply("eval_update", {"evals": evals})
+        _wait_evals_complete(srv, [ev.id for ev in evals], timeout=300.0)
+        wall = time.perf_counter() - start
+
+        placed = 0
+        for job in jobs:
+            placed += sum(
+                1 for a in srv.state_store.allocs_by_job(job.id)
+                if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+            )
+        return wall, placed, GLOBAL_SOLVER.dispatches - dispatches0
+    finally:
+        srv.shutdown()
+
+
+def _wait_evals_complete(srv, eval_ids, timeout):
+    from nomad_tpu import structs
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        done = [srv.state_store.eval_by_id(i) for i in eval_ids]
+        if all(
+            d is not None and d.status != structs.EVAL_STATUS_PENDING
+            for d in done
+        ):
+            # A failed/canceled eval must surface as a bench error, not a
+            # silently-low placement count.
+            bad = {
+                d.id: d.status for d in done
+                if d.status != structs.EVAL_STATUS_COMPLETE
+            }
+            if bad:
+                raise RuntimeError(f"evals did not complete: {bad}")
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"evals not complete after {timeout}s")
 
 
 def main():
@@ -304,7 +325,9 @@ def main():
         e2e_p50 = statistics.median(e2e_times)
         placements_per_sec = placed / solve_p50
 
-        coalesce_wall, coalesce_placed = run_coalesced(nodes)
+        coalesce_wall, coalesce_placed, coalesce_dispatches = run_coalesced(
+            nodes
+        )
 
         emit(
             {
@@ -322,6 +345,7 @@ def main():
                 "coalesced_evals": COALESCE_EVALS,
                 "coalesced_wall_ms": round(coalesce_wall * 1000, 2),
                 "coalesced_placed": coalesce_placed,
+                "coalesced_dispatches": coalesce_dispatches,
                 "backend": backend,
             }
         )

@@ -154,16 +154,28 @@ class CoalescingSolver:
                 # also fails carries the exception to its fetch() caller.
                 for e in entries:
                     try:
-                        counts_dev, remaining_dev = solve_waterfill(
-                            *e.args[:10], jnp.int32(e.args[10]),
-                            jnp.float32(e.args[11]), e.args[12], e.args[13],
-                        )
+                        counts_dev, remaining_dev = self._solve_one(e)
                         e.group = _Group(counts_dev[None], remaining_dev[None])
                         e.index = 0
                     except Exception as exc:
                         e.error = exc
                     finally:
                         e.event.set()
+
+    @staticmethod
+    def _solve_one(e: _Entry):
+        """Single-entry water-fill dispatch, node-axis sharded over the
+        configured mesh when one exists (parallel/mesh.py)."""
+        from nomad_tpu.parallel import mesh as mesh_lib
+
+        args10 = e.args[:10]
+        count = jnp.int32(e.args[10])
+        penalty = jnp.float32(e.args[11])
+        mesh = mesh_lib.mesh_for_nodes(args10[0].shape[0])
+        if mesh is not None:
+            args10 = mesh_lib.shard_waterfill_args(mesh, args10)
+            count, penalty = mesh_lib.replicate_on_mesh(mesh, count, penalty)
+        return solve_waterfill(*args10, count, penalty, e.args[12], e.args[13])
 
     def _dispatch_group(self, entries: List[_Entry], jd: bool, td: bool) -> None:
         self.dispatches += 1
@@ -173,10 +185,7 @@ class CoalescingSolver:
         )
         if len(entries) == 1:
             e = entries[0]
-            counts_dev, remaining_dev = solve_waterfill(
-                *e.args[:10], jnp.int32(e.args[10]), jnp.float32(e.args[11]),
-                jd, td,
-            )
+            counts_dev, remaining_dev = self._solve_one(e)
             e.group = _Group(counts_dev[None], remaining_dev[None])
             e.index = 0
             e.event.set()
@@ -195,6 +204,13 @@ class CoalescingSolver:
         stacked = [jnp.stack(col) for col in cols]
         counts = jnp.asarray([r[10] for r in rows], dtype=jnp.int32)
         penalties = jnp.asarray([r[11] for r in rows], dtype=jnp.float32)
+        from nomad_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.mesh_for_nodes(stacked[0].shape[1])
+        if mesh is not None:
+            stacked, counts, penalties = mesh_lib.shard_waterfill_batch_args(
+                mesh, stacked, counts, penalties
+            )
         counts_dev, remaining_dev = solve_waterfill_batched(
             *stacked, counts, penalties, jd, td,
         )

@@ -11,6 +11,9 @@ RefreshIndex retries.
 
 from __future__ import annotations
 
+import logging
+import os
+import threading
 from functools import partial
 from typing import Optional, Tuple
 
@@ -23,6 +26,8 @@ from nomad_tpu.ops.binpack import solve_greedy
 
 EVAL_AXIS = "evals"
 NODE_AXIS = "nodes"
+
+logger = logging.getLogger("nomad_tpu.parallel")
 
 
 def make_mesh(
@@ -37,6 +42,145 @@ def make_mesh(
         raise ValueError(f"{n} devices not divisible by eval_parallel={eval_parallel}")
     arr = np.array(devices).reshape(eval_parallel, n // eval_parallel)
     return Mesh(arr, (EVAL_AXIS, NODE_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# Production node-axis sharding.
+#
+# When a mesh is configured (explicitly or via NOMAD_TPU_NODE_SHARDS), the
+# node-axis tensors of every production solve — the water-fill kernels that
+# carry the 10k-node x 100k-task load, and the mirror tensors they read —
+# are placed with NamedShardings over the NODE_AXIS. jit then compiles the
+# same kernels SPMD: the binary-search sum and the partial-round top-k
+# become XLA collectives over ICI (psum / all-gather of shard maxima), with
+# no kernel changes. This is the blueprint's scale axis (SURVEY.md §7
+# "blockwise/sharded masking and top-k over the node axis, pjit-sharded
+# across ICI"; the reference's analogous scale bound is the candidate scan,
+# /root/reference/scheduler/stack.go:94-121).
+
+_mesh_lock = threading.Lock()
+_configured_mesh: Optional[Mesh] = None
+_env_checked = False
+
+
+def configure_node_sharding(
+    n_devices: Optional[int] = None, eval_parallel: int = 1
+) -> Mesh:
+    """Shard all subsequent production solves over a device mesh. The node
+    axis extent must be a power of two (node tensors are padded to
+    power-of-two buckets, ops/binpack.py bucket())."""
+    global _configured_mesh
+    mesh = make_mesh(n_devices, eval_parallel=eval_parallel)
+    node_extent = mesh.shape[NODE_AXIS]
+    if node_extent & (node_extent - 1):
+        raise ValueError(
+            f"node axis extent {node_extent} is not a power of two; node "
+            "tensors are padded to power-of-two buckets and must divide"
+        )
+    with _mesh_lock:
+        _configured_mesh = mesh
+    return mesh
+
+
+def clear_node_sharding() -> None:
+    global _configured_mesh
+    with _mesh_lock:
+        _configured_mesh = None
+
+
+def node_sharding_mesh() -> Optional[Mesh]:
+    """The configured solve mesh, or None (single-device dispatch).
+
+    First call honors NOMAD_TPU_NODE_SHARDS=<k>: shard over the first k
+    local devices (k a power of two)."""
+    global _env_checked, _configured_mesh
+    with _mesh_lock:
+        if _configured_mesh is not None:
+            return _configured_mesh
+        if _env_checked:
+            return None
+        _env_checked = True
+    k = int(os.environ.get("NOMAD_TPU_NODE_SHARDS", "0") or 0)
+    if k > 1:
+        try:
+            return configure_node_sharding(k)
+        except Exception as e:
+            logger.warning(
+                "NOMAD_TPU_NODE_SHARDS=%d not usable (%s); solves stay "
+                "single-device", k, e,
+            )
+    return None
+
+
+def mesh_for_nodes(n: int) -> Optional[Mesh]:
+    """The configured mesh if the padded node-axis length ``n`` divides
+    evenly over it, else None (single-device dispatch). Small clusters on
+    big meshes — a padded bucket shorter than the node-axis extent — fall
+    back rather than crash every solve."""
+    mesh = node_sharding_mesh()
+    if mesh is None or n % mesh.shape[NODE_AXIS] != 0:
+        return None
+    return mesh
+
+
+def put_node_sharded(x, trailing_dims: int = 0):
+    """Place one node-axis tensor ([N, ...]) on the configured mesh, or on
+    the default device when no mesh is configured (or doesn't divide the
+    padded length). The mirror uses this so node tensors are born sharded
+    and dispatches pay no reshard."""
+    n = np.shape(x)[0]
+    mesh = mesh_for_nodes(n)
+    if mesh is None:
+        return jnp.asarray(x)
+    spec = P(NODE_AXIS, *(None,) * trailing_dims)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+# Water-fill argument shardings, in solve_waterfill positional order:
+# total[N,4], sched_cap[N,2], used0[N,4], job_count0[N], tg_count0[N],
+# bw_avail[N], bw_used0[N], eligible[N], ask[D], bw_ask[].
+_WF_SPECS = (
+    P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS, None),
+    P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+    P(), P(),
+)
+
+
+def replicate_on_mesh(mesh: Mesh, *xs) -> tuple:
+    """Replicate small tensors (asks, penalties, active masks) across the
+    mesh so they can join sharded node tensors in one jit call."""
+    sharding = NamedSharding(mesh, P())
+    return tuple(jax.device_put(x, sharding) for x in xs)
+
+
+def shard_waterfill_args(mesh: Mesh, args10) -> tuple:
+    """Place the 10 water-fill tensor args with node-axis shardings.
+    device_put is a no-op for args already sharded correctly (mirror
+    tensors); freshly built per-eval usage reshard once here."""
+    return tuple(
+        jax.device_put(x, NamedSharding(mesh, spec))
+        for x, spec in zip(args10, _WF_SPECS)
+    )
+
+
+def shard_waterfill_batch_args(mesh: Mesh, stacked10, counts, penalties):
+    """Batched (eval-stacked) variant: [B, ...] tensors, node axis sharded,
+    eval axis over EVAL_AXIS when the mesh has one."""
+    b = stacked10[0].shape[0]
+    eval_axis = EVAL_AXIS if b % mesh.shape[EVAL_AXIS] == 0 else None
+    specs = tuple(
+        P(eval_axis, *spec) for spec in (
+            (NODE_AXIS, None), (NODE_AXIS, None), (NODE_AXIS, None),
+            (NODE_AXIS,), (NODE_AXIS,), (NODE_AXIS,), (NODE_AXIS,),
+            (NODE_AXIS,), (None,), (),
+        )
+    )
+    placed = tuple(
+        jax.device_put(x, NamedSharding(mesh, spec))
+        for x, spec in zip(stacked10, specs)
+    )
+    vec = NamedSharding(mesh, P(eval_axis))
+    return placed, jax.device_put(counts, vec), jax.device_put(penalties, vec)
 
 
 @partial(jax.jit, static_argnames=("k", "job_distinct", "tg_distinct"))

@@ -99,10 +99,13 @@ PROBE_TIMEOUT = float(_os.environ.get("NOMAD_TPU_PROBE_TIMEOUT", "120"))
 PROBE_RETRY = float(_os.environ.get("NOMAD_TPU_PROBE_RETRY", "60"))
 
 _probe_lock = _threading.Lock()
-_probe_done = _threading.Event()
-# status: unprobed | probing | ready | down
+# status: unprobed | probing | ready | down. "done" is the completion event
+# of the CURRENT probe generation — never reused across generations, so a
+# superseded wedged probe finally exiting can't wake waiters on its
+# replacement.
 _probe_state: Dict[str, object] = {"status": "unprobed", "fallbacks": 0,
-                                   "generation": 0}
+                                   "generation": 0,
+                                   "done": _threading.Event()}
 
 
 def _start_probe_locked(logger: logging.Logger) -> None:
@@ -118,7 +121,8 @@ def _start_probe_locked(logger: logging.Logger) -> None:
     _probe_state["generation"] = gen
     _probe_state["status"] = "probing"
     _probe_state["started_at"] = _time.monotonic()
-    _probe_done.clear()
+    done = _threading.Event()
+    _probe_state["done"] = done
 
     def probe():
         try:
@@ -144,7 +148,7 @@ def _start_probe_locked(logger: logging.Logger) -> None:
                 "back to the host scheduler for %.0fs", e, PROBE_RETRY,
             )
         finally:
-            _probe_done.set()
+            done.set()
 
     _threading.Thread(target=probe, daemon=True,
                       name=f"tpu-device-probe-{gen}").start()
@@ -180,6 +184,7 @@ def _tpu_solver(logger: logging.Logger):
         _probe_state["fallbacks"] = int(_probe_state["fallbacks"]) + (
             0 if started else 1
         )
+        done = _probe_state["done"]
     if not started:
         # A probe is in flight (or the device is in its down-cooldown):
         # fall back without blocking behind the prober.
@@ -187,7 +192,7 @@ def _tpu_solver(logger: logging.Logger):
     # The caller that started the probe gives it one timeout's grace —
     # this keeps single-threaded flows (tests, dev agents) on the device
     # path without a warm-up blip, while peers fall back concurrently.
-    _probe_done.wait(PROBE_TIMEOUT)
+    done.wait(PROBE_TIMEOUT)
     with _probe_lock:
         if _probe_state["status"] == "ready":
             return _probe_state["solver"]
@@ -229,6 +234,7 @@ def wait_for_device(timeout: float = 600.0,
                     sleep_until = retry_at
             elif _probe_is_stale_locked():
                 _start_probe_locked(log)
+            done = _probe_state["done"]
         now = _time.monotonic()
         remaining = deadline - now
         if remaining <= 0:
@@ -236,9 +242,9 @@ def wait_for_device(timeout: float = 600.0,
         wait = min(remaining, 1.0)
         if sleep_until is not None:
             wait = min(remaining, max(sleep_until - now, 0.05))
-            _time.sleep(wait)  # cooldown: _probe_done is already set
+            _time.sleep(wait)  # down-cooldown: the probe event is long set
         else:
-            _probe_done.wait(wait)
+            done.wait(wait)
 
 
 def device_probe_status() -> Dict[str, object]:

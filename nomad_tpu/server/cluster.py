@@ -200,6 +200,23 @@ class ClusterServer(Server):
             return None, ""
         return from_dict(Evaluation, out["eval"]), out["token"]
 
+    def eval_dequeue_batch(self, schedulers: List[str], max_batch: int,
+                           timeout: float):
+        if self.raft.is_leader:
+            return self.eval_broker.dequeue_batch(
+                schedulers, max_batch, timeout
+            )
+        out = self._forward(
+            "Eval.DequeueBatch",
+            {"schedulers": schedulers, "max_batch": max_batch,
+             "timeout": timeout},
+            pool=self.longpoll_pool, timeout=timeout + 5.0,
+        )
+        return [
+            (from_dict(Evaluation, item["eval"]), item["token"])
+            for item in out["batch"]
+        ]
+
     def eval_ack(self, eval_id: str, token: str) -> None:
         if self.raft.is_leader:
             self.eval_broker.ack(eval_id, token)
@@ -281,6 +298,7 @@ class ClusterServer(Server):
         r("Status.Regions", lambda args: self.regions())
 
         r("Eval.Dequeue", self._rpc_eval_dequeue)
+        r("Eval.DequeueBatch", self._rpc_eval_dequeue_batch)
         r("Eval.Ack", lambda a: self.eval_ack(a["eval_id"], a["token"]))
         r("Eval.Nack", lambda a: self.eval_nack(a["eval_id"], a["token"]))
         r("Eval.Upsert", lambda a: self.eval_upsert(
@@ -311,6 +329,15 @@ class ClusterServer(Server):
         if ev is None:
             return {"eval": None, "token": ""}
         return {"eval": to_dict(ev), "token": token}
+
+    def _rpc_eval_dequeue_batch(self, args: dict):
+        batch = self.eval_dequeue_batch(
+            args["schedulers"], int(args.get("max_batch", 1)),
+            min(float(args.get("timeout", 0.5)), 10.0),
+        )
+        return {"batch": [
+            {"eval": to_dict(ev), "token": token} for ev, token in batch
+        ]}
 
     def _rpc_plan_submit(self, args: dict):
         plan = from_dict(Plan, args["plan"])

@@ -60,6 +60,11 @@ class ServerConfig:
     scheduler_backend: str = "tpu"
     eval_nack_timeout: float = 60.0
     eval_delivery_limit: int = 3
+    # Broker-level eval coalescing: each worker drains up to this many
+    # ready evals (distinct jobs) per dequeue and runs them concurrently,
+    # stacking their device solves into one vmapped dispatch
+    # (SURVEY.md §7 "Batched evals"; 1 disables).
+    eval_batch_size: int = 4
     eval_gc_interval: float = 300.0
     eval_gc_threshold: float = 3600.0
     node_gc_interval: float = 300.0
@@ -451,6 +456,14 @@ class Server:
 
     def eval_dequeue(self, schedulers: List[str], timeout: float):
         return self.eval_broker.dequeue(schedulers, timeout)
+
+    def eval_dequeue_batch(self, schedulers: List[str], max_batch: int,
+                           timeout: float):
+        """Coalescing dequeue: block for one eval, drain up to max_batch-1
+        more ready ones (distinct jobs). The broker half of SURVEY.md §7
+        'Batched evals' — the worker runs the batch concurrently so the
+        device solves stack into one dispatch (ops/coalesce.py)."""
+        return self.eval_broker.dequeue_batch(schedulers, max_batch, timeout)
 
     def eval_ack(self, eval_id: str, token: str) -> None:
         self.eval_broker.ack(eval_id, token)

@@ -35,6 +35,8 @@ class Worker(threading.Thread):
         self.eval_token: Optional[str] = None
         # State snapshot used for the current eval
         self._snapshot = None
+        # Size of the most recent broker batch drain (observability/tests)
+        self.last_batch_size = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -54,24 +56,52 @@ class Worker(threading.Thread):
                 self._pause_cond.wait(0.2)
 
     def run(self) -> None:
+        batch_size = getattr(self.server.config, "eval_batch_size", 1)
         while not self._stop.is_set():
             self._check_paused()
-            dequeued = self._dequeue_evaluation()
-            if dequeued is None:
-                continue
-            ev, token = dequeued
+            if batch_size > 1:
+                batch = self._dequeue_batch(batch_size)
+                if not batch:
+                    continue
+                self.last_batch_size = len(batch)
+                if len(batch) == 1:
+                    self._process(*batch[0])
+                    continue
+                # Concurrent compatible evals (distinct jobs) from one
+                # broker drain: run them in parallel so their device
+                # solves stack into one coalesced dispatch
+                # (ops/coalesce.py; SURVEY.md §7 "Batched evals").
+                telemetry.add_sample(
+                    ("worker", "eval_batch_size"), float(len(batch))
+                )
+                threads = [
+                    threading.Thread(
+                        target=self._process, args=(ev, token), daemon=True,
+                        name=f"{self.name}-batch{i}",
+                    )
+                    for i, (ev, token) in enumerate(batch)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            else:
+                dequeued = self._dequeue_evaluation()
+                if dequeued is None:
+                    continue
+                self._process(*dequeued)
 
-            # Wait for the state to reach the eval's modify index
-            # (worker.go:209-230).
-            try:
-                self._wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
-            except TimeoutError as e:
-                self.logger.error("error waiting for state sync: %s", e)
-                self._send_ack(ev.id, token, ack=False)
-                continue
-
-            ok = self._invoke_scheduler(ev, token)
-            self._send_ack(ev.id, token, ack=ok)
+    def _process(self, ev: Evaluation, token: str) -> None:
+        # Wait for the state to reach the eval's modify index
+        # (worker.go:209-230).
+        try:
+            self._wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
+        except TimeoutError as e:
+            self.logger.error("error waiting for state sync: %s", e)
+            self._send_ack(ev.id, token, ack=False)
+            return
+        ok = self._invoke_scheduler(ev, token, planner=_EvalRun(self, token))
+        self._send_ack(ev.id, token, ack=ok)
 
     # -- internals ---------------------------------------------------------
 
@@ -94,6 +124,28 @@ class Worker(threading.Thread):
         telemetry.measure_since(("worker", "dequeue_eval"), start)
         self.logger.debug("dequeued evaluation %s", ev.id)
         return ev, token
+
+    def _dequeue_batch(self, max_batch: int):
+        start = time.perf_counter()
+        try:
+            batch = self.server.eval_dequeue_batch(
+                self.server.config.enabled_schedulers, max_batch,
+                timeout=DEQUEUE_TIMEOUT,
+            )
+        except BrokerError:
+            time.sleep(0.05)
+            return []
+        except Exception as e:
+            self.logger.debug("batch dequeue failed, retrying: %s", e)
+            time.sleep(0.1)
+            return []
+        if batch:
+            telemetry.measure_since(("worker", "dequeue_eval"), start)
+            self.logger.debug(
+                "dequeued %d evaluation(s): %s",
+                len(batch), [ev.id for ev, _ in batch],
+            )
+        return batch
 
     def _send_ack(self, eval_id: str, token: str, ack: bool) -> None:
         """Best effort ack/nack (worker.go:172-202)."""
@@ -128,19 +180,29 @@ class Worker(threading.Thread):
             time.sleep(delay)
             delay = min(delay * 2, 0.1)
 
-    def _invoke_scheduler(self, ev: Evaluation, token: str) -> bool:
-        """worker.go:232-261"""
+    def _invoke_scheduler(self, ev: Evaluation, token: str,
+                          planner: Optional["_EvalRun"] = None) -> bool:
+        """worker.go:232-261. ``planner`` carries per-eval token/snapshot
+        state for batched processing; defaults to the worker itself (the
+        single-eval posture, kept for the legacy call shape)."""
         start = time.perf_counter()
-        self.eval_token = token
-        self._snapshot = self.server.state_store.snapshot()
+        snapshot = self.server.state_store.snapshot()
+        if planner is None:
+            # Legacy single-eval posture only: concurrent batch threads
+            # must not stamp shared worker state (their token rides in
+            # the per-eval _EvalRun).
+            self.eval_token = token
+            self._snapshot = snapshot
         try:
             if ev.type == JOB_TYPE_CORE:
                 from nomad_tpu.server.core_sched import CoreScheduler
 
-                sched = CoreScheduler(self.server, self._snapshot)
+                sched = CoreScheduler(self.server, snapshot)
             else:
                 factory = self.server.config.scheduler_factory(ev.type)
-                sched = new_scheduler(factory, self._snapshot, self, self.logger)
+                sched = new_scheduler(
+                    factory, snapshot, planner or self, self.logger
+                )
             sched.process(ev)
             telemetry.measure_since(("worker", "invoke_scheduler", ev.type), start)
             return True
@@ -151,21 +213,43 @@ class Worker(threading.Thread):
     # -- Planner interface (worker.go:263-396) ------------------------------
 
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
-        start = time.perf_counter()
-        plan.eval_token = self.eval_token
-        result = self.server.plan_submit(plan)
-        telemetry.measure_since(("worker", "submit_plan"), start)
-
-        new_state = None
-        if result.refresh_index != 0:
-            # Stale data: wait for the log to catch up, then refresh
-            # (worker.go:304-322).
-            self._wait_for_index(result.refresh_index, RAFT_SYNC_LIMIT)
-            new_state = self.server.state_store.snapshot()
-        return result, new_state
+        return _EvalRun(self, self.eval_token).submit_plan(plan)
 
     def update_eval(self, ev: Evaluation) -> None:
         self.server.eval_upsert([ev])
 
     def create_eval(self, ev: Evaluation) -> None:
         self.server.eval_upsert([ev])
+
+
+class _EvalRun:
+    """Per-eval Planner context (worker.go:263-396 semantics).
+
+    Batched workers process several evals concurrently; each carries its
+    own EvalToken so concurrent submit_plans can't stamp each other's
+    token (the split-brain guard checked at plan apply,
+    /root/reference/nomad/plan_apply.go:53-58)."""
+
+    def __init__(self, worker: Worker, token: Optional[str]):
+        self.worker = worker
+        self.eval_token = token
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        start = time.perf_counter()
+        plan.eval_token = self.eval_token
+        result = self.worker.server.plan_submit(plan)
+        telemetry.measure_since(("worker", "submit_plan"), start)
+
+        new_state = None
+        if result.refresh_index != 0:
+            # Stale data: wait for the log to catch up, then refresh
+            # (worker.go:304-322).
+            self.worker._wait_for_index(result.refresh_index, RAFT_SYNC_LIMIT)
+            new_state = self.worker.server.state_store.snapshot()
+        return result, new_state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.worker.server.eval_upsert([ev])
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.worker.server.eval_upsert([ev])

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nomad_tpu.ops.binpack import bucket
+from nomad_tpu.parallel.mesh import put_node_sharded
 from nomad_tpu.scheduler.feasible import (
     _parse_bool,
     check_constraint,
@@ -67,11 +68,14 @@ class NodeMirror:
             if node.reserved is not None:
                 bw_reserved[i] = sum(net.mbits for net in node.reserved.networks)
 
-        self.total = jnp.asarray(total)
+        # Node tensors are born with the configured node-axis sharding (a
+        # no-op single-device placement when no mesh is set), so sharded
+        # solves pay no per-dispatch reshard of the big [N, .] inputs.
+        self.total = put_node_sharded(total, 1)
         self.reserved_np = reserved
         sched = (total - reserved)[:, :2].astype(np.float32)
-        self.sched_cap = jnp.asarray(sched)
-        self.bw_avail = jnp.asarray(bw_avail)
+        self.sched_cap = put_node_sharded(sched, 1)
+        self.bw_avail = put_node_sharded(bw_avail)
         self.bw_reserved = bw_reserved
         self.base_mask = np.zeros(self.padded, dtype=bool)
         self.base_mask[: self.n] = True
@@ -150,7 +154,7 @@ class NodeMirror:
             mask = mask & self.constraint_mask(ctx, job_constraints)
         if tg_constraints:
             mask = mask & self.constraint_mask(ctx, tg_constraints)
-        entry = (jnp.asarray(mask), int(self.n - mask[: self.n].sum()))
+        entry = (put_node_sharded(mask), int(self.n - mask[: self.n].sum()))
         self._device_mask_cache[key] = entry
         return entry
 
@@ -161,10 +165,12 @@ class NodeMirror:
         with no allocations and a plan with no placements yet — just the
         reserved base. The fresh-registration fast path."""
         if self._clean_usage_dev is None:
-            zeros = jnp.zeros(self.padded, dtype=jnp.int32)
+            zeros = put_node_sharded(
+                np.zeros(self.padded, dtype=np.int32)
+            )
             self._clean_usage_dev = (
-                jnp.asarray(self.reserved_np), zeros, zeros,
-                jnp.asarray(self.bw_reserved),
+                put_node_sharded(self.reserved_np, 1), zeros, zeros,
+                put_node_sharded(self.bw_reserved),
             )
         return self._clean_usage_dev
 
@@ -229,10 +235,10 @@ class NodeMirror:
                 if delta.any():
                     used[i] += delta.astype(np.int32)
         return (
-            jnp.asarray(used),
-            jnp.asarray(job_count),
-            jnp.asarray(tg_count),
-            jnp.asarray(bw_used),
+            put_node_sharded(used, 1),
+            put_node_sharded(job_count),
+            put_node_sharded(tg_count),
+            put_node_sharded(bw_used),
         )
 
 

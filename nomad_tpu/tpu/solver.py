@@ -869,10 +869,18 @@ class TPUSystemScheduler(SystemScheduler):
                 continue
 
             # One dispatch: fit + score for every node at once.
+            from nomad_tpu.parallel import mesh as mesh_lib
+
+            ask, bw_ask, zero = prep.ask, prep.bw_ask, jnp.float32(0.0)
+            mesh = mesh_lib.mesh_for_nodes(mirror.total.shape[0])
+            if mesh is not None:
+                ask, bw_ask, zero = mesh_lib.replicate_on_mesh(
+                    mesh, ask, bw_ask, zero
+                )
             _score, fit = _greedy_step_state(
                 mirror.total, mirror.sched_cap, prep.used, prep.job_count,
                 prep.tg_count, mirror.bw_avail, prep.bw_used, prep.mask,
-                prep.ask, prep.bw_ask, jnp.float32(0.0),
+                ask, bw_ask, zero,
                 prep.job_distinct, prep.tg_distinct,
             )
             fit_np = np.asarray(fit)

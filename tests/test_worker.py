@@ -145,6 +145,89 @@ def test_create_and_update_eval_replicate(srv):
     assert srv.state_store.eval_by_id(ev.id).status == structs.EVAL_STATUS_COMPLETE
 
 
+def _seed_n_jobs(srv, n, count=1):
+    node = mock.node()
+    node.resources.cpu = 500_000
+    node.resources.memory_mb = 500_000
+    srv.raft.apply("node_register", {"node": node})
+    jobs, evals = [], []
+    for _ in range(n):
+        job = mock.job()
+        job.task_groups[0].count = count
+        # cpu/mem-bound: the mock NIC ask would cap the single test node
+        # at ~20 total placements across all jobs
+        job.task_groups[0].tasks[0].resources.networks = []
+        srv.raft.apply("job_register", {"job": job})
+        ev = Evaluation(
+            id=generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+            status=structs.EVAL_STATUS_PENDING,
+        )
+        jobs.append(job)
+        evals.append(ev)
+    srv.raft.apply("eval_update", {"evals": evals})
+    return jobs, evals
+
+
+def test_worker_batch_dequeue_drains_ready_evals(srv):
+    """K queued evals for distinct jobs drain in ONE broker batch
+    (eval_broker.py dequeue_batch wired through the server seam)."""
+    jobs, evals = _seed_n_jobs(srv, 4)
+    w = Worker(srv, worker_id=92)
+    batch = w._dequeue_batch(4)
+    assert len(batch) == 4
+    assert {ev.id for ev, _ in batch} == {ev.id for ev in evals}
+    # Each eval carries its own outstanding token
+    assert len({token for _, token in batch}) == 4
+    for ev, token in batch:
+        w._send_ack(ev.id, token, ack=True)
+
+
+def test_batched_worker_processes_all_with_coalesced_dispatches():
+    """End-to-end through the REAL server loop: K queued evals for K jobs,
+    one batched worker, TPU backend. All jobs fully placed through the
+    plan queue, the broker drain happened as one batch, and the concurrent
+    device solves took no more dispatches than evals (they stack in the
+    coalescing engine; fewer when timing allows)."""
+    from nomad_tpu.ops.coalesce import GLOBAL_SOLVER
+
+    s = Server(ServerConfig(
+        scheduler_backend="tpu", num_schedulers=0, eval_batch_size=4,
+    ))
+    s.plan_queue.set_enabled(True)
+    s.eval_broker.set_enabled(True)
+    s.plan_applier.start()
+    try:
+        # count > exact threshold so the water-fill/coalescer path runs
+        jobs, evals = _seed_n_jobs(s, 4, count=200)
+        dispatches_before = GLOBAL_SOLVER.dispatches
+        w = Worker(s, worker_id=91)
+        w.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            done = [
+                s.state_store.eval_by_id(ev.id) for ev in evals
+            ]
+            if all(
+                d is not None and d.status == structs.EVAL_STATUS_COMPLETE
+                for d in done
+            ):
+                break
+            time.sleep(0.05)
+        for job in jobs:
+            allocs = [
+                a for a in s.state_store.allocs_by_job(job.id)
+                if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+            ]
+            assert len(allocs) == 200, (job.id, len(allocs))
+        assert w.last_batch_size == 4  # one broker drain carried all four
+        solves = GLOBAL_SOLVER.dispatches - dispatches_before
+        assert 1 <= solves <= 4
+        w.stop()
+    finally:
+        s.shutdown()
+
+
 def test_worker_pause_blocks_processing(srv):
     """The leader pauses one worker (worker.go:77-93, leader.go:100-104):
     a paused worker must not dequeue."""
