@@ -33,6 +33,7 @@ class AllocRunner:
         alloc_dir_root: str,
         updater: Callable[[Allocation], None],
         logger: Optional[logging.Logger] = None,
+        options=None,
     ):
         # Own copy: the in-process store hands out shared objects; client
         # status must flow through the replicated log, never in-place.
@@ -40,7 +41,7 @@ class AllocRunner:
         self.updater = updater
         self.logger = logger or logging.getLogger("nomad_tpu.alloc_runner")
         self.alloc_dir = AllocDir(os.path.join(alloc_dir_root, alloc.id))
-        self.ctx = ExecContext(self.alloc_dir, alloc.id)
+        self.ctx = ExecContext(self.alloc_dir, alloc.id, options=options)
         self.task_runners: Dict[str, TaskRunner] = {}
         self.task_status: Dict[str, str] = {}
         self._lock = threading.Lock()

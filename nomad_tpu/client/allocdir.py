@@ -40,5 +40,37 @@ class AllocDir:
     def log_dir(self) -> str:
         return os.path.join(self.shared_dir, LOG_DIR_NAME)
 
+    def embed(self, task: str, dirs: Dict[str, str]) -> None:
+        """Populate a task's chroot with host directories
+        (alloc_dir.go:115-170 Embed): each ``{host_src: chroot_dest}``
+        entry is mirrored into the task dir, hardlinking files where the
+        filesystem allows and copying otherwise. Missing sources are
+        skipped (the reference's chrootEnv is a best-effort host set)."""
+        task_dir = self.task_dirs[task]
+        for src, dest in dirs.items():
+            if not os.path.isdir(src):
+                continue
+            dest_dir = os.path.join(task_dir, dest.lstrip("/"))
+            for dirpath, _subdirs, files in os.walk(src):
+                rel = os.path.relpath(dirpath, src)
+                target = (dest_dir if rel == "." else
+                          os.path.join(dest_dir, rel))
+                os.makedirs(target, exist_ok=True)
+                for name in files:
+                    s = os.path.join(dirpath, name)
+                    t = os.path.join(target, name)
+                    if os.path.lexists(t):
+                        continue
+                    try:
+                        if os.path.islink(s):
+                            os.symlink(os.readlink(s), t)
+                        else:
+                            os.link(s, t)
+                    except OSError:
+                        try:
+                            shutil.copy2(s, t, follow_symlinks=False)
+                        except OSError:
+                            pass  # best-effort, like the reference
+
     def destroy(self) -> None:
         shutil.rmtree(self.alloc_dir, ignore_errors=True)

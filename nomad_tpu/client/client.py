@@ -253,7 +253,8 @@ class Client:
 
     def _add_alloc(self, alloc: Allocation) -> None:
         runner = AllocRunner(
-            alloc, self.config.alloc_dir, self._update_alloc_status, self.logger
+            alloc, self.config.alloc_dir, self._update_alloc_status,
+            self.logger, options=self.config.options,
         )
         with self._alloc_lock:
             self.alloc_runners[alloc.id] = runner
@@ -312,7 +313,7 @@ class Client:
                 continue
             runner = AllocRunner(
                 alloc, self.config.alloc_dir, self._update_alloc_status,
-                self.logger,
+                self.logger, options=self.config.options,
             )
             runner.restore(alloc_state)
             with self._alloc_lock:

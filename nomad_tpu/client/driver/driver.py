@@ -18,9 +18,13 @@ class DriverError(Exception):
 class ExecContext:
     """Context passed to driver start (reference: driver.go:97-116)."""
 
-    def __init__(self, alloc_dir, alloc_id: str):
+    def __init__(self, alloc_dir, alloc_id: str, options=None):
         self.alloc_dir = alloc_dir  # allocdir.AllocDir
         self.alloc_id = alloc_id
+        # Client config options (config.Options namespaced map, consumed by
+        # drivers like the reference's DriverContext config,
+        # client/config/config.go:51-75). Plain dict, may be empty.
+        self.options = options or {}
 
 
 class DriverHandle:

@@ -77,6 +77,37 @@ class ExecutorHandle(DriverHandle):
         spawn.kill(self.state_prefix)
 
 
+# Host directories embedded into each exec-driver chroot
+# (exec_linux.go:29-41 chrootEnv).
+CHROOT_ENV = {
+    "/bin": "/bin",
+    "/etc": "/etc",
+    "/lib": "/lib",
+    "/lib32": "/lib32",
+    "/lib64": "/lib64",
+    "/usr/bin": "/usr/bin",
+    "/usr/lib": "/usr/lib",
+}
+
+
+def chroot_available() -> bool:
+    """chroot + setuid require root (exec_linux.go gates the Linux
+    executor the same way)."""
+    return os.name == "posix" and os.geteuid() == 0
+
+
+def nobody_ids() -> tuple:
+    """(uid, gid) of the unprivileged user tasks run as
+    (exec_linux.go:154-156 runAs("nobody"))."""
+    import pwd
+
+    try:
+        rec = pwd.getpwnam("nobody")
+        return rec.pw_uid, rec.pw_gid
+    except KeyError:
+        return 65534, 65534
+
+
 def start_command(
     ctx,
     task: Task,
@@ -84,8 +115,16 @@ def start_command(
     args: List[str],
     env: Dict[str, str],
     isolate: bool = True,
+    chroot: bool = False,
+    run_as_nobody: bool = False,
 ) -> ExecutorHandle:
-    """Start a command through the spawn daemon in the task's directory."""
+    """Start a command through the spawn daemon in the task's directory.
+
+    With ``chroot`` the child roots into the task dir before exec, so
+    ``command`` must be a path inside it (artifacts land there; host
+    binaries ride the embedded CHROOT_ENV). ``run_as_nobody`` drops
+    privileges after the chroot. Both require root and silently degrade
+    otherwise, recorded on the handle."""
     task_dir = ctx.alloc_dir.task_dirs.get(task.name, ctx.alloc_dir.alloc_dir)
     log_dir = ctx.alloc_dir.log_dir()
     # Unique per start: a restart must not read the previous attempt's
@@ -104,11 +143,18 @@ def start_command(
     full_env.update(env)
     full_env.setdefault("PATH", os.environ.get("PATH", "/usr/bin:/bin"))
 
+    can_isolate = chroot_available()
+    uid = gid = -1
+    if run_as_nobody and can_isolate:
+        uid, gid = nobody_ids()
+    chroot_dir = task_dir if (chroot and can_isolate) else ""
+
     pid = spawn.spawn_detached(
-        command, args, full_env, task_dir, stdout, stderr, state_prefix
+        command, args, full_env, task_dir, stdout, stderr, state_prefix,
+        chroot=chroot_dir, uid=uid, gid=gid,
     )
     isolated = isolate and apply_cgroup_limits(pid, task.name, task.resources)
-    return ExecutorHandle(state_prefix, isolated)
+    return ExecutorHandle(state_prefix, isolated or bool(chroot_dir))
 
 
 def open_handle(handle_id: str) -> ExecutorHandle:

@@ -31,9 +31,17 @@ def spawn_detached(
     stdout_path: str,
     stderr_path: str,
     state_prefix: str,
+    chroot: str = "",
+    uid: int = -1,
+    gid: int = -1,
 ) -> int:
     """Launch the spawn daemon; returns the daemon pid. The daemon execs the
-    user command in a new session and records pid + exit status."""
+    user command in a new session and records pid + exit status.
+
+    ``chroot``/``uid``/``gid`` apply least-privilege isolation in the child
+    just before exec (the reference Linux executor chroots into the task
+    dir and runs as nobody, exec_linux.go:154-156, 240-290); they require
+    the agent to run as root."""
     spec = {
         "command": command,
         "args": args,
@@ -42,6 +50,9 @@ def spawn_detached(
         "stdout": stdout_path,
         "stderr": stderr_path,
         "state_prefix": state_prefix,
+        "chroot": chroot,
+        "uid": uid,
+        "gid": gid,
     }
     from nomad_tpu.discover import spawn_daemon_command
 
@@ -145,14 +156,38 @@ def _daemon_main(spec_json: str) -> int:
 
     stdout = open(spec["stdout"], "ab")
     stderr = open(spec["stderr"], "ab")
+
+    chroot = spec.get("chroot") or ""
+    uid = int(spec.get("uid", -1))
+    gid = int(spec.get("gid", -1))
+    cwd = spec["cwd"]
+    preexec = None
+    if chroot or uid >= 0:
+        # Least-privilege order matters: chroot while still root, then drop
+        # groups/gid/uid (exec_linux.go:145-156). Runs in the forked child
+        # (single-threaded daemon) right before exec; the command path
+        # resolves inside the new root.
+        cwd = None
+
+        def preexec():
+            if chroot:
+                os.chroot(chroot)
+                os.chdir("/")
+            if gid >= 0:
+                os.setgroups([])
+                os.setgid(gid)
+            if uid >= 0:
+                os.setuid(uid)
+
     try:
         proc = subprocess.Popen(
             [spec["command"], *spec["args"]],
             env=spec["env"],
-            cwd=spec["cwd"],
+            cwd=cwd,
             stdout=stdout,
             stderr=stderr,
             start_new_session=True,
+            preexec_fn=preexec,
         )
     except OSError as e:
         with open(prefix + ".status", "w") as f:

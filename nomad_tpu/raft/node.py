@@ -11,8 +11,13 @@ framework RPC layer. Scope notes vs the paper:
   snapshots through raft.FileSnapshotStore retaining 2
   (nomad/server.go:437,453); we retain ``snapshot_retain`` snapshot files
   the same way.
-- membership change: static peer set per cluster (the reference's
-  bootstrap_expect posture, nomad/serf.go:76-134)
+- membership change: single-server add/remove committed through the log
+  as ``_config`` entries (add_peer/remove_peer, one change at a time).
+  The cluster layer drives them from gossip events the way the
+  reference's leader reconciles Serf members with Raft peers
+  (nomad/serf.go:76-134, nomad/leader.go:263-343). A server that applies
+  its own removal stops starting elections (no removed-server disruption)
+  until a leader contacts it again after a re-add.
 
 Persistence: term/vote/log journal + snapshot files to ``data_dir`` when
 set; on restart the newest valid snapshot is restored into the FSM and the
@@ -149,6 +154,11 @@ class RaftNode:
         self.leader_id: Optional[str] = None
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
+        # Set when this node applies its own removal from the peer set; a
+        # removed server must not start elections (it would disrupt the
+        # cluster with ever-higher terms). Cleared when a leader contacts
+        # us again (re-added via a later _config entry).
+        self.removed = False
 
         self._lock = threading.RLock()
         self._apply_futures: Dict[int, Future] = {}
@@ -228,6 +238,64 @@ class RaftNode:
         future = self.apply("_noop", {})
         return future.result(timeout)
 
+    # -- membership change (single-server, committed through the log) -------
+
+    def seed_peers(self, peers: Dict[str, str]) -> bool:
+        """Pre-bootstrap membership seeding (the reference's maybeBootstrap,
+        serf.go:76-134): while nothing has ever committed, gossip-discovered
+        members go straight into the peer table so the first election can
+        reach bootstrap_expect. Once the cluster has state, membership
+        moves only via committed _config entries. Returns True if seeded."""
+        with self._lock:
+            if self.commit_index > 0:
+                return False
+            self.config.peers.update(peers)
+            return True
+
+    def add_peer(self, pid: str, addr: str) -> Future:
+        """Leader-only: commit the addition of a peer. Takes effect (on
+        every node, incl. replication targets and quorum math) when the
+        entry applies."""
+        return self.apply("_config", {"op": "add", "id": pid, "addr": addr})
+
+    def remove_peer(self, pid: str) -> Future:
+        """Leader-only: commit the removal of a peer (a leader never
+        removes itself — transfer leadership by crashing instead)."""
+        if pid == self.config.node_id:
+            future: Future = Future()
+            future.set_exception(
+                ValueError("a leader cannot remove itself")
+            )
+            return future
+        return self.apply("_config", {"op": "remove", "id": pid})
+
+    def _apply_config_locked(self, payload: dict) -> None:
+        op, pid = payload.get("op"), payload.get("id")
+        if op == "add":
+            addr = payload.get("addr", "")
+            if self.config.peers.get(pid) != addr:
+                self.config.peers[pid] = addr
+                self.logger.info(
+                    "raft: node %s peer set += %s (%d members)",
+                    self.config.node_id, pid, len(self.config.peers),
+                )
+        elif op == "remove":
+            if pid == self.config.node_id:
+                self.removed = True
+                self.role = FOLLOWER
+                self.logger.info(
+                    "raft: node %s removed from the cluster; standing down",
+                    self.config.node_id,
+                )
+            if self.config.peers.pop(pid, None) is not None:
+                self.logger.info(
+                    "raft: node %s peer set -= %s (%d members)",
+                    self.config.node_id, pid, len(self.config.peers),
+                )
+            self.next_index.pop(pid, None)
+            self.match_index.pop(pid, None)
+        self._persist_meta()  # the peer table is durable state
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -251,8 +319,12 @@ class RaftNode:
         if not self.config.data_dir:
             return
         meta_path, _ = self._paths()
+        # The peer table rides the meta file: _config entries are compacted
+        # out of the log, and the snapshot holds only FSM state, so without
+        # this a restart from snapshot would come up with peers == {self}.
         _atomic_write(meta_path, json.dumps(
-            {"term": self.current_term, "voted_for": self.voted_for}
+            {"term": self.current_term, "voted_for": self.voted_for,
+             "peers": dict(self.config.peers)}
         ))
 
     def _persist_entry(self, index: int, entry: _Entry) -> None:
@@ -308,6 +380,9 @@ class RaftNode:
                 meta = json.load(f)
             self.current_term = meta.get("term", 0)
             self.voted_for = meta.get("voted_for")
+            persisted_peers = meta.get("peers") or {}
+            persisted_peers.pop(self.config.node_id, None)
+            self.config.peers.update(persisted_peers)
         except (OSError, ValueError):
             pass
         # Newest valid snapshot first (fall back through retained copies),
@@ -412,6 +487,10 @@ class RaftNode:
             with self._lock:
                 if self.role == LEADER:
                     continue
+                if self.removed:
+                    # Not a member: don't disrupt the cluster with elections.
+                    self._election_deadline = self._random_deadline()
+                    continue
                 if len(self.config.peers) < self.config.bootstrap_expect:
                     # Not yet bootstrapped: wait for peers to join.
                     self._election_deadline = self._random_deadline()
@@ -496,6 +575,13 @@ class RaftNode:
 
     def _handle_request_vote(self, args: dict) -> dict:
         with self._lock:
+            # Votes from non-members are ignored WITHOUT adopting their
+            # term: a server removed while partitioned (it never saw its
+            # removal commit) would otherwise depose live leaders with
+            # ever-higher terms forever (hashicorp/raft guards the same
+            # way; the cluster layer re-joins such a server via gossip).
+            if args["candidate_id"] not in self.config.peers:
+                return {"term": self.current_term, "vote_granted": False}
             term = args["term"]
             if term > self.current_term:
                 self._become_follower(term, None)
@@ -686,7 +772,9 @@ class RaftNode:
             index = self.last_applied + 1
             entry = self._entry_at(index)
             try:
-                if entry.msg_type != "_noop":
+                if entry.msg_type == "_config":
+                    self._apply_config_locked(entry.payload)
+                elif entry.msg_type != "_noop":
                     self.fsm.apply(
                         index, entry.msg_type,
                         decode_payload(entry.msg_type, entry.payload),
@@ -770,6 +858,10 @@ class RaftNode:
                 self._become_follower(term, args["leader_id"])
             self.leader_id = args["leader_id"]
             self._election_deadline = self._random_deadline()
+            if self.removed:
+                # A leader talking to us means we are a member again
+                # (re-added by a committed _config entry on its side).
+                self.removed = False
 
             prev_idx = args["prev_log_index"]
             prev_term = args["prev_log_term"]

@@ -54,6 +54,18 @@ class ClusterConfig:
     # 2 at nomad/server.go:453).
     snapshot_threshold: int = 8192
     snapshot_retain: int = 2
+    # Gossip-style failure detection (serf memberlist probing, serf.go:136-
+    # 194): each server pings its same-region peers every probe_interval;
+    # suspicion_threshold consecutive failures mark a member failed. The
+    # leader reconciles membership (leader.go:263-343): failed members are
+    # removed from the Raft configuration and reaped from the member table;
+    # gossip-known members missing from Raft are added.
+    probe_interval: float = 1.0
+    probe_timeout: float = 1.0
+    suspicion_threshold: int = 5
+    # Keep retrying start_join addresses until one succeeds (the agent's
+    # retry-join posture, command/agent/command.go).
+    retry_join_interval: float = 2.0
 
 
 class ClusterServer(Server):
@@ -85,11 +97,20 @@ class ClusterServer(Server):
             self.config.region: self.cluster.peers
         }
 
-        # Replace the in-process replication layer with Raft
+        # Member liveness from the probing loop: node_id -> "alive"/"failed"
+        # (absent = alive, never probed bad).
+        self._member_status: Dict[str, str] = {}
+        self._probe_failures: Dict[str, int] = {}
+
+        # Replace the in-process replication layer with Raft. Raft keeps
+        # its OWN peer table (seeded from the gossip view at start, then
+        # changed only by committed _config entries via the leader's
+        # reconciliation) — the gossip table converges eventually, the
+        # Raft configuration changes one committed step at a time.
         self.raft = RaftNode(
             RaftConfig(
                 node_id=self.cluster.node_id,
-                peers=self.cluster.peers,
+                peers={self.cluster.node_id: self.rpc_addr},
                 heartbeat_interval=self.cluster.heartbeat_interval,
                 election_timeout_min=self.cluster.election_timeout_min,
                 election_timeout_max=self.cluster.election_timeout_max,
@@ -118,12 +139,26 @@ class ClusterServer(Server):
             return
         self._started = True
         self.rpc.start()
+        joined = not self.cluster.start_join
         for addr in self.cluster.start_join:
             try:
                 n = self.join(addr)
                 self.logger.info("cluster: joined %d peers via %s", n, addr)
+                joined = True
             except RPCError as e:
                 self.logger.warning("cluster: start_join %s failed: %s", addr, e)
+        # Seed the Raft peer table from the gossip view as of startup;
+        # later membership moves only via committed _config entries.
+        self.raft.config.peers.update(self.cluster.peers)
+        if not joined:
+            threading.Thread(
+                target=self._retry_join_loop, daemon=True,
+                name=f"retry-join-{self.cluster.node_id}",
+            ).start()
+        threading.Thread(
+            target=self._membership_loop, daemon=True,
+            name=f"membership-{self.cluster.node_id}",
+        ).start()
         self.raft.start()
         self.plan_applier.start()
         from nomad_tpu.server.worker import Worker
@@ -398,6 +433,134 @@ class ClusterServer(Server):
 
     # -- membership (serf-lite; reference: nomad/serf.go + hashicorp/serf) ----
 
+    def _retry_join_loop(self) -> None:
+        """Keep retrying start_join until one address answers
+        (command/agent/command.go retry-join)."""
+        while not self._periodic_stop.is_set():
+            self._periodic_stop.wait(self.cluster.retry_join_interval)
+            if self._periodic_stop.is_set():
+                return
+            for addr in self.cluster.start_join:
+                try:
+                    n = self.join(addr)
+                    self.logger.info(
+                        "cluster: retry-join reached %d peers via %s", n, addr
+                    )
+                    return
+                except RPCError:
+                    continue
+
+    def _membership_loop(self) -> None:
+        """Failure detector + leader reconciliation (serf.go:136-194 member
+        probing -> nodeFailed; leader.go:263-343 reconcile)."""
+        leaderless_since = None
+        while not self._periodic_stop.is_set():
+            self._periodic_stop.wait(self.cluster.probe_interval)
+            if self._periodic_stop.is_set():
+                return
+            try:
+                self._probe_members()
+                if self.raft.is_leader:
+                    leaderless_since = None
+                    self._reconcile_membership()
+                elif self.raft.leader_addr:
+                    leaderless_since = None
+                else:
+                    # No leader known. A server that was removed while
+                    # partitioned (it never saw its own removal commit and
+                    # members ignore its votes) self-heals here: re-join
+                    # through gossip so the leader's reconciliation re-adds
+                    # it to the Raft configuration.
+                    import time as _time
+
+                    now = _time.monotonic()
+                    if leaderless_since is None:
+                        leaderless_since = now
+                    elif now - leaderless_since > max(
+                        5 * self.cluster.probe_interval, 3.0
+                    ):
+                        leaderless_since = now
+                        self._rejoin_any_member()
+            except Exception:  # pragma: no cover - keep the loop alive
+                self.logger.exception("cluster: membership pass failed")
+
+    def _rejoin_any_member(self) -> None:
+        for pid, addr in list(self.cluster.peers.items()):
+            if pid == self.cluster.node_id:
+                continue
+            if self._member_status.get(pid) == "failed":
+                continue
+            try:
+                self.join(addr)
+                self.logger.info(
+                    "cluster: leaderless; re-announced to %s via gossip", pid
+                )
+                return
+            except (RPCError, RemoteError):
+                continue
+
+    def _probe_members(self) -> None:
+        for pid, addr in list(self.cluster.peers.items()):
+            if pid == self.cluster.node_id:
+                continue
+            try:
+                self.pool.call(
+                    addr, "Status.Ping", {},
+                    timeout=self.cluster.probe_timeout,
+                )
+            except (RPCError, RemoteError):
+                n = self._probe_failures.get(pid, 0) + 1
+                self._probe_failures[pid] = n
+                if (n >= self.cluster.suspicion_threshold
+                        and self._member_status.get(pid) != "failed"):
+                    self._member_status[pid] = "failed"
+                    self.logger.warning(
+                        "cluster: member %s failed (%d missed probes)",
+                        pid, n,
+                    )
+            else:
+                self._probe_failures.pop(pid, None)
+                if self._member_status.get(pid) == "failed":
+                    self.logger.info("cluster: member %s recovered", pid)
+                self._member_status[pid] = "alive"
+
+    def _reconcile_membership(self) -> None:
+        """Leader-only: converge the Raft configuration with the gossip
+        member table, one committed change at a time (leader.go:263-343;
+        Raft single-server membership change)."""
+        raft_peers = dict(self.raft.config.peers)
+        # Members known to gossip but absent from Raft: add (nodeJoin ->
+        # addRaftPeer, serf.go:76-134).
+        for pid, addr in list(self.cluster.peers.items()):
+            if pid in raft_peers or self._member_status.get(pid) == "failed":
+                continue
+            try:
+                self.raft.add_peer(pid, addr).result(2.0)
+                self.logger.info("cluster: added raft peer %s", pid)
+            except Exception as e:
+                self.logger.debug("cluster: add_peer %s deferred: %s", pid, e)
+                return
+        # Failed members still in Raft: remove and reap from the member
+        # table (nodeFailed -> removeRaftPeer, serf.go:136-194).
+        for pid in list(raft_peers):
+            if pid == self.cluster.node_id:
+                continue
+            if self._member_status.get(pid) != "failed":
+                continue
+            try:
+                self.raft.remove_peer(pid).result(2.0)
+            except Exception as e:
+                self.logger.debug(
+                    "cluster: remove_peer %s deferred: %s", pid, e
+                )
+                return
+            self.cluster.peers.pop(pid, None)
+            self.logger.warning(
+                "cluster: reaped failed member %s (now %d members)",
+                pid, len(self.cluster.peers),
+            )
+            self._broadcast_peers()
+
     def join(self, addr: str) -> int:
         """Join an existing cluster member at ``addr`` (serf gossip join →
         nodeJoin → Raft peer add, serf.go:76-134). Joining a server of
@@ -421,8 +584,18 @@ class ClusterServer(Server):
 
     def force_leave(self, node_id: str) -> None:
         """Remove a member and broadcast the removal (serf.go nodeFailed /
-        server-force-leave)."""
+        server-force-leave). Marks the member failed so the leader's
+        reconciliation also drops it from the Raft configuration."""
         self.cluster.peers.pop(node_id, None)
+        self._member_status[node_id] = "failed"
+        if self.raft.is_leader and node_id in self.raft.config.peers:
+            try:
+                self.raft.remove_peer(node_id).result(2.0)
+            except Exception as e:
+                self.logger.warning(
+                    "cluster: force-leave raft removal of %s deferred: %s",
+                    node_id, e,
+                )
         self._broadcast_peers()
 
     def members(self):
@@ -430,7 +603,7 @@ class ClusterServer(Server):
             {
                 "name": pid,
                 "addr": addr,
-                "status": "alive",
+                "status": self._member_status.get(pid, "alive"),
                 "leader": addr == self.raft.leader_addr,
             }
             for pid, addr in sorted(self.cluster.peers.items())
@@ -443,6 +616,10 @@ class ClusterServer(Server):
             self.logger.info(
                 "cluster: peer set now %s", sorted(self.cluster.peers)
             )
+            # Pre-bootstrap, discovered members seed Raft directly so the
+            # first election can reach bootstrap_expect (maybeBootstrap);
+            # afterwards the leader commits the additions.
+            self.raft.seed_peers(dict(self.cluster.peers))
 
     def _merge_region_peers(self, regions: Dict[str, Dict[str, str]]) -> None:
         for region, members in regions.items():

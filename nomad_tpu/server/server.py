@@ -181,6 +181,18 @@ class Server:
             telemetry.set_gauge(
                 ("heartbeat", "active"), self.heartbeat.num_timers()
             )
+            solver = self.solver_stats()
+            device = solver.get("device", {})
+            # probe state as a numeric gauge: 1 ready / 0 probing-unprobed /
+            # -1 down — alertable without string handling
+            state_num = {"ready": 1, "down": -1}.get(
+                str(device.get("status")), 0
+            )
+            telemetry.set_gauge(("scheduler", "device", "state"), state_num)
+            telemetry.set_gauge(
+                ("scheduler", "device", "fallbacks"),
+                float(device.get("fallbacks", 0)),
+            )
 
     def restore_eval_broker(self) -> None:
         """Re-enqueue non-terminal evals after (re)gaining leadership
@@ -511,4 +523,33 @@ class Server:
             "broker_blocked": broker.total_blocked,
             "plan_queue_depth": self.plan_queue.depth(),
             "heartbeat_timers": self.heartbeat.num_timers(),
+            "scheduler": self.solver_stats(),
         }
+
+    @staticmethod
+    def solver_stats() -> Dict:
+        """Device-solver health: probe state + host-fallback count, the
+        coalescer's dispatch/batch counters, and the mirror-cache hit rate.
+        Surfaced through Stats()/agent-info so a silently-degraded device
+        path (host fallback: same placements, order-of-magnitude latency
+        cliff) is operator-visible. Metrics posture mirrors the
+        reference's broker stats (nomad/eval_broker.go:557-575)."""
+        from nomad_tpu.scheduler import device_probe_status
+
+        out: Dict = {"device": device_probe_status()}
+        try:
+            import sys
+
+            coalesce = sys.modules.get("nomad_tpu.ops.coalesce")
+            mirror = sys.modules.get("nomad_tpu.tpu.mirror")
+            if coalesce is not None:
+                eng = coalesce.GLOBAL_SOLVER
+                out["coalesce_dispatches"] = eng.dispatches
+                out["coalesce_batched_evals"] = eng.coalesced
+            if mirror is not None:
+                cache = mirror.GLOBAL_MIRROR_CACHE
+                out["mirror_cache_hits"] = cache.hits
+                out["mirror_cache_misses"] = cache.misses
+        except Exception:  # stats must never break agent-info
+            pass
+        return out

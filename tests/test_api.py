@@ -52,6 +52,13 @@ def test_agent_self(client, agent):
     assert client.status().leader() == agent.http.addr
     members = client.agent().members()
     assert len(members) == 1 and members[0]["leader"]
+    # Device-solver health is operator-visible (silent host fallback is a
+    # latency cliff): probe state + fallback count ride agent-info.
+    solver = info["stats"]["server"]["scheduler"]
+    assert solver["device"]["status"] in (
+        "unprobed", "probing", "ready", "down"
+    )
+    assert "fallbacks" in solver["device"]
 
 
 def test_job_lifecycle_over_http(client, agent):

@@ -1,0 +1,185 @@
+"""Gossip-style membership: failure detection, member reap, dynamic Raft
+peers, runtime joins.
+
+Reference: /root/reference/nomad/serf.go:76-194 (nodeJoin -> peer add,
+memberFailed -> peer removal) and nomad/leader.go:263-343 (leader
+reconciliation of Serf members vs Raft peers). Here the member table is a
+serf-lite gossip layer (Serf.Join / Serf.PeerUpdate RPCs + probing), and
+Raft membership moves via committed single-server _config entries.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server import ServerConfig
+from nomad_tpu.server.cluster import (
+    ClusterConfig,
+    ClusterServer,
+    form_cluster,
+    wait_for_leader,
+)
+
+
+def _fast_cluster_cfg(**kw):
+    return ClusterConfig(
+        probe_interval=0.1, probe_timeout=0.25, suspicion_threshold=2, **kw
+    )
+
+
+def _host_cfg():
+    return ServerConfig(
+        scheduler_backend="host", num_schedulers=1, min_heartbeat_ttl=30.0,
+    )
+
+
+def _wait(predicate, timeout=40.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_dead_follower_is_detected_evicted_and_quorum_updates():
+    """Kill a follower: probes fail, the member is marked failed, the
+    leader commits its removal from the Raft configuration and reaps it
+    from the member table — and the 2-server remainder still commits
+    writes (quorum math updated)."""
+    servers = form_cluster(3, _host_cfg(), _fast_cluster_cfg())
+    try:
+        leader = wait_for_leader(servers, timeout=30.0)
+        _wait(
+            lambda: all(len(s.raft.config.peers) == 3 for s in servers),
+            msg="full raft membership",
+        )
+        victim = next(s for s in servers if s is not leader)
+        victim_id = victim.cluster.node_id
+        victim.shutdown()
+
+        _wait(
+            lambda: victim_id not in leader.raft.config.peers,
+            msg="raft peer eviction",
+        )
+        _wait(
+            lambda: victim_id not in leader.cluster.peers,
+            msg="member table reap",
+        )
+        assert len(leader.raft.config.peers) == 2
+
+        # Writes still commit: quorum is now 2 of 2, not 2 of 3 blocked
+        # on a ghost member.
+        leader.node_register(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        eval_id, _ = leader.job_register(job)
+        ev = leader.wait_for_eval(eval_id, timeout=15.0)
+        assert ev.status == structs.EVAL_STATUS_COMPLETE
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_server_added_at_runtime_replicates_and_can_win_election():
+    """Join a server to a live cluster: gossip spreads it, the leader
+    commits the Raft peer addition, the newcomer replicates history, and
+    after the old leader dies the cluster re-elects among the remainder —
+    the added server fully participating."""
+    servers = form_cluster(2, _host_cfg(), _fast_cluster_cfg())
+    extra = None
+    try:
+        leader = wait_for_leader(servers, timeout=30.0)
+        leader.node_register(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        eval_id, _ = leader.job_register(job)
+        leader.wait_for_eval(eval_id, timeout=15.0)
+
+        # A third server joins at runtime via start_join.
+        cfg = _host_cfg()
+        cfg.node_name = "server-late"
+        cluster_cfg = _fast_cluster_cfg(
+            node_id="server-late",
+            start_join=[leader.rpc_addr],
+        )
+        extra = ClusterServer(cfg, cluster_cfg)
+        extra.start()
+
+        _wait(
+            lambda: "server-late" in leader.raft.config.peers,
+            msg="leader committed the peer addition",
+        )
+        _wait(
+            lambda: extra.raft.applied_index >= leader.raft.applied_index
+            and len(extra.raft.config.peers) == 3,
+            msg="newcomer caught up",
+        )
+        assert extra.state_store.job_by_id(job.id) is not None
+        assert len(extra.state_store.allocs_by_job(job.id)) == 2
+
+        # Old leader dies; the remaining two (incl. the newcomer) hold
+        # quorum 2-of-3 and elect a new leader; the dead one is evicted.
+        old_leader_id = leader.cluster.node_id
+        leader.shutdown()
+        remaining = [s for s in servers if s is not leader] + [extra]
+        new_leader = wait_for_leader(remaining, timeout=40.0)
+        _wait(
+            lambda: old_leader_id not in new_leader.raft.config.peers,
+            msg="dead leader evicted",
+        )
+        # The cluster keeps working — and if the newcomer won, it is fully
+        # in charge.
+        job2 = mock.job()
+        job2.task_groups[0].count = 1
+        eval_id2, _ = new_leader.job_register(job2)
+        ev2 = new_leader.wait_for_eval(eval_id2, timeout=15.0)
+        assert ev2.status == structs.EVAL_STATUS_COMPLETE
+    finally:
+        for s in servers:
+            s.shutdown()
+        if extra is not None:
+            extra.shutdown()
+
+
+def test_recovered_member_is_not_reaped():
+    """A member that misses probes transiently (below the suspicion
+    threshold) is never marked failed; one marked alive again after
+    recovery stays in the member table."""
+    servers = form_cluster(2, _host_cfg(), _fast_cluster_cfg())
+    try:
+        leader = wait_for_leader(servers, timeout=30.0)
+        other = next(s for s in servers if s is not leader)
+        # Simulate one missed probe: below threshold=2
+        leader._probe_failures[other.cluster.node_id] = 1
+        time.sleep(0.5)
+        assert leader._member_status.get(
+            other.cluster.node_id, "alive"
+        ) == "alive"
+        assert other.cluster.node_id in leader.raft.config.peers
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_force_leave_removes_member_and_raft_peer():
+    servers = form_cluster(3, _host_cfg(), _fast_cluster_cfg())
+    try:
+        leader = wait_for_leader(servers, timeout=30.0)
+        _wait(
+            lambda: all(len(s.raft.config.peers) == 3 for s in servers),
+            msg="full raft membership",
+        )
+        victim = next(s for s in servers if s is not leader)
+        victim_id = victim.cluster.node_id
+        victim.shutdown()
+        leader.force_leave(victim_id)
+        assert victim_id not in leader.cluster.peers
+        _wait(
+            lambda: victim_id not in leader.raft.config.peers,
+            msg="raft removal after force-leave",
+        )
+    finally:
+        for s in servers:
+            s.shutdown()
