@@ -74,134 +74,146 @@ def new_scheduler(
 
 
 # ---------------------------------------------------------------------------
-# Device probe.
+# Device acquisition.
 #
 # The TPU factories live behind a lazy import so the control plane can run
 # host-only (e.g. on machines without jax). If the device backend cannot
 # initialize — or hangs (a wedged remote-device tunnel blocks inside
 # jax.devices() indefinitely) — fall back to the host solver instead of
-# wedging every worker thread: same placements, scalar speed. Unavailability
-# is re-probed after a cooldown so a recovered device comes back without a
-# restart.
+# wedging every worker thread: same placements, scalar speed.
 #
-# The probe runs on its own daemon thread. The caller that *starts* a probe
-# waits up to PROBE_TIMEOUT for it; every concurrent caller sees "probing"
-# and falls back to the host solver immediately rather than queueing on a
-# lock (a cold tunneled-device jax.devices() can take minutes). A probe that
-# outlives the timeout keeps running — if the device eventually comes up,
-# the next eval uses it.
+# Acquisition is subprocess-isolated (nomad_tpu/scheduler/device_probe.py):
+# jax backend init is process-global and single-shot, so an in-process retry
+# of a wedged jax.devices() can never succeed — it just queues on the same
+# init lock. A single manager thread therefore probes in killable CHILD
+# processes, and only after a child proves the claim completes does the
+# manager initialize jax in this process and flip the state to ready. The
+# child's staged reports (relay reachability → import → claim → smoke) ride
+# device_probe_status() so "relay unreachable" is distinguishable from
+# "claim pending" and from a framework bug.
 
 import os as _os
 import threading as _threading
 import time as _time
 
+# Grace the FIRST caller gives the manager before falling back to the host
+# solver (single-threaded flows — tests, dev agents — stay on the device
+# path without a warm-up blip; concurrent callers never block).
 PROBE_TIMEOUT = float(_os.environ.get("NOMAD_TPU_PROBE_TIMEOUT", "120"))
+# Backoff between child probes when the backend fails fast (hard-down).
 PROBE_RETRY = float(_os.environ.get("NOMAD_TPU_PROBE_RETRY", "60"))
 
 _probe_lock = _threading.Lock()
-# status: unprobed | probing | ready | down. "done" is the completion event
-# of the CURRENT probe generation — never reused across generations, so a
-# superseded wedged probe finally exiting can't wake waiters on its
-# replacement.
-_probe_state: Dict[str, object] = {"status": "unprobed", "fallbacks": 0,
-                                   "generation": 0,
-                                   "done": _threading.Event()}
+# status: unprobed | probing | ready | down. "ready_event" is set exactly
+# once, when the solver becomes available. "phase" narrows "probing":
+# child-probe (killable subprocess running) vs init (in-process jax init
+# after a child success — if THIS wedges despite child proof, the status
+# shows it, which is its own diagnostic).
+_probe_state: Dict[str, object] = {
+    "status": "unprobed",
+    "fallbacks": 0,
+    "attempts": 0,
+    "phase": None,
+    "ready_event": _threading.Event(),
+    "manager_started": False,
+}
 
 
-def _start_probe_locked(logger: logging.Logger) -> None:
-    """Kick off the async device probe. Caller holds ``_probe_lock``.
+def _manager_loop(logger: logging.Logger) -> None:
+    """Probe in fresh child processes until the device is claimable, then
+    initialize jax in-process and publish the solver. Runs forever (daemon)
+    until ready — a device that comes up an hour in is still picked up."""
+    from nomad_tpu.scheduler import device_probe
 
-    Probes are generation-tagged: a stale probe (superseded after it
-    wedged past its deadline) may still flip the state to ready — the
-    device coming up is good news from any generation — but only the
-    current generation may mark it down, so a late failure can't clobber
-    a newer probe's in-flight state.
-    """
-    gen = int(_probe_state["generation"]) + 1
-    _probe_state["generation"] = gen
-    _probe_state["status"] = "probing"
-    _probe_state["started_at"] = _time.monotonic()
-    done = _threading.Event()
-    _probe_state["done"] = done
+    while True:
+        with _probe_lock:
+            _probe_state["status"] = "probing"
+            _probe_state["phase"] = "child-probe"
+            _probe_state["attempts"] = int(_probe_state["attempts"]) + 1
+            _probe_state["started_at"] = _time.monotonic()
+        report = device_probe.probe_once()
+        with _probe_lock:
+            _probe_state["child"] = report.summary()
+        if report.ok:
+            with _probe_lock:
+                _probe_state["phase"] = "init"
+                _probe_state["init_started_at"] = _time.monotonic()
+            try:
+                import jax
 
-    def probe():
-        try:
-            import jax
-
-            jax.devices()
-            from nomad_tpu.tpu import solver
-
+                jax.devices()
+                from nomad_tpu.tpu import solver
+            except Exception as e:
+                # In-process init failed even though a child succeeded —
+                # report and retry; the distinction is preserved in "phase".
+                with _probe_lock:
+                    _probe_state["status"] = "down"
+                    _probe_state["error"] = (
+                        f"in-process init failed after child probe ok: "
+                        f"{type(e).__name__}: {e}"
+                    )
+                logger.warning(
+                    "jax in-process init failed after successful child "
+                    "probe (%s); retrying in %.0fs", e, PROBE_RETRY)
+                _time.sleep(PROBE_RETRY)
+                continue
             with _probe_lock:
                 _probe_state["status"] = "ready"
+                _probe_state["phase"] = None
                 _probe_state["solver"] = solver
                 _probe_state["backend"] = jax.default_backend()
                 _probe_state.pop("error", None)
-        except Exception as e:  # device backend truly unavailable
-            with _probe_lock:
-                if (_probe_state["generation"] == gen
-                        and _probe_state["status"] == "probing"):
-                    _probe_state["status"] = "down"
-                    _probe_state["error"] = f"{type(e).__name__}: {e}"
-                    _probe_state["retry_at"] = _time.monotonic() + PROBE_RETRY
+                _probe_state["ready_event"].set()
+            logger.info("device solver ready (backend=%s)",
+                        jax.default_backend())
+            return
+        with _probe_lock:
+            _probe_state["status"] = "down"
+            _probe_state["phase"] = None
+            _probe_state["error"] = report.error
+        if report.killed:
+            # Wedged/slow claim: the fresh child IS the retry; go again
+            # immediately — each attempt already costs a full child timeout.
             logger.warning(
-                "jax device backend unavailable (%s); TPU factories fall "
-                "back to the host scheduler for %.0fs", e, PROBE_RETRY,
-            )
-        finally:
-            done.set()
+                "device probe child killed at stage '%s' after %.0fs; "
+                "retrying in a fresh child", report.last_stage,
+                report.elapsed_s)
+        else:
+            logger.warning(
+                "device backend unavailable (%s); TPU factories fall back "
+                "to the host scheduler; next probe in %.0fs",
+                report.error, PROBE_RETRY)
+            _time.sleep(PROBE_RETRY)
 
-    _threading.Thread(target=probe, daemon=True,
-                      name=f"tpu-device-probe-{gen}").start()
 
-
-def _probe_is_stale_locked() -> bool:
-    """True when the in-flight probe has been wedged long past its grace
-    window and a fresh probe should replace it (a recovered tunnel may not
-    unblock the original stuck jax.devices() call)."""
-    return (
-        _probe_state["status"] == "probing"
-        and _time.monotonic() - float(_probe_state.get("started_at", 0))
-        > PROBE_TIMEOUT + PROBE_RETRY
-    )
+def _ensure_manager(logger: logging.Logger) -> bool:
+    """Start the acquisition manager if it isn't running. Returns True when
+    this call started it (the starter gets the PROBE_TIMEOUT grace)."""
+    with _probe_lock:
+        if _probe_state["manager_started"]:
+            return False
+        _probe_state["manager_started"] = True
+        _probe_state["status"] = "probing"
+        _probe_state["phase"] = "child-probe"
+        _probe_state["started_at"] = _time.monotonic()
+    _threading.Thread(target=_manager_loop, args=(logger,), daemon=True,
+                      name="tpu-device-acquire").start()
+    return True
 
 
 def _tpu_solver(logger: logging.Logger):
     """The device solver module, or None while the device path is
-    unavailable (host fallback; retried after a cooldown)."""
-    started = False
-    with _probe_lock:
-        st = _probe_state["status"]
-        if st == "ready":
-            return _probe_state["solver"]
-        if (
-            st == "unprobed"
-            or (st == "down"
-                and _time.monotonic() >= _probe_state.get("retry_at", 0))
-            or _probe_is_stale_locked()
-        ):
-            _start_probe_locked(logger)
-            started = True
-        _probe_state["fallbacks"] = int(_probe_state["fallbacks"]) + (
-            0 if started else 1
-        )
-        done = _probe_state["done"]
-    if not started:
-        # A probe is in flight (or the device is in its down-cooldown):
-        # fall back without blocking behind the prober.
-        return None
-    # The caller that started the probe gives it one timeout's grace —
-    # this keeps single-threaded flows (tests, dev agents) on the device
-    # path without a warm-up blip, while peers fall back concurrently.
-    done.wait(PROBE_TIMEOUT)
+    unavailable (host fallback; the manager keeps probing)."""
     with _probe_lock:
         if _probe_state["status"] == "ready":
             return _probe_state["solver"]
-        if _probe_state["status"] == "probing":
-            logger.warning(
-                "jax device probe still running after %.0fs; TPU factories "
-                "fall back to the host scheduler until it completes",
-                PROBE_TIMEOUT,
-            )
+        ready = _probe_state["ready_event"]
+    if _ensure_manager(logger):
+        # The caller that started acquisition gives it one timeout's grace.
+        ready.wait(PROBE_TIMEOUT)
+    with _probe_lock:
+        if _probe_state["status"] == "ready":
+            return _probe_state["solver"]
         _probe_state["fallbacks"] = int(_probe_state["fallbacks"]) + 1
         return None
 
@@ -212,50 +224,32 @@ def wait_for_device(timeout: float = 600.0,
 
     For callers that *require* the device — the bench harness, explicit
     health checks — rather than preferring graceful fallback. Returns the
-    solver module or None. Honors the down-state retry cooldown (so a
-    fast-failing backend is re-probed every PROBE_RETRY, not hot-looped)
-    and replaces wedged probes once they exceed their grace window.
+    solver module or None; on None, ``device_probe_status()`` carries the
+    forensic trail (relay reachability, last acquisition stage, kill
+    count) of why.
     """
     log = logger or logging.getLogger("nomad_tpu.sched")
-    deadline = _time.monotonic() + timeout
-    while True:
-        sleep_until = None
-        with _probe_lock:
-            st = _probe_state["status"]
-            if st == "ready":
-                return _probe_state["solver"]
-            if st == "unprobed":
-                _start_probe_locked(log)
-            elif st == "down":
-                retry_at = float(_probe_state.get("retry_at", 0))
-                if _time.monotonic() >= retry_at:
-                    _start_probe_locked(log)
-                else:
-                    sleep_until = retry_at
-            elif _probe_is_stale_locked():
-                _start_probe_locked(log)
-            done = _probe_state["done"]
-        now = _time.monotonic()
-        remaining = deadline - now
-        if remaining <= 0:
-            return None
-        wait = min(remaining, 1.0)
-        if sleep_until is not None:
-            wait = min(remaining, max(sleep_until - now, 0.05))
-            _time.sleep(wait)  # down-cooldown: the probe event is long set
-        else:
-            done.wait(wait)
+    _ensure_manager(log)
+    with _probe_lock:
+        ready = _probe_state["ready_event"]
+    ready.wait(timeout)
+    with _probe_lock:
+        if _probe_state["status"] == "ready":
+            return _probe_state["solver"]
+        return None
 
 
 def device_probe_status() -> Dict[str, object]:
-    """Snapshot of the device-probe state for Stats()/agent-info."""
+    """Snapshot of the device-acquisition state for Stats()/agent-info,
+    including the last child probe's staged diagnostics."""
     with _probe_lock:
         out = {
             "status": _probe_state["status"],
             "fallbacks": int(_probe_state["fallbacks"]),
+            "attempts": int(_probe_state["attempts"]),
         }
-        for k in ("backend", "error"):
-            if k in _probe_state:
+        for k in ("backend", "error", "phase", "child"):
+            if _probe_state.get(k) is not None:
                 out[k] = _probe_state[k]
         if _probe_state["status"] == "probing":
             out["probing_for_s"] = round(

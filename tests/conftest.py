@@ -10,6 +10,10 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Child processes (device probes, forked servers) must claim the cpu backend
+# too — the image's sitecustomize pins the axon platform regardless of
+# JAX_PLATFORMS, so the probe child honors this explicit re-pin knob.
+os.environ["NOMAD_TPU_PROBE_FORCE_CPU"] = "1"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
