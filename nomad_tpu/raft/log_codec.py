@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 from nomad_tpu.api.codec import from_dict, to_dict
-from nomad_tpu.structs import Allocation, Evaluation, Job, Node
+from nomad_tpu.structs import AllocBatch, Allocation, Evaluation, Job, Node
 
 # msg_type -> {payload_field: element_dataclass or None for plain values}
 _SCHEMAS: Dict[str, Dict[str, Any]] = {
@@ -23,13 +23,21 @@ _SCHEMAS: Dict[str, Dict[str, Any]] = {
     "job_deregister": {"job_id": None},
     "eval_update": {"evals": [Evaluation]},
     "eval_delete": {"evals": None, "allocs": None},
-    "alloc_update": {"allocs": [Allocation]},
+    "alloc_update": {"allocs": [Allocation], "alloc_batches": "blocks"},
     "alloc_client_update": {"allocs": [Allocation]},
 }
 
 
 def encode_payload(msg_type: str, payload: dict) -> dict:
-    return {k: to_dict(v) for k, v in payload.items()}
+    out = {}
+    for k, v in payload.items():
+        if _SCHEMAS.get(msg_type, {}).get(k) == "blocks":
+            # Columnar batches carry their own compact wire form — runs +
+            # one hex id block, never per-Allocation rows.
+            out[k] = [b.to_wire() for b in v]
+        else:
+            out[k] = to_dict(v)
+    return out
 
 
 def decode_payload(msg_type: str, payload: dict) -> dict:
@@ -41,6 +49,10 @@ def decode_payload(msg_type: str, payload: dict) -> dict:
         spec = schema.get(key)
         if spec is None:
             out[key] = value
+        elif spec == "blocks":
+            # Decode to plain batches; the FSM stamps indexes and the
+            # deterministic block id at upsert (state/blocks.py from_batch).
+            out[key] = [AllocBatch.from_wire(v) for v in value]
         elif isinstance(spec, list):
             out[key] = [from_dict(spec[0], v) for v in value]
         else:

@@ -60,3 +60,16 @@ class EvalContext:
         update = self._plan.node_update.get(node_id, [])
         proposed = remove_allocs(existing, update) if update else existing
         return proposed + self._plan.node_allocation.get(node_id, [])
+
+    def proposed_allocs_objects(self, node_id: str) -> List[Allocation]:
+        """``proposed_allocs`` over the object table only. Callers that
+        account stored columnar blocks separately (the device mirror's
+        usage tensorization) use this to avoid per-node materialization; a
+        state without the split view falls back to the full one."""
+        getter = getattr(self._state, "allocs_by_node_objects", None)
+        if getter is None:
+            return self.proposed_allocs(node_id)
+        existing = filter_terminal_allocs(getter(node_id))
+        update = self._plan.node_update.get(node_id, [])
+        proposed = remove_allocs(existing, update) if update else existing
+        return proposed + self._plan.node_allocation.get(node_id, [])

@@ -93,7 +93,14 @@ class FSM:
         self.state.delete_eval(index, payload["evals"], payload["allocs"])
 
     def _apply_alloc_update(self, index: int, payload: dict) -> None:
-        self.state.upsert_allocs(index, payload["allocs"])
+        allocs = payload.get("allocs") or []
+        if allocs:
+            self.state.upsert_allocs(index, allocs)
+        # Columnar placements commit as stored blocks — O(node runs), no
+        # per-Allocation expansion (state/blocks.py).
+        batches = payload.get("alloc_batches") or []
+        if batches:
+            self.state.upsert_alloc_blocks(index, batches)
 
     def _apply_alloc_client_update(self, index: int, payload: dict) -> None:
         for alloc in payload["allocs"]:
@@ -119,7 +126,10 @@ class FSM:
             "nodes": snap.nodes(),
             "jobs": snap.jobs(),
             "evals": snap.evals(),
-            "allocs": snap.allocs(),
+            # Object rows and columnar blocks persist in their native forms:
+            # a 100k-placement block snapshots as its runs, not 100k rows.
+            "allocs": snap.allocs_objects(),
+            "blocks": snap.alloc_blocks(),
             "indexes": {
                 t: snap.get_index(t) for t in ("nodes", "jobs", "evals", "allocs")
             },
@@ -140,6 +150,8 @@ class FSM:
             restore.eval_restore(ev)
         for alloc in payload["allocs"]:
             restore.alloc_restore(alloc)
+        for block in payload.get("blocks", []):
+            restore.block_restore(block)
         for table, index in payload["indexes"].items():
             restore.index_restore(table, index)
         restore.commit()

@@ -71,6 +71,33 @@ def _res_vec(res) -> "np.ndarray":
     return np.array(res.as_vector(), dtype=np.int64)
 
 
+def _existing_block_usage(snap):
+    """Per-node usage of stored columnar blocks: {node_id: int64[4]}, plus
+    the set of nodes whose blocks carry network asks (those fall back to
+    the scalar path). O(runs), no materialization."""
+    import numpy as np
+
+    usage = {}
+    net_nodes = set()
+    getter = getattr(snap, "alloc_blocks", None)
+    blocks = getter() if getter is not None else []
+    for blk in blocks:
+        has_net = bool(blk.resources is not None and blk.resources.networks)
+        if not has_net and blk.task_resources:
+            has_net = any(
+                tr is not None and tr.networks
+                for tr in blk.task_resources.values()
+            )
+        if has_net:
+            net_nodes.update(nid for nid, _ in blk.live_node_counts())
+            continue
+        vec = np.asarray(blk.resource_vector(), dtype=np.int64)
+        for nid, cnt in blk.live_node_counts():
+            prev = usage.get(nid)
+            usage[nid] = vec * cnt if prev is None else prev + vec * cnt
+    return usage, net_nodes, blocks
+
+
 def _prevaluate_nodes_bulk(snap, plan: Plan, batch_ask=None):
     """Bulk-verify the network-free nodes of a large plan with the native
     kernels (nomad_tpu.native): one scatter-add of every placement's
@@ -90,6 +117,25 @@ def _prevaluate_nodes_bulk(snap, plan: Plan, batch_ask=None):
     out = {}
     ids = [nid for nid, placed in plan.node_allocation.items() if placed]
     ids.extend(nid for nid in batch_ask if nid not in plan.node_allocation)
+
+    # Existing usage held in columnar blocks, accounted without
+    # materialization; reads below then only walk the object table.
+    block_usage, block_net_nodes, blocks = _existing_block_usage(snap)
+    read_objects = getattr(snap, "allocs_by_node_objects", None)
+    if read_objects is None:
+        read_objects = snap.allocs_by_node
+        block_usage, block_net_nodes, blocks = {}, set(), []
+
+    def evicted_block_vec(nid):
+        """Resource sum of this plan's evictions that live in blocks (the
+        object walk below can't see them); stale eviction ids subtract
+        nothing."""
+        total = None
+        for a in plan.node_update.get(nid, ()):
+            if any(blk.find(a.id) is not None for blk in blocks):
+                vec = _res_vec(a.resources)
+                total = vec if total is None else total + vec
+        return total
 
     totals_rows = []
     base_rows = []
@@ -127,13 +173,22 @@ def _prevaluate_nodes_bulk(snap, plan: Plan, batch_ask=None):
             continue
         if node.reserved is not None and node.reserved.networks:
             continue  # reserved-port semantics: scalar path
+        if nid in block_net_nodes:
+            continue  # network-carrying block members: scalar path
         placements = plan.node_allocation.get(nid, ())
 
         base = _res_vec(node.reserved)
         extra = batch_ask.get(nid)
         if extra is not None:
             base = base + extra
-        existing = filter_terminal_allocs(snap.allocs_by_node(nid))
+        blk_used = block_usage.get(nid)
+        if blk_used is not None:
+            base = base + blk_used
+            if plan.node_update.get(nid):
+                evicted = evicted_block_vec(nid)
+                if evicted is not None:
+                    base = base - evicted
+        existing = filter_terminal_allocs(read_objects(nid))
         bail = False
         if existing:
             removed = {a.id for a in plan.node_update.get(nid, [])}
@@ -317,14 +372,15 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
     return result
 
 
-def _flatten_result(result: PlanResult) -> list:
+def _object_allocs(result: PlanResult) -> list:
+    """The object-row part of a committed plan. Columnar placement batches
+    stay columnar all the way into the state store (state/blocks.py);
+    update batches re-stamp existing rows and materialize here."""
     allocs: list = []
     for update_list in result.node_update.values():
         allocs.extend(update_list)
     for alloc_list in result.node_allocation.values():
         allocs.extend(alloc_list)
-    for batch in result.alloc_batches:
-        allocs.extend(batch.materialize())
     for batch in result.update_batches:
         allocs.extend(batch.materialize())
     allocs.extend(result.failed_allocs)
@@ -420,8 +476,11 @@ class PlanApplier(threading.Thread):
         """Dispatch the replicated alloc update + optimistic snapshot apply
         (plan_apply.go:119-144)."""
         t0 = time.perf_counter()
-        allocs = _flatten_result(result)
-        future = self.raft.apply("alloc_update", {"allocs": allocs})
+        allocs = _object_allocs(result)
+        payload = {"allocs": allocs}
+        if result.alloc_batches:
+            payload["alloc_batches"] = result.alloc_batches
+        future = self.raft.apply("alloc_update", payload)
         telemetry.measure_since(("plan", "submit"), t0)
         if snap is not None:
             # Stamp the optimistic snapshot with the entry's real index: with
@@ -433,7 +492,10 @@ class PlanApplier(threading.Thread):
                 idx = future.result()
             else:
                 idx = self.raft.applied_index + 1
-            snap.upsert_allocs(idx, allocs)
+            if allocs:
+                snap.upsert_allocs(idx, allocs)
+            if result.alloc_batches:
+                snap.upsert_alloc_blocks(idx, result.alloc_batches)
         return future
 
     def _async_plan_wait(self, wait_event, future, result, pending: PendingPlan):

@@ -22,7 +22,9 @@ from __future__ import annotations
 import threading
 from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
+from nomad_tpu.state.blocks import StoredAllocBlock
 from nomad_tpu.structs import (
+    AllocBatch,
     Allocation,
     Evaluation,
     Job,
@@ -115,11 +117,18 @@ class _Tables:
         self.jobs: Dict[str, Job] = {}
         self.evals: Dict[str, Evaluation] = {}
         self.allocs: Dict[str, Allocation] = {}
+        # Columnar allocation blocks (state/blocks.py): one row per
+        # (eval, task group) block instead of one per placement. Blocks are
+        # immutable — exclusion replaces the entry with a COW copy — so the
+        # snapshot container-copy below stays cheap and consistent.
+        self.blocks: Dict[str, StoredAllocBlock] = {}
         # Secondary indexes: id sets keyed by foreign key.
         self.evals_by_job: Dict[str, Set[str]] = {}
         self.allocs_by_job: Dict[str, Set[str]] = {}
         self.allocs_by_node: Dict[str, Set[str]] = {}
         self.allocs_by_eval: Dict[str, Set[str]] = {}
+        self.blocks_by_job: Dict[str, Set[str]] = {}
+        self.blocks_by_eval: Dict[str, Set[str]] = {}
 
     def copy(self) -> "_Tables":
         new = _Tables()
@@ -128,10 +137,13 @@ class _Tables:
         new.jobs = dict(self.jobs)
         new.evals = dict(self.evals)
         new.allocs = dict(self.allocs)
+        new.blocks = dict(self.blocks)
         new.evals_by_job = {k: set(v) for k, v in self.evals_by_job.items()}
         new.allocs_by_job = {k: set(v) for k, v in self.allocs_by_job.items()}
         new.allocs_by_node = {k: set(v) for k, v in self.allocs_by_node.items()}
         new.allocs_by_eval = {k: set(v) for k, v in self.allocs_by_eval.items()}
+        new.blocks_by_job = {k: set(v) for k, v in self.blocks_by_job.items()}
+        new.blocks_by_eval = {k: set(v) for k, v in self.blocks_by_eval.items()}
         return new
 
 
@@ -177,27 +189,64 @@ class _StateView:
     # -- allocs -----------------------------------------------------------
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
-        return self._t.allocs.get(alloc_id)
+        alloc = self._t.allocs.get(alloc_id)
+        if alloc is not None or not self._t.blocks:
+            return alloc
+        for blk in self._t.blocks.values():
+            pos = blk.find(alloc_id)
+            if pos is not None:
+                return blk.materialize_pos(pos)
+        return None
 
     def allocs(self) -> List[Allocation]:
-        return list(self._t.allocs.values())
+        out = list(self._t.allocs.values())
+        for blk in self._t.blocks.values():
+            out.extend(blk.materialize())
+        return out
 
     def alloc_count(self) -> int:
         """Cheap table cardinality (used by the solver's clean-state fast
         path to skip usage tensorization entirely)."""
-        return len(self._t.allocs)
+        return len(self._t.allocs) + sum(
+            blk.n_live for blk in self._t.blocks.values()
+        )
+
+    def alloc_blocks(self) -> List[StoredAllocBlock]:
+        """Live columnar blocks — the no-materialization read for plan
+        verification and the device mirror."""
+        return list(self._t.blocks.values())
+
+    def allocs_objects(self) -> List[Allocation]:
+        """Object-table rows only (the complement of alloc_blocks())."""
+        return list(self._t.allocs.values())
 
     def allocs_by_job(self, job_id: str) -> List[Allocation]:
         ids = self._t.allocs_by_job.get(job_id, set())
-        return [self._t.allocs[i] for i in ids]
+        out = [self._t.allocs[i] for i in ids]
+        for bid in self._t.blocks_by_job.get(job_id, ()):
+            out.extend(self._t.blocks[bid].materialize())
+        return out
 
     def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        out = self.allocs_by_node_objects(node_id)
+        for blk in self._t.blocks.values():
+            if blk.node_runs().get(node_id) is not None:
+                out = out + blk.materialize_node(node_id)
+        return out
+
+    def allocs_by_node_objects(self, node_id: str) -> List[Allocation]:
+        """Object-table rows only: callers that account block usage
+        columnar (plan verification, mirror) read this plus alloc_blocks()
+        instead of paying per-node materialization."""
         ids = self._t.allocs_by_node.get(node_id, set())
         return [self._t.allocs[i] for i in ids]
 
     def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
         ids = self._t.allocs_by_eval.get(eval_id, set())
-        return [self._t.allocs[i] for i in ids]
+        out = [self._t.allocs[i] for i in ids]
+        for bid in self._t.blocks_by_eval.get(eval_id, ()):
+            out.extend(self._t.blocks[bid].materialize())
+        return out
 
     # -- indexes ----------------------------------------------------------
 
@@ -231,6 +280,9 @@ class StateSnapshot(_StateView):
     # write-side helpers against the snapshot's private tables.
     def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
         _upsert_allocs(self._t, index, allocs)
+
+    def upsert_alloc_blocks(self, index: int, batches) -> None:
+        _upsert_alloc_blocks(self._t, index, batches)
 
 
 class StateRestore:
@@ -270,6 +322,15 @@ class StateRestore:
             t.indexes.get("allocs", 0), alloc.modify_index
         )
 
+    def block_restore(self, block: StoredAllocBlock) -> None:
+        t = self._tables
+        t.blocks[block.block_id] = block
+        t.blocks_by_job.setdefault(block.job_id, set()).add(block.block_id)
+        t.blocks_by_eval.setdefault(block.eval_id, set()).add(block.block_id)
+        t.indexes["allocs"] = max(
+            t.indexes.get("allocs", 0), block.modify_index
+        )
+
     def index_restore(self, table: str, index: int) -> None:
         self._tables.indexes[table] = index
 
@@ -277,11 +338,54 @@ class StateRestore:
         self._store._install(self._tables)
 
 
+def _find_block_member(t: _Tables, alloc_id: str):
+    """(block_id, pos) of a live block member, or None."""
+    for bid, blk in t.blocks.items():
+        pos = blk.find(alloc_id)
+        if pos is not None:
+            return bid, pos
+    return None
+
+
+def _exclude_block_members(t: _Tables, members: Dict[str, Set[int]]) -> None:
+    """Replace blocks with COW copies excluding ``members`` ({block_id:
+    positions}); blocks drained to zero live members are dropped."""
+    for bid, positions in members.items():
+        blk = t.blocks[bid].with_excluded(positions)
+        if blk.n_live == 0:
+            del t.blocks[bid]
+            for idx_map, key in ((t.blocks_by_job, blk.job_id),
+                                 (t.blocks_by_eval, blk.eval_id)):
+                ids = idx_map.get(key)
+                if ids is not None:
+                    ids.discard(bid)
+                    if not ids:
+                        del idx_map[key]
+        else:
+            t.blocks[bid] = blk
+
+
 def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
+    # An object row superseding a block member (eviction, re-placement,
+    # client-side restamp) promotes it out of the block.
+    if t.blocks:
+        members: Dict[str, Set[int]] = {}
+        for alloc in allocs:
+            if alloc.id in t.allocs:
+                continue
+            found = _find_block_member(t, alloc.id)
+            if found is not None:
+                bid, pos = found
+                members.setdefault(bid, set()).add(pos)
+                if alloc.create_index == 0:
+                    alloc.create_index = t.blocks[bid].create_index
+        if members:
+            _exclude_block_members(t, members)
     for alloc in allocs:
         existing = t.allocs.get(alloc.id)
         if existing is None:
-            alloc.create_index = index
+            if alloc.create_index == 0:
+                alloc.create_index = index
         else:
             alloc.create_index = existing.create_index
             # De-index under stale foreign keys if they changed.
@@ -297,6 +401,24 @@ def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
         t.allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
         t.allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
     t.indexes["allocs"] = index
+
+
+def _upsert_alloc_blocks(t: _Tables, index: int, batches) -> List[WatchItem]:
+    """Commit columnar batches as stored blocks — O(runs), no object
+    expansion. Returns the watch items to notify."""
+    items: List[WatchItem] = [item_table("allocs")]
+    for batch in batches:
+        if batch.n == 0:
+            continue
+        blk = StoredAllocBlock.from_batch(batch, index)
+        t.blocks[blk.block_id] = blk
+        t.blocks_by_job.setdefault(blk.job_id, set()).add(blk.block_id)
+        t.blocks_by_eval.setdefault(blk.eval_id, set()).add(blk.block_id)
+        items.append(item_alloc_job(blk.job_id))
+        items.append(item_alloc_eval(blk.eval_id))
+        items.extend(item_alloc_node(nid) for nid in blk.node_ids)
+    t.indexes["allocs"] = index
+    return items
 
 
 class StateStore(_StateView):
@@ -432,27 +554,61 @@ class StateStore(_StateView):
                         if not ids:
                             del t.evals_by_job[ev.job_id]
                     items.append(item_eval(eval_id))
+                # A reaped eval takes its columnar blocks with it wholesale.
+                for bid in list(t.blocks_by_eval.get(eval_id, ())):
+                    blk = t.blocks.pop(bid, None)
+                    if blk is None:
+                        continue
+                    ids = t.blocks_by_job.get(blk.job_id)
+                    if ids is not None:
+                        ids.discard(bid)
+                        if not ids:
+                            del t.blocks_by_job[blk.job_id]
+                    items.append(item_alloc_job(blk.job_id))
+                    items.append(item_alloc_eval(blk.eval_id))
+                    items.extend(item_alloc_node(n) for n in blk.node_ids)
+                t.blocks_by_eval.pop(eval_id, None)
+            block_members: Dict[str, Set[int]] = {}
             for alloc_id in alloc_ids:
                 alloc = t.allocs.pop(alloc_id, None)
-                if alloc is not None:
-                    for idx_map, key in (
-                        (t.allocs_by_job, alloc.job_id),
-                        (t.allocs_by_node, alloc.node_id),
-                        (t.allocs_by_eval, alloc.eval_id),
-                    ):
-                        ids = idx_map.get(key)
-                        if ids is not None:
-                            ids.discard(alloc_id)
-                            if not ids:
-                                del idx_map[key]
-                    items.extend(
-                        [
-                            item_alloc(alloc_id),
-                            item_alloc_job(alloc.job_id),
-                            item_alloc_node(alloc.node_id),
-                            item_alloc_eval(alloc.eval_id),
-                        ]
-                    )
+                if alloc is None:
+                    if t.blocks:
+                        found = _find_block_member(t, alloc_id)
+                        if found is not None:
+                            bid, pos = found
+                            block_members.setdefault(bid, set()).add(pos)
+                            # Watchers see block-member deletions exactly
+                            # like object-row deletions.
+                            blk = t.blocks[bid]
+                            items.extend(
+                                [
+                                    item_alloc(alloc_id),
+                                    item_alloc_job(blk.job_id),
+                                    item_alloc_node(blk.node_of_pos(pos)),
+                                    item_alloc_eval(blk.eval_id),
+                                ]
+                            )
+                    continue
+                for idx_map, key in (
+                    (t.allocs_by_job, alloc.job_id),
+                    (t.allocs_by_node, alloc.node_id),
+                    (t.allocs_by_eval, alloc.eval_id),
+                ):
+                    ids = idx_map.get(key)
+                    if ids is not None:
+                        ids.discard(alloc_id)
+                        if not ids:
+                            del idx_map[key]
+                items.extend(
+                    [
+                        item_alloc(alloc_id),
+                        item_alloc_job(alloc.job_id),
+                        item_alloc_node(alloc.node_id),
+                        item_alloc_eval(alloc.eval_id),
+                    ]
+                )
+            if block_members:
+                _exclude_block_members(t, block_members)
             t.indexes["evals"] = index
             t.indexes["allocs"] = index
         self.watch.notify(items)
@@ -474,11 +630,33 @@ class StateStore(_StateView):
                 )
         self.watch.notify(items)
 
+    def upsert_alloc_blocks(self, index: int, batches: List[AllocBatch]) -> None:
+        """Commit columnar placement batches natively (no per-Allocation
+        expansion); blocking queries on the touched nodes/job/eval fire."""
+        with self._lock:
+            items = _upsert_alloc_blocks(self._t, index, batches)
+        self.watch.notify(items)
+
     def update_alloc_from_client(self, index: int, alloc: Allocation) -> None:
         """Client status update: only client-side fields are trusted
-        (reference: state_store.go UpdateAllocFromClient)."""
+        (reference: state_store.go UpdateAllocFromClient). A block member
+        is promoted to an object row, since its status now diverges from
+        its block."""
         with self._lock:
             existing = self._t.allocs.get(alloc.id)
+            if existing is None and self._t.blocks:
+                found = _find_block_member(self._t, alloc.id)
+                if found is not None:
+                    bid, pos = found
+                    existing = self._t.blocks[bid].materialize_pos(pos)
+                    _exclude_block_members(self._t, {bid: {pos}})
+                    self._t.allocs[existing.id] = existing
+                    self._t.allocs_by_job.setdefault(
+                        existing.job_id, set()).add(existing.id)
+                    self._t.allocs_by_node.setdefault(
+                        existing.node_id, set()).add(existing.id)
+                    self._t.allocs_by_eval.setdefault(
+                        existing.eval_id, set()).add(existing.id)
             if existing is None:
                 raise KeyError(f"alloc not found: {alloc.id}")
             new = existing.copy()

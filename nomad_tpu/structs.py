@@ -767,11 +767,16 @@ class AllocBatch:
             name_idx=name_idx, ids_hex=ids_hex,
         )
 
-    def materialize(self) -> List["Allocation"]:
-        """Expand to Allocation objects (the FSM/state-boundary form)."""
+    # Stored-form overrides (state/blocks.py StoredAllocBlock): a plain
+    # batch has no commit indexes and no excluded members.
+    create_index = 0
+    modify_index = 0
+    excluded: frozenset = frozenset()
+
+    def _template(self) -> dict:
         job_name = self.job.name if self.job is not None else ""
         job_id = self.job.id if self.job is not None else ""
-        template = {
+        return {
             "id": "", "eval_id": self.eval_id, "name": "", "node_id": "",
             "job_id": job_id, "job": self.job, "task_group": self.tg_name,
             "resources": self.resources,
@@ -779,22 +784,40 @@ class AllocBatch:
             "desired_status": ALLOC_DESIRED_STATUS_RUN,
             "desired_description": "",
             "client_status": ALLOC_CLIENT_STATUS_PENDING,
-            "client_description": "", "create_index": 0, "modify_index": 0,
+            "client_description": "",
+            "create_index": self.create_index,
+            "modify_index": self.modify_index,
+            "_job_name": job_name,
         }
-        out: List[Allocation] = []
+
+    def _materialize_span(self, template: dict, node_id: str, start: int,
+                          end: int, out: List["Allocation"]) -> None:
+        """Expand positions [start, end) on one node, skipping excluded
+        members. The single template-and-expand implementation shared by
+        the wire batch and the stored block."""
         new = object.__new__
         copy_t = template.copy
+        prefix = f"{template['_job_name']}.{self.tg_name}["
+        excluded = self.excluded
+        for i in range(start, end):
+            if i in excluded:
+                continue
+            d = copy_t()
+            del d["_job_name"]
+            d["id"] = self.alloc_id(i)
+            d["name"] = f"{prefix}{self.name_idx[i]}]"
+            d["node_id"] = node_id
+            alloc = new(Allocation)
+            alloc.__dict__ = d
+            out.append(alloc)
+
+    def materialize(self) -> List["Allocation"]:
+        """Expand to Allocation objects (the FSM/state-boundary form)."""
+        out: List[Allocation] = []
+        template = self._template()
         pos = 0
-        prefix = f"{job_name}.{self.tg_name}["
         for nid, cnt in zip(self.node_ids, self.node_counts):
-            for i in range(pos, pos + cnt):
-                d = copy_t()
-                d["id"] = self.alloc_id(i)
-                d["name"] = f"{prefix}{self.name_idx[i]}]"
-                d["node_id"] = nid
-                alloc = new(Allocation)
-                alloc.__dict__ = d
-                out.append(alloc)
+            self._materialize_span(template, nid, pos, pos + cnt, out)
             pos += cnt
         return out
 

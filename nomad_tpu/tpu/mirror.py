@@ -187,13 +187,53 @@ class NodeMirror:
         job_count = np.zeros(self.padded, dtype=np.int32)
         tg_count = np.zeros(self.padded, dtype=np.int32)
         for i, node in enumerate(self.nodes):
-            for alloc in ctx.proposed_allocs(node.id):
+            for alloc in ctx.proposed_allocs_objects(node.id):
                 used[i] += _res_vec(alloc.resources)
                 bw_used[i] += _task_bw(alloc.task_resources)
                 if alloc.job_id == job_id:
                     job_count[i] += 1
                     if alloc.task_group == tg_name:
                         tg_count[i] += 1
+        # Existing allocations held in stored columnar blocks: accounted
+        # per run (count × vec), never materialized. Members this plan
+        # evicts are invisible to the object walk above, so subtract them
+        # here; stale eviction ids (member already gone) subtract nothing.
+        blocks_getter = getattr(ctx.state, "alloc_blocks", None)
+        blocks = blocks_getter() if blocks_getter is not None else []
+        if blocks:
+            evicted: Dict[int, List] = {}
+            for nid, evs in plan.node_update.items():
+                i = self.index.get(nid)
+                if i is None:
+                    continue
+                for a in evs:
+                    for blk in blocks:
+                        if blk.find(a.id) is not None:
+                            evicted.setdefault(i, []).append((a, blk))
+                            break
+            for blk in blocks:
+                vec = _res_vec(blk.resources)
+                bw = _task_bw(blk.task_resources)
+                b_job = blk.job_id
+                b_tg = blk.tg_name
+                for nid, cnt in blk.live_node_counts():
+                    i = self.index.get(nid)
+                    if i is None:
+                        continue
+                    used[i] += vec * cnt
+                    bw_used[i] += bw * cnt
+                    if b_job == job_id:
+                        job_count[i] += cnt
+                        if b_tg == tg_name:
+                            tg_count[i] += cnt
+            for i, pairs in evicted.items():
+                for a, blk in pairs:
+                    used[i] -= _res_vec(a.resources)
+                    bw_used[i] -= _task_bw(a.task_resources)
+                    if a.job_id == job_id:
+                        job_count[i] -= 1
+                        if a.task_group == tg_name:
+                            tg_count[i] -= 1
         # Columnar placements from earlier task groups of this plan
         # (AllocBatch bypasses proposed_allocs' per-object view).
         for b in ctx.plan.alloc_batches:

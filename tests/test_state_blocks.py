@@ -1,0 +1,261 @@
+"""Stored columnar blocks (state/blocks.py): the block form of a committed
+batch must be observationally equal to its materialized expansion.
+
+Reference semantics oracle: every placement behaves as an individual
+Allocation row (/root/reference/nomad/state/state_store.go:91-760); the
+block is purely a storage/wire optimization.
+"""
+
+import threading
+
+import pytest
+
+from nomad_tpu import structs
+from nomad_tpu.state import StateStore
+from nomad_tpu.state.store import item_alloc_node
+from nomad_tpu.structs import AllocBatch, Resources, generate_uuid
+from nomad_tpu import mock
+
+
+def _mk_batch(job, node_ids, counts, eval_id="ev-1"):
+    n = sum(counts)
+    ids_hex = "".join(generate_uuid().replace("-", "") for _ in range(n))
+    return AllocBatch(
+        eval_id=eval_id,
+        job=job,
+        tg_name=job.task_groups[0].name,
+        resources=Resources(cpu=100, memory_mb=128),
+        node_ids=list(node_ids),
+        node_counts=list(counts),
+        name_idx=list(range(n)),
+        ids_hex=ids_hex,
+    )
+
+
+def _seeded_store(n_nodes=4):
+    store = StateStore()
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.id = f"node-{i}"
+        store.upsert_node(i + 1, node)
+        nodes.append(node)
+    job = mock.job()
+    store.upsert_job(50, job)
+    return store, nodes, job
+
+
+def _alloc_key(a):
+    return (a.id, a.node_id, a.job_id, a.eval_id, a.name, a.task_group,
+            a.desired_status, a.client_status, a.create_index, a.modify_index)
+
+
+def test_block_store_equals_object_store():
+    store_b, nodes, job = _seeded_store()
+    store_o = StateStore()
+    for i, node in enumerate(nodes):
+        store_o.upsert_node(i + 1, node.copy())
+    store_o.upsert_job(50, job)
+
+    batch = _mk_batch(job, [n.id for n in nodes], [3, 2, 0, 4])
+    store_b.upsert_alloc_blocks(100, [batch])
+    store_o.upsert_allocs(100, batch.materialize())
+
+    assert store_b.alloc_count() == store_o.alloc_count() == 9
+    assert store_b.get_index("allocs") == store_o.get_index("allocs")
+    for nid in [n.id for n in nodes]:
+        got = sorted(map(_alloc_key, store_b.allocs_by_node(nid)))
+        want = sorted(map(_alloc_key, store_o.allocs_by_node(nid)))
+        assert got == want
+    got = sorted(map(_alloc_key, store_b.allocs_by_job(job.id)))
+    want = sorted(map(_alloc_key, store_o.allocs_by_job(job.id)))
+    assert got == want
+    assert sorted(map(_alloc_key, store_b.allocs_by_eval("ev-1"))) == \
+        sorted(map(_alloc_key, store_o.allocs_by_eval("ev-1")))
+    some_id = batch.alloc_id(4)
+    assert _alloc_key(store_b.alloc_by_id(some_id)) == \
+        _alloc_key(store_o.alloc_by_id(some_id))
+
+
+def test_client_update_promotes_member():
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id, nodes[1].id], [2, 2])
+    store.upsert_alloc_blocks(100, [batch])
+
+    target = store.allocs_by_node(nodes[0].id)[0]
+    upd = target.copy()
+    upd.client_status = structs.ALLOC_CLIENT_STATUS_RUNNING
+    upd.client_description = "up"
+    store.update_alloc_from_client(101, upd)
+
+    got = store.alloc_by_id(target.id)
+    assert got.client_status == structs.ALLOC_CLIENT_STATUS_RUNNING
+    assert got.modify_index == 101
+    assert got.create_index == 100  # block commit index survives promotion
+    # The untouched sibling still reads through the block.
+    sibling = [a for a in store.allocs_by_node(nodes[0].id)
+               if a.id != target.id]
+    assert len(sibling) == 1
+    assert sibling[0].client_status == structs.ALLOC_CLIENT_STATUS_PENDING
+    assert store.alloc_count() == 4
+
+
+def test_superseding_upsert_promotes_member():
+    """A stop/evict row for a block member replaces it — reads must not
+    show the member twice (the rolling-update path)."""
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id], [3])
+    store.upsert_alloc_blocks(100, [batch])
+
+    stop = store.allocs_by_node(nodes[0].id)[0].copy()
+    stop.desired_status = structs.ALLOC_DESIRED_STATUS_EVICT
+    store.upsert_allocs(101, [stop])
+
+    on_node = store.allocs_by_node(nodes[0].id)
+    assert len(on_node) == 3
+    assert {a.id for a in on_node} == {batch.alloc_id(i) for i in range(3)}
+    evicted = [a for a in on_node
+               if a.desired_status == structs.ALLOC_DESIRED_STATUS_EVICT]
+    assert len(evicted) == 1 and evicted[0].id == stop.id
+    assert evicted[0].modify_index == 101
+
+
+def test_delete_eval_reaps_blocks():
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id, nodes[1].id], [2, 1], eval_id="ev-gc")
+    ev = mock.evaluation()
+    ev.id = "ev-gc"
+    ev.job_id = job.id
+    store.upsert_evals(99, [ev])
+    store.upsert_alloc_blocks(100, [batch])
+    assert store.alloc_count() == 3
+
+    store.delete_eval(102, ["ev-gc"], [])
+    assert store.alloc_count() == 0
+    assert store.allocs_by_node(nodes[0].id) == []
+    assert store.allocs_by_job(job.id) == []
+    assert store.eval_by_id("ev-gc") is None
+
+
+def test_snapshot_isolated_from_promotion():
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id], [2])
+    store.upsert_alloc_blocks(100, [batch])
+    snap = store.snapshot()
+
+    upd = store.allocs_by_node(nodes[0].id)[0].copy()
+    upd.client_status = structs.ALLOC_CLIENT_STATUS_FAILED
+    store.update_alloc_from_client(101, upd)
+
+    # The earlier snapshot still sees the pristine block.
+    before = snap.allocs_by_node(nodes[0].id)
+    assert all(a.client_status == structs.ALLOC_CLIENT_STATUS_PENDING
+               for a in before)
+    after = store.allocs_by_node(nodes[0].id)
+    assert any(a.client_status == structs.ALLOC_CLIENT_STATUS_FAILED
+               for a in after)
+
+
+def test_fsm_snapshot_roundtrip_with_blocks():
+    from nomad_tpu.server.fsm import FSM
+
+    fsm = FSM()
+    store, nodes, job = _seeded_store()
+    for i, node in enumerate(nodes):
+        fsm.state.upsert_node(i + 1, node)
+    fsm.state.upsert_job(50, job)
+    batch = _mk_batch(job, [nodes[0].id, nodes[2].id], [2, 3])
+    fsm.state.upsert_alloc_blocks(100, [batch])
+    # One promoted member mixes object + block rows in the stream.
+    upd = fsm.state.allocs_by_node(nodes[0].id)[0].copy()
+    upd.client_status = structs.ALLOC_CLIENT_STATUS_RUNNING
+    fsm.state.update_alloc_from_client(101, upd)
+
+    data = fsm.snapshot_bytes()
+    before = sorted(map(_alloc_key, fsm.state.allocs()))
+    fsm2 = FSM()
+    fsm2.restore_bytes(data)
+    after = sorted(map(_alloc_key, fsm2.state.allocs()))
+    assert before == after
+    assert fsm2.state.alloc_count() == 5
+    assert fsm2.state.get_index("allocs") == 101
+    # Restored blocks stay columnar, not exploded.
+    assert len(fsm2.state.alloc_blocks()) == 1
+
+
+def test_plan_verification_sees_block_usage():
+    """A committed block's usage must reject an overcommitting second plan
+    (the optimistic-concurrency guard, plan_apply.go:229-277)."""
+    from nomad_tpu.server.plan_apply import evaluate_plan
+    from nomad_tpu.structs import Plan
+
+    store, nodes, job = _seeded_store(1)
+    node = nodes[0]
+    cap = node.resources.cpu // 100  # how many 100-cpu tasks fit
+    batch = _mk_batch(job, [node.id], [cap])
+    store.upsert_alloc_blocks(100, [batch])
+
+    job2 = mock.job()
+    batch2 = _mk_batch(job2, [node.id], [1], eval_id="ev-2")
+    plan = Plan(eval_id="ev-2", alloc_batches=[batch2])
+    result = evaluate_plan(store.snapshot(), plan)
+    assert sum(b.n for b in result.alloc_batches) == 0
+    assert result.refresh_index > 0
+
+    # Below the large-plan threshold both verify paths agree: force the
+    # scalar path by checking a tiny object plan too.
+    a = mock.alloc()
+    a.node_id = node.id
+    a.job_id = job2.id
+    a.resources = Resources(cpu=100, memory_mb=64)
+    plan_obj = Plan(eval_id="ev-3", node_allocation={node.id: [a]})
+    result = evaluate_plan(store.snapshot(), plan_obj)
+    assert result.node_allocation == {}
+
+
+def test_bulk_verification_sees_block_usage():
+    """Same overcommit guard through the native bulk verifier
+    (>= FAST_VERIFY_THRESHOLD placements)."""
+    from nomad_tpu.server.plan_apply import FAST_VERIFY_THRESHOLD, evaluate_plan
+    from nomad_tpu.structs import Plan
+
+    store, nodes, job = _seeded_store(2)
+    full, free = nodes
+    cap = full.resources.cpu // 100
+    batch = _mk_batch(job, [full.id], [cap])
+    store.upsert_alloc_blocks(100, [batch])
+
+    job2 = mock.job()
+    ask = max(FAST_VERIFY_THRESHOLD, 2)
+    # Half the asks target the saturated node, half the free one: partial
+    # commit must keep exactly the free node's run.
+    batch2 = _mk_batch(job2, [full.id, free.id], [ask // 2, ask // 2],
+                       eval_id="ev-2")
+    plan = Plan(eval_id="ev-2", alloc_batches=[batch2])
+    result = evaluate_plan(store.snapshot(), plan)
+    committed = [b for b in result.alloc_batches]
+    assert sum(b.n for b in committed) == ask // 2
+    assert all(set(b.node_ids) == {free.id} for b in committed)
+    assert result.refresh_index > 0
+
+
+def test_block_commit_fires_node_watch():
+    store, nodes, job = _seeded_store()
+    fired = threading.Event()
+    store.watch.watch([item_alloc_node(nodes[1].id)], fired)
+    batch = _mk_batch(job, [nodes[1].id], [2])
+    store.upsert_alloc_blocks(100, [batch])
+    assert fired.wait(1.0)
+
+
+def test_block_member_delete_fires_node_watch():
+    """A client long-polling its node's allocs must wake when a block
+    member is GC'd, exactly as for object-row deletions."""
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[1].id], [2])
+    store.upsert_alloc_blocks(100, [batch])
+    fired = threading.Event()
+    store.watch.watch([item_alloc_node(nodes[1].id)], fired)
+    store.delete_eval(101, [], [batch.alloc_id(0)])
+    assert fired.wait(1.0)
+    assert store.alloc_count() == 1
