@@ -593,7 +593,18 @@ def main():
                 "error": f"{type(e).__name__}: {e}",
             }
         )
-        sys.exit(1)
+        _exit(1)
+    _exit(0)
+
+
+def _exit(code: int) -> None:
+    """Exit without interpreter teardown: daemon threads (shape warmer,
+    broker timers) may sit inside an XLA compile, and finalizing python
+    under them aborts the process (rc 134) AFTER the JSON was emitted.
+    The one-line contract is already flushed; skip teardown entirely."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
 
 
 if __name__ == "__main__":

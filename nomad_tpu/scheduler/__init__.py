@@ -141,6 +141,13 @@ def _manager_loop(logger: logging.Logger) -> None:
             try:
                 import jax
 
+                # The force-cpu knob must bind the parent exactly like the
+                # child (device_probe.py): the image's sitecustomize pins
+                # the device platform regardless of JAX_PLATFORMS, so
+                # without this re-pin a cpu-probed child would be followed
+                # by an in-process claim against the real device.
+                if _os.environ.get("NOMAD_TPU_PROBE_FORCE_CPU") == "1":
+                    jax.config.update("jax_platforms", "cpu")
                 jax.devices()
                 from nomad_tpu.tpu import solver
             except Exception as e:

@@ -53,13 +53,17 @@ class EvalContext:
         """Invoked after each placement (context.go:99-101)."""
         self._metrics = AllocMetric()
 
-    def proposed_allocs(self, node_id: str) -> List[Allocation]:
-        """Existing allocs − terminal − planned evictions + planned
-        placements (context.go:103-126)."""
-        existing = filter_terminal_allocs(self._state.allocs_by_node(node_id))
+    def _proposed(self, node_id: str,
+                  existing: List[Allocation]) -> List[Allocation]:
+        existing = filter_terminal_allocs(existing)
         update = self._plan.node_update.get(node_id, [])
         proposed = remove_allocs(existing, update) if update else existing
         return proposed + self._plan.node_allocation.get(node_id, [])
+
+    def proposed_allocs(self, node_id: str) -> List[Allocation]:
+        """Existing allocs − terminal − planned evictions + planned
+        placements (context.go:103-126)."""
+        return self._proposed(node_id, self._state.allocs_by_node(node_id))
 
     def proposed_allocs_objects(self, node_id: str) -> List[Allocation]:
         """``proposed_allocs`` over the object table only. Callers that
@@ -68,8 +72,5 @@ class EvalContext:
         state without the split view falls back to the full one."""
         getter = getattr(self._state, "allocs_by_node_objects", None)
         if getter is None:
-            return self.proposed_allocs(node_id)
-        existing = filter_terminal_allocs(getter(node_id))
-        update = self._plan.node_update.get(node_id, [])
-        proposed = remove_allocs(existing, update) if update else existing
-        return proposed + self._plan.node_allocation.get(node_id, [])
+            getter = self._state.allocs_by_node
+        return self._proposed(node_id, getter(node_id))

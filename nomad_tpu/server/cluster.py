@@ -264,6 +264,12 @@ class ClusterServer(Server):
             return
         self._forward("Eval.Nack", {"eval_id": eval_id, "token": token})
 
+    def eval_touch(self, eval_id: str, token: str) -> None:
+        if self.raft.is_leader:
+            self.eval_broker.outstanding_reset(eval_id, token)
+            return
+        self._forward("Eval.Reset", {"eval_id": eval_id, "token": token})
+
     def eval_upsert(self, evals: List[Evaluation]) -> int:
         if self.raft.is_leader:
             return self.raft.apply("eval_update", {"evals": evals}).result()
@@ -336,6 +342,7 @@ class ClusterServer(Server):
         r("Eval.DequeueBatch", self._rpc_eval_dequeue_batch)
         r("Eval.Ack", lambda a: self.eval_ack(a["eval_id"], a["token"]))
         r("Eval.Nack", lambda a: self.eval_nack(a["eval_id"], a["token"]))
+        r("Eval.Reset", lambda a: self.eval_touch(a["eval_id"], a["token"]))
         r("Eval.Upsert", lambda a: self.eval_upsert(
             [from_dict(Evaluation, e) for e in a["evals"]]
         ))

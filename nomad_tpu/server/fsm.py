@@ -103,8 +103,7 @@ class FSM:
             self.state.upsert_alloc_blocks(index, batches)
 
     def _apply_alloc_client_update(self, index: int, payload: dict) -> None:
-        for alloc in payload["allocs"]:
-            self.state.update_alloc_from_client(index, alloc)
+        self.state.update_allocs_from_client(index, payload["allocs"])
 
     # -- snapshot/restore (fsm.go:299-593) ---------------------------------
 

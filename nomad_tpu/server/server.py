@@ -73,6 +73,11 @@ class ServerConfig:
     max_heartbeats_per_second: float = 50.0
     failover_heartbeat_ttl: float = 300.0
     periodic_dispatch: bool = False  # GC dispatch loop (leader.go:170-200)
+    # Pre-compile the device solve programs for the cluster's shape buckets
+    # in the background at start/leader-establish, so a first eval doesn't
+    # pay a cold XLA compile against the nack timeout (tpu/solver.py
+    # warm_shapes; the worker's nack-touch loop covers the gap meanwhile).
+    prewarm_shapes: bool = True
 
     def scheduler_factory(self, eval_type: str) -> str:
         if self.scheduler_backend == "tpu" and eval_type in (
@@ -142,6 +147,49 @@ class Server:
             target=self._emit_stats, daemon=True, name="stats-emitter",
         )
         emitter.start()
+        if self.config.prewarm_shapes and self.config.scheduler_backend == "tpu":
+            warmer = threading.Thread(
+                target=self._prewarm_solver, daemon=True, name="shape-warmer",
+            )
+            warmer.start()
+
+    def _prewarm_solver(self) -> None:
+        """Background shape-bucket pre-compile (see ServerConfig
+        .prewarm_shapes). Waits for device acquisition, then re-warms
+        whenever the cluster's node-bucket signature changes — a fresh
+        cluster warms as soon as nodes register, and growth into a larger
+        padded bucket triggers a new compile before an eval needs it. A
+        host-only deployment simply never warms."""
+        from nomad_tpu.ops.binpack import bucket
+        from nomad_tpu.scheduler import wait_for_device
+
+        solver = wait_for_device(timeout=600.0, logger=self.logger)
+        if solver is None:
+            return
+        warmed_sig = None
+        while not self._periodic_stop.is_set():
+            snap = self.state_store.snapshot()
+            nodes = [
+                n for n in snap.nodes()
+                if n.status == structs.NODE_STATUS_READY and not n.drain
+            ]
+            per_dc: Dict[str, int] = {}
+            for n in nodes:
+                per_dc[n.datacenter] = per_dc.get(n.datacenter, 0) + 1
+            sig = (
+                bucket(len(nodes)) if nodes else 0,
+                tuple(sorted(bucket(c) for c in per_dc.values())),
+            )
+            if nodes and sig != warmed_sig:
+                try:
+                    solver.warm_shapes(
+                        snap, logger=self.logger,
+                        stop=self._periodic_stop.is_set,
+                    )
+                    warmed_sig = sig
+                except Exception:
+                    self.logger.exception("shape prewarm failed")
+            self._periodic_stop.wait(5.0)
 
     def shutdown(self) -> None:
         self._periodic_stop.set()
@@ -479,6 +527,14 @@ class Server:
 
     def eval_ack(self, eval_id: str, token: str) -> None:
         self.eval_broker.ack(eval_id, token)
+
+    def eval_touch(self, eval_id: str, token: str) -> None:
+        """Reset the outstanding eval's nack timer mid-processing — keeps a
+        long first-compile solve from being redelivered (the broker-side
+        mechanism is OutstandingReset, eval_broker.go:396-412; the
+        reference only exercises it from plan submission, which is too
+        late for a pre-plan cold compile)."""
+        self.eval_broker.outstanding_reset(eval_id, token)
 
     def eval_nack(self, eval_id: str, token: str) -> None:
         self.eval_broker.nack(eval_id, token)

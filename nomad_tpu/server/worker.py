@@ -100,7 +100,43 @@ class Worker(threading.Thread):
             self.logger.error("error waiting for state sync: %s", e)
             self._send_ack(ev.id, token, ack=False)
             return
-        ok = self._invoke_scheduler(ev, token, planner=_EvalRun(self, token))
+        # Touch the broker's nack timer while the scheduler runs: a cold
+        # first compile of a new shape bucket can exceed eval_nack_timeout
+        # before any plan is submitted, and a redelivered eval mid-solve
+        # would double-schedule (OutstandingReset, eval_broker.go:396-412;
+        # the plan applier's reset only fires once a plan exists).
+        stop_touch = threading.Event()
+        interval = max(self.server.config.eval_nack_timeout / 3.0, 0.05)
+
+        def touch_loop():
+            while not stop_touch.wait(interval):
+                try:
+                    self.server.eval_touch(ev.id, token)
+                except BrokerError as e:
+                    # The eval is no longer outstanding (acked/nacked/lost
+                    # leadership): touching is moot.
+                    self.logger.debug(
+                        "eval touch stopped for %s: %s", ev.id, e
+                    )
+                    return
+                except Exception as e:
+                    # Transient forwarding failure (follower -> leader blip):
+                    # keep trying — one miss must not disable the keep-alive
+                    # for the rest of a long solve.
+                    self.logger.debug(
+                        "eval touch failed for %s (retrying): %s", ev.id, e
+                    )
+
+        toucher = threading.Thread(
+            target=touch_loop, daemon=True, name=f"{self.name}-touch"
+        )
+        toucher.start()
+        try:
+            ok = self._invoke_scheduler(
+                ev, token, planner=_EvalRun(self, token)
+            )
+        finally:
+            stop_touch.set()
         self._send_ack(ev.id, token, ack=ok)
 
     # -- internals ---------------------------------------------------------

@@ -178,6 +178,25 @@ class StoredAllocBlock(AllocBatch):
 
     # -- persistence (FSM snapshot stream) --------------------------------
 
+    _PICKLE_SLOTS = (
+        "eval_id", "job", "tg_name", "resources", "task_resources",
+        "metrics", "node_ids", "node_counts", "name_idx", "ids_hex",
+        "block_id", "job_id", "create_index", "modify_index", "excluded",
+    )
+
+    def __getstate__(self):
+        """Pickle the columns only: a block that has served one
+        materialize() read carries an O(placements) object cache that must
+        never re-inflate a raft snapshot."""
+        return {k: getattr(self, k) for k in self._PICKLE_SLOTS}
+
+    def __setstate__(self, state):
+        for k in self._PICKLE_SLOTS:
+            setattr(self, k, state[k])
+        self._id_pos = None
+        self._node_run = None
+        self._materialized = None
+
     def to_wire(self) -> dict:
         d = super().to_wire()
         d["block_id"] = self.block_id

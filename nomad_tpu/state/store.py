@@ -347,12 +347,25 @@ def _find_block_member(t: _Tables, alloc_id: str):
     return None
 
 
+def _insert_alloc_row(t: _Tables, alloc: Allocation) -> None:
+    t.allocs[alloc.id] = alloc
+    t.allocs_by_job.setdefault(alloc.job_id, set()).add(alloc.id)
+    t.allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
+    t.allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+
+
 def _exclude_block_members(t: _Tables, members: Dict[str, Set[int]]) -> None:
     """Replace blocks with COW copies excluding ``members`` ({block_id:
-    positions}); blocks drained to zero live members are dropped."""
+    positions}). A block whose exclusion set reaches half its size
+    dissolves — remaining members become object rows — so per-member
+    promotion cost stays O(n) over a block's whole life instead of the
+    frozenset-union O(n^2)."""
     for bid, positions in members.items():
         blk = t.blocks[bid].with_excluded(positions)
-        if blk.n_live == 0:
+        dissolve = blk.n_live == 0 or len(blk.excluded) * 2 >= blk.n
+        if dissolve:
+            for alloc in blk.materialize():
+                _insert_alloc_row(t, alloc)
             del t.blocks[bid]
             for idx_map, key in ((t.blocks_by_job, blk.job_id),
                                  (t.blocks_by_eval, blk.eval_id)):
@@ -638,40 +651,46 @@ class StateStore(_StateView):
         self.watch.notify(items)
 
     def update_alloc_from_client(self, index: int, alloc: Allocation) -> None:
-        """Client status update: only client-side fields are trusted
-        (reference: state_store.go UpdateAllocFromClient). A block member
-        is promoted to an object row, since its status now diverges from
-        its block."""
+        self.update_allocs_from_client(index, [alloc])
+
+    def update_allocs_from_client(self, index: int,
+                                  allocs: List[Allocation]) -> None:
+        """Client status updates: only client-side fields are trusted
+        (reference: state_store.go UpdateAllocFromClient). Block members
+        are promoted to object rows — their status now diverges from their
+        block — with one COW exclusion per block per batch, not per
+        member."""
+        items: List[WatchItem] = [item_table("allocs")]
         with self._lock:
-            existing = self._t.allocs.get(alloc.id)
-            if existing is None and self._t.blocks:
-                found = _find_block_member(self._t, alloc.id)
-                if found is not None:
-                    bid, pos = found
-                    existing = self._t.blocks[bid].materialize_pos(pos)
-                    _exclude_block_members(self._t, {bid: {pos}})
-                    self._t.allocs[existing.id] = existing
-                    self._t.allocs_by_job.setdefault(
-                        existing.job_id, set()).add(existing.id)
-                    self._t.allocs_by_node.setdefault(
-                        existing.node_id, set()).add(existing.id)
-                    self._t.allocs_by_eval.setdefault(
-                        existing.eval_id, set()).add(existing.id)
-            if existing is None:
-                raise KeyError(f"alloc not found: {alloc.id}")
-            new = existing.copy()
-            new.client_status = alloc.client_status
-            new.client_description = alloc.client_description
-            new.modify_index = index
-            self._t.allocs[alloc.id] = new
-            self._t.indexes["allocs"] = index
-            alloc = new
-        self.watch.notify(
-            [
-                item_table("allocs"),
-                item_alloc(alloc.id),
-                item_alloc_job(alloc.job_id),
-                item_alloc_node(alloc.node_id),
-                item_alloc_eval(alloc.eval_id),
-            ]
-        )
+            t = self._t
+            if t.blocks:
+                members: Dict[str, Set[int]] = {}
+                for alloc in allocs:
+                    if alloc.id in t.allocs:
+                        continue
+                    found = _find_block_member(t, alloc.id)
+                    if found is not None:
+                        bid, pos = found
+                        members.setdefault(bid, set()).add(pos)
+                        _insert_alloc_row(t, t.blocks[bid].materialize_pos(pos))
+                if members:
+                    _exclude_block_members(t, members)
+            for alloc in allocs:
+                existing = t.allocs.get(alloc.id)
+                if existing is None:
+                    raise KeyError(f"alloc not found: {alloc.id}")
+                new = existing.copy()
+                new.client_status = alloc.client_status
+                new.client_description = alloc.client_description
+                new.modify_index = index
+                t.allocs[alloc.id] = new
+                items.extend(
+                    [
+                        item_alloc(new.id),
+                        item_alloc_job(new.job_id),
+                        item_alloc_node(new.node_id),
+                        item_alloc_eval(new.eval_id),
+                    ]
+                )
+            t.indexes["allocs"] = index
+        self.watch.notify(items)

@@ -956,3 +956,74 @@ def new_tpu_scheduler(variant: str, state, planner, logger: logging.Logger):
     if variant == "system":
         return TPUSystemScheduler(state, planner, logger)
     raise ValueError(f"unknown TPU scheduler variant {variant!r}")
+
+
+def warm_shapes(snapshot, counts=(8, 16, 32, 64, 128, 129), logger=None,
+                stop=None) -> int:
+    """Pre-compile the device programs for the current cluster's shape
+    buckets (the leader-establish hook; see ServerConfig.prewarm_shapes).
+
+    XLA compiles are keyed on padded tensor shapes: the node-axis bucket
+    (per datacenter subset) times the count bucket of the exact greedy path
+    (counts <= 128) plus the count-independent water-fill. A cold first
+    compile on a tunneled device can take tens of seconds — longer than
+    eval_nack_timeout — so the leader warms the buckets in the background
+    at establish, and the worker's nack-touch loop covers evals that
+    arrive before warmup completes.
+
+    Drives the REAL production path (TPUStack.prepare -> solve dispatch)
+    against the live snapshot with an unsatisfiable synthetic job, so the
+    warmed programs, mirror tensors, and mask caches are exactly the ones
+    the first eval uses. Returns the number of solve dispatches issued.
+    """
+    from nomad_tpu import structs as _structs
+    from nomad_tpu.structs import Plan, Task
+
+    log = logger or logging.getLogger("nomad_tpu.tpu.warm")
+    nodes = [
+        n for n in snapshot.nodes()
+        if n.status == _structs.NODE_STATUS_READY and not n.drain
+    ]
+    if not nodes:
+        return 0
+    all_dcs = sorted({n.datacenter for n in nodes})
+    # One warm per distinct node-axis bucket: the union of datacenters plus
+    # each single datacenter (the common job targeting shapes).
+    dc_sets = [all_dcs] + [[dc] for dc in all_dcs]
+    seen = set()
+    dispatches = 0
+    t0 = time.perf_counter()
+    for dcs in dc_sets:
+        _nodes, mirror = GLOBAL_MIRROR_CACHE.get(snapshot, list(dcs))
+        if mirror.n == 0 or mirror.padded in seen:
+            continue
+        seen.add(mirror.padded)
+        tg = TaskGroup(
+            name="_warm", count=1,
+            tasks=[Task(name="_warm", driver="_warm",
+                        resources=Resources(cpu=1, memory_mb=1))],
+        )
+        job = Job(
+            region="global", id=f"_warm-{mirror.padded}", name="_warm",
+            type=_structs.JOB_TYPE_BATCH, priority=1,
+            datacenters=list(dcs), task_groups=[tg],
+        )
+        ctx = EvalContext(snapshot, Plan(eval_id="_warm"), log)
+        stack = TPUStack(ctx, batch=True)
+        stack.set_mirror(mirror)
+        stack.set_job(job)
+        for count in counts:
+            if stop is not None and stop():
+                # Server shutting down: don't start another compile that
+                # would hold a thread inside XLA through interpreter exit.
+                return dispatches
+            if count <= 128:
+                stack.solve_group(tg, count)
+            else:
+                stack.solve_group_counts(tg, count)
+            dispatches += 1
+    log.info(
+        "warmed %d solve program(s) across %d node bucket(s) in %.1fs",
+        dispatches, len(seen), time.perf_counter() - t0,
+    )
+    return dispatches

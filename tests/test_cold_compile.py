@@ -1,0 +1,152 @@
+"""Cold-compile resilience: a first solve slower than the nack timeout
+must not be redelivered (worker nack-touch), and the leader pre-warms the
+shape buckets so it rarely happens at all (tpu/solver.py warm_shapes).
+
+Reference machinery: OutstandingReset + Nack timers,
+/root/reference/nomad/eval_broker.go:319-412.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler import BUILTIN_SCHEDULERS, register
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import Evaluation, generate_uuid
+
+
+def _wait_complete(srv, eval_id, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = srv.state_store.eval_by_id(eval_id)
+        if got is not None and got.status != structs.EVAL_STATUS_PENDING:
+            return got
+        time.sleep(0.02)
+    raise TimeoutError("eval still pending")
+
+
+def test_slow_first_solve_not_redelivered():
+    """Scheduler invocation takes 3x the nack timeout; the touch loop must
+    keep the broker from redelivering, so the scheduler runs exactly once
+    and the eval completes."""
+    invocations = []
+    orig = BUILTIN_SCHEDULERS["service"]
+
+    def slow_factory(state, planner, logger):
+        inner = orig(state, planner, logger)
+
+        class Slow:
+            def process(self, ev):
+                invocations.append(ev.id)
+                time.sleep(1.6)  # > 3x nack timeout below
+                return inner.process(ev)
+
+        return Slow()
+
+    register("service", slow_factory)
+    srv = Server(ServerConfig(
+        scheduler_backend="host", num_schedulers=1, eval_batch_size=1,
+        eval_nack_timeout=0.5, prewarm_shapes=False,
+    ))
+    try:
+        node = mock.node()
+        srv.raft.apply("node_register", {"node": node})
+        job = mock.job()
+        job.task_groups[0].count = 1
+        srv.raft.apply("job_register", {"job": job})
+        srv.start()
+        ev = Evaluation(
+            id=generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+            status=structs.EVAL_STATUS_PENDING,
+        )
+        srv.raft.apply("eval_update", {"evals": [ev]})
+        got = _wait_complete(srv, ev.id)
+        assert got.status == structs.EVAL_STATUS_COMPLETE
+        # Exactly one delivery: the nack timer never fired mid-solve.
+        assert invocations == [ev.id]
+        stats = srv.eval_broker.snapshot_stats()
+        assert stats.total_unacked == 0 and stats.total_ready == 0
+    finally:
+        register("service", orig)
+        srv.shutdown()
+
+
+def test_slow_solve_without_touch_redelivers():
+    """Control for the test above: with touching disabled the same slow
+    solve IS redelivered — proving the touch loop is load-bearing."""
+    invocations = []
+    orig = BUILTIN_SCHEDULERS["service"]
+
+    def slow_factory(state, planner, logger):
+        inner = orig(state, planner, logger)
+
+        class Slow:
+            def process(self, ev):
+                invocations.append(ev.id)
+                time.sleep(1.6)
+                return inner.process(ev)
+
+        return Slow()
+
+    register("service", slow_factory)
+    srv = Server(ServerConfig(
+        scheduler_backend="host", num_schedulers=1, eval_batch_size=1,
+        eval_nack_timeout=0.5, prewarm_shapes=False,
+    ))
+    srv.eval_touch = lambda eval_id, token: None  # disable the touch loop
+    try:
+        node = mock.node()
+        srv.raft.apply("node_register", {"node": node})
+        job = mock.job()
+        job.task_groups[0].count = 1
+        srv.raft.apply("job_register", {"job": job})
+        srv.start()
+        ev = Evaluation(
+            id=generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+            status=structs.EVAL_STATUS_PENDING,
+        )
+        srv.raft.apply("eval_update", {"evals": [ev]})
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and len(invocations) < 2:
+            time.sleep(0.05)
+        assert len(invocations) >= 2  # nack timer fired -> redelivery
+    finally:
+        register("service", orig)
+        srv.shutdown()
+
+
+def test_warm_shapes_compiles_cluster_buckets():
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.tpu import solver as tpu_solver
+    from nomad_tpu.tpu.mirror import GLOBAL_MIRROR_CACHE
+    from nomad_tpu.ops.binpack import bucket
+
+    store = StateStore()
+    # 12 nodes in dc1 + 3 in dc2: union bucket 16, dc1 bucket 16 (dedup),
+    # dc2 bucket 8 -> two distinct node buckets.
+    for i in range(15):
+        n = mock.node()
+        n.id = f"warm-{i}"
+        n.datacenter = "dc1" if i < 12 else "dc2"
+        store.upsert_node(i + 1, n)
+    snap = store.snapshot()
+    counts = (1, 129)
+    dispatches = tpu_solver.warm_shapes(snap, counts=counts)
+    assert dispatches == 2 * len(counts)
+
+    # The warmed mirror is the one a real eval adopts (cache hit).
+    hits0 = GLOBAL_MIRROR_CACHE.hits
+    _nodes, mirror = GLOBAL_MIRROR_CACHE.get(snap, ["dc1", "dc2"])
+    assert GLOBAL_MIRROR_CACHE.hits == hits0 + 1
+    assert mirror.padded == bucket(15)
+
+
+def test_warm_shapes_empty_store_noop():
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.tpu import solver as tpu_solver
+
+    assert tpu_solver.warm_shapes(StateStore().snapshot()) == 0

@@ -239,6 +239,43 @@ def test_bulk_verification_sees_block_usage():
     assert result.refresh_index > 0
 
 
+def test_block_dissolves_once_half_promoted():
+    """Per-member COW exclusion is O(n^2) if it runs to completion; the
+    store dissolves a block at 50% promotion so total cost stays O(n)."""
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id, nodes[1].id], [2, 2])
+    store.upsert_alloc_blocks(100, [batch])
+
+    for i in range(2):
+        upd = store.alloc_by_id(batch.alloc_id(i)).copy()
+        upd.client_status = structs.ALLOC_CLIENT_STATUS_RUNNING
+        store.update_alloc_from_client(101 + i, upd)
+
+    assert store.alloc_blocks() == []  # dissolved into object rows
+    assert store.alloc_count() == 4
+    assert len(store.allocs_objects()) == 4
+    running = [a for a in store.allocs_by_job(job.id)
+               if a.client_status == structs.ALLOC_CLIENT_STATUS_RUNNING]
+    assert {a.id for a in running} == {batch.alloc_id(0), batch.alloc_id(1)}
+
+
+def test_pickle_drops_materialize_cache():
+    import pickle
+
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id, nodes[1].id], [250, 250])
+    store.upsert_alloc_blocks(100, [batch])
+    blk = store.alloc_blocks()[0]
+    blk.materialize()  # fill the O(placements) cache
+    data = pickle.dumps(blk)
+    blk2 = pickle.loads(data)
+    assert blk2._materialized is None  # cache never rides a raft snapshot
+    assert sorted(map(_alloc_key, blk2.materialize())) == \
+        sorted(map(_alloc_key, blk.materialize()))
+    # And the cache's absence keeps the payload columnar-sized.
+    assert len(data) < len(pickle.dumps(blk.materialize())) / 2
+
+
 def test_block_commit_fires_node_watch():
     store, nodes, job = _seeded_store()
     fired = threading.Event()
