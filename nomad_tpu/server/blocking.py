@@ -1,0 +1,73 @@
+"""Generic RPC-tier blocking-query machinery.
+
+The reference's ``blockingRPC`` (/root/reference/nomad/rpc.go:270-335) is a
+reusable mechanism any endpoint opts into: register watch items, run the
+query, retry until the result index passes the caller's MinQueryIndex or
+the timeout lapses. This is that mechanism for our RPC tier; the HTTP tier
+long-polls through the same store watch registry.
+
+One subtlety the reference doesn't have: a raft snapshot install rebinds
+``fsm.state`` to a fresh StateStore, so the live store must be re-read
+every pass and the watch registration raced against the rebind (the old
+store fires ``notify_all`` on replacement, and an identity re-check after
+registration closes the remaining window).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Tuple
+
+# Server-side clamp on client-requested waits (rpc.go maxQueryTime analog).
+MAX_QUERY_TIME = 10.0
+
+
+def blocking_query(
+    get_store: Callable[[], object],
+    items: Callable[[object], Iterable[Tuple[str, str]]],
+    run: Callable[[object], Tuple[int, object]],
+    min_index: int,
+    timeout: float,
+    max_timeout: float = MAX_QUERY_TIME,
+    index_of: Callable[[object], int] = None,
+) -> Tuple[int, object]:
+    """Run ``run(store)`` until its index passes ``min_index`` or the
+    timeout lapses (rpc.go:270-335 semantics).
+
+    - ``get_store``: returns the CURRENT live store (re-read each pass —
+      a snapshot restore rebinds it).
+    - ``items``: watch items to park on, given the store.
+    - ``run``: executes the query; returns (index, result). The index is
+      the query's table/item index (QueryMeta.Index analog).
+    - ``min_index`` <= 0 or a fresh-enough index returns immediately.
+    - ``index_of``: cheap index-only probe used for the post-registration
+      re-check (defaults to running the full query and dropping the
+      result).
+
+    Returns the final (index, result) — on timeout, the last read.
+    """
+    if index_of is None:
+        index_of = lambda store: run(store)[0]  # noqa: E731
+    timeout = min(timeout, max_timeout)
+    end = time.monotonic() + timeout
+    while True:
+        store = get_store()
+        # Index probe first: the full query (which may materialize a large
+        # result) runs only when it will actually be returned.
+        remaining = end - time.monotonic()
+        if index_of(store) > min_index or remaining <= 0:
+            return run(store)
+        event = threading.Event()
+        watch_items = list(items(store))
+        store.watch.watch(watch_items, event)
+        try:
+            # Identity re-check closes the register-vs-rebind race; a
+            # rebind after registration fires notify_all on the old store,
+            # so a full-length wait is safe. The index re-check closes the
+            # write-between-run-and-register race the same way.
+            if (get_store() is store
+                    and index_of(store) <= min_index):
+                event.wait(timeout=remaining)
+        finally:
+            store.watch.stop_watch(watch_items, event)

@@ -360,6 +360,8 @@ class ClusterServer(Server):
             [from_dict(Allocation, x) for x in a["allocs"]]
         ))
         r("Node.GetAllocs", self._rpc_node_get_allocs)
+        r("Eval.GetEval", self._rpc_eval_get)
+        r("Job.GetJob", self._rpc_job_get)
         r("Alloc.GetAlloc", self._rpc_alloc_get)
         r("Serf.Join", self._rpc_serf_join)
         r("Serf.PeerUpdate", self._rpc_serf_peer_update)
@@ -394,45 +396,84 @@ class ClusterServer(Server):
         return {"eval_id": eval_id, "index": index}
 
     def _rpc_node_get_allocs(self, args: dict):
-        """Blocking Node.GetAllocs (node_endpoint.go:328 + rpc.go:270-335):
-        hold until the allocs table passes min_index or the timeout lapses.
-        Served from local (possibly follower) state — the stale-read path."""
+        """Blocking Node.GetAllocs (node_endpoint.go:328) over the shared
+        blocking_query machinery (server/blocking.py; rpc.go:270-335).
+        Served from local (possibly follower) state — the stale-read
+        path."""
+        from nomad_tpu.server.blocking import blocking_query
         from nomad_tpu.state.store import item_alloc_node
 
         node_id = args["node_id"]
         min_index = int(args.get("min_index", 0))
-        timeout = min(float(args.get("timeout", 0.5)), 10.0)
 
-        import time as _time
+        index, allocs = blocking_query(
+            get_store=lambda: self.state_store,
+            items=lambda store: [item_alloc_node(node_id)],
+            run=lambda store: (
+                store.get_index("allocs"), store.allocs_by_node(node_id)
+            ),
+            index_of=lambda store: store.get_index("allocs"),
+            min_index=min_index,
+            timeout=float(args.get("timeout", 0.5)),
+        )
+        if index <= min_index:
+            return {"allocs": None, "index": index}
+        return {"allocs": [to_dict(a) for a in allocs], "index": index}
 
-        end = _time.monotonic() + timeout
-        while True:
-            # Re-read the store each pass: a raft snapshot install rebinds
-            # fsm.state, and a watch parked on the orphaned store would
-            # never fire again.
-            store = self.state_store
-            index = store.get_index("allocs")
-            if index > min_index:
-                allocs = store.allocs_by_node(node_id)
-                return {
-                    "allocs": [to_dict(a) for a in allocs],
-                    "index": index,
-                }
-            remaining = end - _time.monotonic()
-            if remaining <= 0:
-                return {"allocs": None, "index": index}
-            event = threading.Event()
-            item = item_alloc_node(node_id)
-            store.watch.watch([item], event)
-            try:
-                # Identity re-check closes the register-vs-rebind race; a
-                # rebind after registration fires notify_all on the old
-                # store, so a full-length wait is safe.
-                if (self.state_store is store
-                        and store.get_index("allocs") <= min_index):
-                    event.wait(timeout=remaining)
-            finally:
-                store.watch.stop_watch([item], event)
+    def _rpc_eval_get(self, args: dict):
+        """Blocking Eval.GetEval (eval_endpoint.go GetEval + rpc.go
+        blockingRPC): long-poll an evaluation's modify index — the RPC-tier
+        feed for eval monitors."""
+        from nomad_tpu.server.blocking import blocking_query
+        from nomad_tpu.state.store import item_eval
+
+        eval_id = args["eval_id"]
+        min_index = int(args.get("min_index", 0))
+
+        def run(store):
+            ev = store.eval_by_id(eval_id)
+            if ev is None:
+                # Not-yet-created evals resolve on the table index, like
+                # the reference's table-default QueryMeta.Index.
+                return store.get_index("evals"), None
+            return ev.modify_index, ev
+
+        # item_eval fires on create, update, AND delete (store.py
+        # upsert_evals/delete_eval), so the table-wide item is unnecessary
+        # — and watching it would wake every parked monitor on every
+        # unrelated eval write.
+        index, ev = blocking_query(
+            get_store=lambda: self.state_store,
+            items=lambda store: [item_eval(eval_id)],
+            run=run,
+            min_index=min_index,
+            timeout=float(args.get("timeout", 0.5)),
+        )
+        return {"eval": None if ev is None else to_dict(ev), "index": index}
+
+    def _rpc_job_get(self, args: dict):
+        """Blocking Job.GetJob (job_endpoint.go GetJob + rpc.go
+        blockingRPC)."""
+        from nomad_tpu.server.blocking import blocking_query
+        from nomad_tpu.state.store import item_job
+
+        job_id = args["job_id"]
+        min_index = int(args.get("min_index", 0))
+
+        def run(store):
+            job = store.job_by_id(job_id)
+            if job is None:
+                return store.get_index("jobs"), None
+            return job.modify_index, job
+
+        index, job = blocking_query(
+            get_store=lambda: self.state_store,
+            items=lambda store: [item_job(job_id)],
+            run=run,
+            min_index=min_index,
+            timeout=float(args.get("timeout", 0.5)),
+        )
+        return {"job": None if job is None else to_dict(job), "index": index}
 
     def _rpc_alloc_get(self, args: dict):
         alloc = self.state_store.alloc_by_id(args["alloc_id"])

@@ -314,10 +314,7 @@ class StateRestore:
 
     def alloc_restore(self, alloc: Allocation) -> None:
         t = self._tables
-        t.allocs[alloc.id] = alloc
-        t.allocs_by_job.setdefault(alloc.job_id, set()).add(alloc.id)
-        t.allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
-        t.allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+        _insert_alloc_row(t, alloc)
         t.indexes["allocs"] = max(
             t.indexes.get("allocs", 0), alloc.modify_index
         )
@@ -409,10 +406,7 @@ def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
             if existing.eval_id != alloc.eval_id:
                 t.allocs_by_eval.get(existing.eval_id, set()).discard(alloc.id)
         alloc.modify_index = index
-        t.allocs[alloc.id] = alloc
-        t.allocs_by_job.setdefault(alloc.job_id, set()).add(alloc.id)
-        t.allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
-        t.allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+        _insert_alloc_row(t, alloc)
     t.indexes["allocs"] = index
 
 
@@ -675,10 +669,15 @@ class StateStore(_StateView):
                         _insert_alloc_row(t, t.blocks[bid].materialize_pos(pos))
                 if members:
                     _exclude_block_members(t, members)
+            missing: List[str] = []
             for alloc in allocs:
                 existing = t.allocs.get(alloc.id)
                 if existing is None:
-                    raise KeyError(f"alloc not found: {alloc.id}")
+                    # A GC'd alloc must not abort the batch: the updates
+                    # already applied need their index bump and watch
+                    # notifications regardless (raise after both).
+                    missing.append(alloc.id)
+                    continue
                 new = existing.copy()
                 new.client_status = alloc.client_status
                 new.client_description = alloc.client_description
@@ -694,3 +693,5 @@ class StateStore(_StateView):
                 )
             t.indexes["allocs"] = index
         self.watch.notify(items)
+        if missing:
+            raise KeyError(f"alloc not found: {', '.join(missing)}")

@@ -1,0 +1,193 @@
+"""Generic RPC-tier blocking queries (server/blocking.py).
+
+The reference's blockingRPC (/root/reference/nomad/rpc.go:270-335) is one
+shared mechanism; here Node.GetAllocs, Eval.GetEval, and Job.GetJob all
+ride it. Includes the snapshot-rebind race: a blocking query parked on a
+store that a raft snapshot install replaces must wake and re-check against
+the live store, not sleep out its timeout on the orphan.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server import ServerConfig
+from nomad_tpu.server.blocking import blocking_query
+from nomad_tpu.server.cluster import ClusterConfig, ClusterServer, wait_for_leader
+from nomad_tpu.structs import Evaluation, generate_uuid
+
+
+@pytest.fixture
+def srv():
+    s = ClusterServer(
+        ServerConfig(scheduler_backend="host", num_schedulers=1),
+        ClusterConfig(node_id="blk-1"),
+    )
+    s.start()
+    wait_for_leader([s])
+    yield s
+    s.shutdown()
+
+
+def _call_async(fn, args):
+    out = {}
+
+    def run():
+        out["result"] = fn(args)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, out
+
+
+def test_node_get_allocs_blocks_until_write(srv):
+    node = mock.node()
+    srv.node_register(node)
+    index0 = srv.state_store.get_index("allocs")
+
+    t, out = _call_async(
+        srv._rpc_node_get_allocs,
+        {"node_id": node.id, "min_index": index0, "timeout": 8.0},
+    )
+    time.sleep(0.3)
+    assert t.is_alive()  # parked, not polling out
+
+    alloc = mock.alloc()
+    alloc.node_id = node.id
+    srv.raft.apply("alloc_update", {"allocs": [alloc]}).result()
+    t.join(5.0)
+    assert not t.is_alive()
+    assert out["result"]["index"] > index0
+    assert [a["id"] for a in out["result"]["allocs"]] == [alloc.id]
+
+
+def test_eval_get_blocks_until_status_change(srv):
+    ev = Evaluation(
+        id=generate_uuid(), priority=50, type=structs.JOB_TYPE_SERVICE,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id="j1",
+        status=structs.EVAL_STATUS_FAILED,
+    )
+    srv.raft.apply("eval_update", {"evals": [ev]}).result()
+    first = srv._rpc_eval_get({"eval_id": ev.id, "min_index": 0})
+    assert first["eval"]["status"] == structs.EVAL_STATUS_FAILED
+    index0 = first["index"]
+
+    t, out = _call_async(
+        srv._rpc_eval_get,
+        {"eval_id": ev.id, "min_index": index0, "timeout": 8.0},
+    )
+    time.sleep(0.3)
+    assert t.is_alive()
+
+    ev2 = ev.copy()
+    ev2.status = structs.EVAL_STATUS_COMPLETE
+    srv.raft.apply("eval_update", {"evals": [ev2]}).result()
+    t.join(5.0)
+    assert not t.is_alive()
+    assert out["result"]["eval"]["status"] == structs.EVAL_STATUS_COMPLETE
+    assert out["result"]["index"] > index0
+
+
+def test_job_get_blocks_until_update(srv):
+    job = mock.job()
+    srv.job_register(job)
+    first = srv._rpc_job_get({"job_id": job.id, "min_index": 0})
+    index0 = first["index"]
+    assert first["job"]["id"] == job.id
+
+    t, out = _call_async(
+        srv._rpc_job_get,
+        {"job_id": job.id, "min_index": index0, "timeout": 8.0},
+    )
+    time.sleep(0.3)
+    assert t.is_alive()
+
+    import copy
+
+    job2 = copy.deepcopy(job)
+    job2.priority = 70
+    srv.job_register(job2)
+    t.join(5.0)
+    assert not t.is_alive()
+    assert out["result"]["job"]["priority"] == 70
+
+
+def test_blocking_query_timeout_returns_last_read(srv):
+    node = mock.node()
+    srv.node_register(node)
+    index0 = srv.state_store.get_index("allocs")
+    t0 = time.monotonic()
+    out = srv._rpc_node_get_allocs(
+        {"node_id": node.id, "min_index": index0, "timeout": 0.4}
+    )
+    assert 0.3 <= time.monotonic() - t0 < 5.0
+    assert out["allocs"] is None
+    assert out["index"] == index0
+
+
+def test_snapshot_rebind_race_wakes_parked_query(srv):
+    """Park a blocking query, then install an FSM snapshot (rebinds
+    fsm.state to a fresh store). The query must wake via the old store's
+    notify_all and resolve against the NEW store's index."""
+    node = mock.node()
+    srv.node_register(node)
+
+    # Build snapshot state that already contains an alloc for the node —
+    # the new store's allocs index exceeds min_index, so after the rebind
+    # the parked query resolves immediately with the new content.
+    alloc = mock.alloc()
+    alloc.node_id = node.id
+    donor = ClusterServer(
+        ServerConfig(scheduler_backend="host", num_schedulers=1),
+        ClusterConfig(node_id="blk-donor"),
+    )
+    try:
+        donor.start()
+        wait_for_leader([donor])
+        donor.node_register(node.copy())
+        base = srv.state_store.get_index("allocs")
+        # Push the donor's alloc index past the parked query's min_index.
+        for i in range(int(base) + 1):
+            donor.raft.apply(
+                "alloc_update", {"allocs": [alloc.copy()]}
+            ).result()
+        data = donor.fsm.snapshot_bytes()
+
+        min_index = srv.state_store.get_index("allocs")
+        t, out = _call_async(
+            srv._rpc_node_get_allocs,
+            {"node_id": node.id, "min_index": min_index, "timeout": 8.0},
+        )
+        time.sleep(0.3)
+        assert t.is_alive()
+
+        srv.fsm.restore_bytes(data)  # rebind: old store orphaned
+        t.join(5.0)
+        assert not t.is_alive(), "query slept through the store rebind"
+        assert out["result"]["allocs"] is not None
+        assert [a["id"] for a in out["result"]["allocs"]] == [alloc.id]
+    finally:
+        donor.shutdown()
+
+
+def test_blocking_query_helper_semantics():
+    """Unit-level: a fresh index returns immediately, and the full query
+    runs exactly once — the index probe, not the query, drives the wait
+    decision (a query may materialize a large result)."""
+    from nomad_tpu.state import StateStore
+
+    store = StateStore()
+    store.upsert_node(3, mock.node())
+    runs = []
+    index, result = blocking_query(
+        get_store=lambda: store,
+        items=lambda s: [("table", "nodes")],
+        run=lambda s: runs.append(1) or (s.get_index("nodes"), "payload"),
+        index_of=lambda s: s.get_index("nodes"),
+        min_index=0,
+        timeout=5.0,
+    )
+    assert (index, result) == (3, "payload")
+    assert runs == [1]  # the expensive query ran exactly once
