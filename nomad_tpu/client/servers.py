@@ -91,24 +91,20 @@ class RemoteEndpoint:
             raise ValueError("RemoteEndpoint requires at least one server addr")
         self.servers = list(servers)
         random.shuffle(self.servers)
+        # One stream-multiplexed connection per server: blocking queries
+        # interleave with control traffic on the same conn (nomad_tpu/rpc.py).
         self.pool = ConnPool(timeout=timeout)
-        # Long-poll traffic rides its own connection so blocking queries
-        # don't serialize behind control traffic.
-        self.longpoll_pool = ConnPool(timeout=timeout)
 
     def shutdown(self) -> None:
         self.pool.shutdown()
-        self.longpoll_pool.shutdown()
 
-    def _call(self, method: str, args: dict, pool: Optional[ConnPool] = None,
+    def _call(self, method: str, args: dict,
               timeout: Optional[float] = None):
         last: Optional[Exception] = None
         for _ in range(len(self.servers)):
             addr = self.servers[0]
             try:
-                return (pool or self.pool).call(
-                    addr, method, args, timeout=timeout
-                )
+                return self.pool.call(addr, method, args, timeout=timeout)
             except RPCError as e:
                 last = e
                 # Rotate the failed server to the back (client.go:246-252)
@@ -149,7 +145,6 @@ class RemoteEndpoint:
         out = self._call(
             "Node.GetAllocs",
             {"node_id": node_id, "min_index": min_index, "timeout": timeout},
-            pool=self.longpoll_pool,
             timeout=timeout + 5.0,
         )
         index = int(out.get("index", 0))

@@ -1,4 +1,4 @@
-"""Network RPC: framed JSON over TCP with connection pooling.
+"""Network RPC: framed JSON over TCP with stream-multiplexed pooling.
 
 The transport tier of the reference is msgpack-RPC over yamux with a pooled
 client (/root/reference/nomad/rpc.go:21-137, nomad/pool.go). Capabilities
@@ -6,6 +6,16 @@ carried over: a single listener serving concurrent requests, client-side
 connection reuse, request/response correlation, and clean propagation of
 remote errors. Framing is length-prefixed JSON (the codec is internal to
 this framework; pickle is avoided — peers are semi-trusted).
+
+Multiplexing (yamux-lite): the seq field IS the stream id. One pooled
+connection per address carries any number of in-flight requests — the
+server dispatches each request on its own thread and writes responses
+out of order under a per-connection write lock; the client parks each
+caller on its seq and a per-connection reader demuxes responses. A
+blocking long-poll (Eval.Dequeue, blocking queries) therefore shares the
+connection with control traffic instead of requiring a second pool, which
+is the scaling answer the reference gets from yamux streams
+(nomad/rpc.go:120-137).
 
 Wire format: 4-byte big-endian length + JSON object.
 Request:  {"seq": n, "method": "Service.Method", "args": {...}}
@@ -19,10 +29,39 @@ import logging
 import socket
 import struct
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 << 20
+# Kernel-level send timeout (SO_SNDTIMEO): bounds sendall on a peer that
+# stopped reading WITHOUT touching recv (the demux reader blocks forever by
+# design). A send that trips this invalidates the connection.
+SEND_TIMEOUT = 30.0
+# Per-connection cap on in-flight server-side requests: reads from a
+# flooding peer pause (TCP backpressure) instead of spawning unbounded
+# threads.
+MAX_INFLIGHT_PER_CONN = 64
+
+
+def _set_send_timeout(sock: socket.socket, seconds: float) -> None:
+    sec = int(seconds)
+    usec = int((seconds - sec) * 1_000_000)
+    sock.setsockopt(
+        socket.SOL_SOCKET, socket.SO_SNDTIMEO, struct.pack("ll", sec, usec)
+    )
+
+
+def _hard_close(sock: socket.socket) -> None:
+    """shutdown(SHUT_RDWR) then close: plain close() does not interrupt a
+    recv blocked in another thread, and the peer would never see FIN."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 class RPCError(Exception):
@@ -67,6 +106,8 @@ class RPCServer:
         self._listener = socket.create_server((host, port))
         self.addr = "{}:{}".format(*self._listener.getsockname())
         self._shutdown = threading.Event()
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
         self._thread = threading.Thread(
             target=self._accept_loop, daemon=True, name=f"rpc-{self.addr}"
         )
@@ -83,6 +124,13 @@ class RPCServer:
             self._listener.close()
         except OSError:
             pass
+        # Close accepted connections too: parked long-poll streams on
+        # peers must fail fast, not sleep out their timeouts.
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            _hard_close(conn)
 
     def _accept_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -96,15 +144,62 @@ class RPCServer:
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        # Each request runs on its own thread; responses interleave on the
+        # shared connection under a write lock, correlated by seq — so a
+        # parked long-poll never head-of-line blocks control traffic.
+        # In-flight requests per connection are capped: acquiring the
+        # semaphore before reading the next frame applies TCP backpressure
+        # to a flooding peer instead of spawning unbounded threads.
+        write_lock = threading.Lock()
+        inflight = threading.Semaphore(MAX_INFLIGHT_PER_CONN)
+
+        def handle(req: dict) -> None:
+            try:
+                resp = self._dispatch(req)
+                try:
+                    with write_lock:
+                        _send_frame(conn, resp)
+                except (ConnectionError, OSError):
+                    pass
+                except Exception as e:
+                    # Unserializable handler result: answer with an error
+                    # frame so the caller fails fast instead of timing out.
+                    self.logger.warning(
+                        "rpc: response for %s not serializable: %s",
+                        req.get("method"), e,
+                    )
+                    err = {"seq": req.get("seq"),
+                           "error": f"response serialization failed: {e}",
+                           "result": None}
+                    try:
+                        with write_lock:
+                            _send_frame(conn, err)
+                    except Exception:
+                        _hard_close(conn)
+            finally:
+                inflight.release()
+
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _set_send_timeout(conn, SEND_TIMEOUT)
             while not self._shutdown.is_set():
-                req = _recv_frame(conn)
-                resp = self._dispatch(req)
-                _send_frame(conn, resp)
+                inflight.acquire()
+                try:
+                    req = _recv_frame(conn)
+                except BaseException:
+                    inflight.release()
+                    raise
+                threading.Thread(
+                    target=handle, args=(req,), daemon=True,
+                    name="rpc-stream",
+                ).start()
         except (ConnectionError, OSError, ValueError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def _dispatch(self, req: dict) -> dict:
@@ -122,71 +217,146 @@ class RPCServer:
                     "result": None}
 
 
+class _Waiter:
+    __slots__ = ("event", "resp")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.resp: Optional[dict] = None
+
+
+class _MuxConn:
+    """One multiplexed client connection: a reader thread demuxes
+    responses to parked callers by seq (the yamux-stream analog)."""
+
+    def __init__(self, sock: socket.socket, addr: str):
+        self.sock = sock
+        self.addr = addr
+        self.write_lock = threading.Lock()
+        self.lock = threading.Lock()
+        self.pending: Dict[int, _Waiter] = {}
+        self.dead: Optional[Exception] = None
+        threading.Thread(
+            target=self._read_loop, daemon=True, name=f"rpc-mux-{addr}"
+        ).start()
+
+    def register(self, seq: int) -> _Waiter:
+        waiter = _Waiter()
+        with self.lock:
+            if self.dead is not None:
+                raise RPCError(f"connection to {self.addr} is down: {self.dead}")
+            self.pending[seq] = waiter
+        return waiter
+
+    def forget(self, seq: int) -> None:
+        with self.lock:
+            self.pending.pop(seq, None)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                resp = _recv_frame(self.sock)
+                with self.lock:
+                    waiter = self.pending.pop(resp.get("seq"), None)
+                if waiter is not None:
+                    waiter.resp = resp
+                    waiter.event.set()
+                # Unknown seq: a response arriving after its caller timed
+                # out — dropped; the stream stays healthy.
+        except Exception as e:
+            with self.lock:
+                self.dead = e
+                pending = list(self.pending.values())
+                self.pending.clear()
+            for waiter in pending:
+                waiter.event.set()  # resp stays None -> transport error
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
 class ConnPool:
-    """Pooled RPC client connections (reference: nomad/pool.go:138-371).
-    One pooled connection per address; requests on a connection serialize
-    (sufficient at control-plane rates; the reference multiplexes instead)."""
+    """Pooled, stream-multiplexed RPC client connections (reference:
+    nomad/pool.go:138-371 + yamux). One connection per address carries all
+    concurrent requests — long-polls and control traffic interleave."""
 
     def __init__(self, timeout: float = 10.0):
         self.timeout = timeout
         self._lock = threading.Lock()
-        self._conns: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
+        self._conns: Dict[str, _MuxConn] = {}
         self._seq = 0
 
     def call(self, addr: str, method: str, args: dict,
              timeout: Optional[float] = None) -> Any:
         """RPC to addr; raises RemoteError for handler errors, RPCError for
-        transport failures (after invalidating the pooled conn)."""
-        sock, conn_lock = self._acquire(addr)
+        transport failures (after invalidating the pooled conn). A per-call
+        timeout does NOT kill the shared connection — the late response is
+        simply dropped by the demuxer."""
+        mux = self._acquire(addr)
         with self._lock:
             self._seq += 1
             seq = self._seq
+        waiter = mux.register(seq)
         try:
-            with conn_lock:
-                sock.settimeout(timeout or self.timeout)
-                _send_frame(sock, {"seq": seq, "method": method, "args": args})
-                resp = _recv_frame(sock)
+            with mux.write_lock:
+                _send_frame(mux.sock, {"seq": seq, "method": method,
+                                       "args": args})
         except (ConnectionError, OSError, ValueError) as e:
-            self._invalidate(addr)
+            mux.forget(seq)
+            self._invalidate(addr, mux)
             raise RPCError(f"rpc to {addr} failed: {e}") from e
+        if not waiter.event.wait(timeout or self.timeout):
+            mux.forget(seq)
+            raise RPCError(f"rpc to {addr} timed out: {method}")
+        resp = waiter.resp
+        if resp is None:  # reader died: transport failure
+            self._invalidate(addr, mux)
+            raise RPCError(f"rpc to {addr} failed: {mux.dead}")
         if resp.get("error"):
             raise RemoteError(resp["error"])
         return resp.get("result")
 
-    def _acquire(self, addr: str) -> Tuple[socket.socket, threading.Lock]:
+    def _acquire(self, addr: str) -> _MuxConn:
         with self._lock:
-            entry = self._conns.get(addr)
-            if entry is not None:
-                return entry
+            mux = self._conns.get(addr)
+            if mux is not None and mux.dead is None:
+                return mux
         host, port = addr.rsplit(":", 1)
         try:
             sock = socket.create_connection((host, int(port)), timeout=self.timeout)
         except OSError as e:
             raise RPCError(f"failed to connect to {addr}: {e}") from e
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        entry = (sock, threading.Lock())
+        # Kernel send timeout bounds sendall on a peer that stopped
+        # reading (the write_lock holder must never block forever);
+        # per-call deadlines are enforced by the waiter, and the demux
+        # reader blocks on recv by design.
+        sock.settimeout(None)
+        _set_send_timeout(sock, SEND_TIMEOUT)
+        mux = _MuxConn(sock, addr)
         with self._lock:
             existing = self._conns.get(addr)
-            if existing is not None:
-                sock.close()
+            if existing is not None and existing.dead is None:
+                # Lost the connect race: hard-close so the loser's already-
+                # running reader thread unblocks and exits.
+                _hard_close(sock)
                 return existing
-            self._conns[addr] = entry
-        return entry
+            self._conns[addr] = mux
+        return mux
 
-    def _invalidate(self, addr: str) -> None:
+    def _invalidate(self, addr: str, mux: Optional[_MuxConn] = None) -> None:
         with self._lock:
-            entry = self._conns.pop(addr, None)
-        if entry is not None:
-            try:
-                entry[0].close()
-            except OSError:
-                pass
+            current = self._conns.get(addr)
+            if mux is None or current is mux:
+                self._conns.pop(addr, None)
+                mux = current
+        if mux is not None:
+            _hard_close(mux.sock)
 
     def shutdown(self) -> None:
         with self._lock:
-            for sock, _ in self._conns.values():
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+            conns = list(self._conns.values())
             self._conns.clear()
+        for mux in conns:
+            _hard_close(mux.sock)

@@ -80,11 +80,10 @@ class ClusterServer(Server):
             self.logger.getChild("rpc"),
         )
         self.rpc_addr = self.rpc.addr
+        # One stream-multiplexed connection per peer carries control
+        # traffic AND long-polls (Eval.Dequeue, blocking queries) — the
+        # yamux posture (nomad/rpc.go:120-137); see nomad_tpu/rpc.py.
         self.pool = ConnPool(timeout=5.0)
-        # Long-poll traffic (Eval.Dequeue) gets its own pooled connection so
-        # blocking dequeues don't serialize behind control traffic (the
-        # reference multiplexes with yamux instead, nomad/pool.go).
-        self.longpoll_pool = ConnPool(timeout=5.0)
 
         if not self.cluster.node_id:
             self.cluster.node_id = self.config.node_name
@@ -178,7 +177,6 @@ class ClusterServer(Server):
         self.raft.shutdown()
         self.rpc.shutdown()
         self.pool.shutdown()
-        self.longpoll_pool.shutdown()
 
     def _leadership_changed(self, is_leader: bool) -> None:
         """establishLeadership / revokeLeadership (leader.go:99-140,
@@ -203,7 +201,7 @@ class ClusterServer(Server):
 
     # -- forwarding (rpc.go:163-228) ------------------------------------------
 
-    def _forward(self, method: str, args: dict, pool: Optional[ConnPool] = None,
+    def _forward(self, method: str, args: dict,
                  timeout: Optional[float] = None):
         """Forward an RPC to the current leader. Waits briefly for leader
         discovery (a follower learns the leader from the first heartbeat of a
@@ -215,9 +213,7 @@ class ClusterServer(Server):
         while True:
             leader = self.raft.leader_addr
             if leader:
-                return (pool or self.pool).call(
-                    leader, method, args, timeout=timeout
-                )
+                return self.pool.call(leader, method, args, timeout=timeout)
             if self.raft.is_leader or _time.monotonic() >= deadline:
                 raise NotLeaderError("")
             _time.sleep(0.02)
@@ -229,7 +225,7 @@ class ClusterServer(Server):
             return self.eval_broker.dequeue(schedulers, timeout)
         out = self._forward(
             "Eval.Dequeue", {"schedulers": schedulers, "timeout": timeout},
-            pool=self.longpoll_pool, timeout=timeout + 5.0,
+            timeout=timeout + 5.0,
         )
         if out.get("eval") is None:
             return None, ""
@@ -245,7 +241,7 @@ class ClusterServer(Server):
             "Eval.DequeueBatch",
             {"schedulers": schedulers, "max_batch": max_batch,
              "timeout": timeout},
-            pool=self.longpoll_pool, timeout=timeout + 5.0,
+            timeout=timeout + 5.0,
         )
         return [
             (from_dict(Evaluation, item["eval"]), item["token"])

@@ -1,0 +1,147 @@
+"""Stream-multiplexed RPC (yamux-lite, nomad_tpu/rpc.py).
+
+The reference multiplexes msgpack-RPC streams over one connection with
+yamux (/root/reference/nomad/rpc.go:120-137, nomad/pool.go); here the seq
+field is the stream id. The defining property: a parked long-poll and
+control traffic share ONE TCP connection without head-of-line blocking.
+"""
+
+import threading
+import time
+
+from nomad_tpu.rpc import ConnPool, RPCError, RPCServer, RemoteError
+
+
+def _server():
+    srv = RPCServer()
+    gate = threading.Event()
+
+    def slow(args):
+        gate.wait(args.get("wait", 5.0))
+        return "slow-done"
+
+    srv.register("Test.Slow", slow)
+    srv.register("Test.Echo", lambda a: a.get("x"))
+    srv.register("Test.Boom", lambda a: 1 / 0)
+    srv.start()
+    return srv, gate
+
+
+def test_longpoll_and_control_share_one_connection():
+    srv, gate = _server()
+    pool = ConnPool(timeout=10.0)
+    try:
+        out = {}
+
+        def longpoll():
+            out["slow"] = pool.call(srv.addr, "Test.Slow", {"wait": 6.0})
+
+        t = threading.Thread(target=longpoll, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        assert t.is_alive()
+
+        # Control traffic completes while the long-poll is parked — on the
+        # same pooled connection (the pool holds exactly one per address).
+        t0 = time.perf_counter()
+        for i in range(20):
+            assert pool.call(srv.addr, "Test.Echo", {"x": i}) == i
+        assert time.perf_counter() - t0 < 2.0
+        assert len(pool._conns) == 1  # one address, one multiplexed conn
+        assert t.is_alive()  # long-poll still parked throughout
+
+        gate.set()
+        t.join(5.0)
+        assert out["slow"] == "slow-done"
+    finally:
+        pool.shutdown()
+        srv.shutdown()
+
+
+def test_out_of_order_responses_correlate_by_seq():
+    srv, gate = _server()
+    pool = ConnPool(timeout=10.0)
+    try:
+        results = {}
+
+        def call(name, method, args):
+            results[name] = pool.call(srv.addr, method, args)
+
+        t_slow = threading.Thread(
+            target=call, args=("slow", "Test.Slow", {"wait": 6.0}), daemon=True
+        )
+        t_slow.start()
+        time.sleep(0.1)
+        t_fast = threading.Thread(
+            target=call, args=("fast", "Test.Echo", {"x": "hi"}), daemon=True
+        )
+        t_fast.start()
+        t_fast.join(3.0)
+        # The LATER request's response arrives FIRST.
+        assert results == {"fast": "hi"}
+        gate.set()
+        t_slow.join(5.0)
+        assert results["slow"] == "slow-done"
+    finally:
+        pool.shutdown()
+        srv.shutdown()
+
+
+def test_per_call_timeout_keeps_connection_alive():
+    srv, gate = _server()
+    pool = ConnPool(timeout=10.0)
+    try:
+        try:
+            pool.call(srv.addr, "Test.Slow", {"wait": 30.0}, timeout=0.3)
+            raise AssertionError("expected timeout")
+        except RPCError as e:
+            assert "timed out" in str(e)
+        # The shared connection survived the timed-out stream: control
+        # traffic keeps flowing with no reconnect.
+        mux = pool._conns[srv.addr]
+        assert pool.call(srv.addr, "Test.Echo", {"x": 1}) == 1
+        assert pool._conns[srv.addr] is mux
+    finally:
+        gate.set()
+        pool.shutdown()
+        srv.shutdown()
+
+
+def test_remote_error_propagates():
+    srv, gate = _server()
+    pool = ConnPool(timeout=5.0)
+    try:
+        try:
+            pool.call(srv.addr, "Test.Boom", {})
+            raise AssertionError("expected RemoteError")
+        except RemoteError as e:
+            assert "ZeroDivisionError" in str(e)
+    finally:
+        pool.shutdown()
+        srv.shutdown()
+
+
+def test_transport_failure_fails_all_parked_streams():
+    srv, gate = _server()
+    pool = ConnPool(timeout=10.0)
+    try:
+        errors = []
+
+        def parked():
+            try:
+                pool.call(srv.addr, "Test.Slow", {"wait": 30.0})
+            except RPCError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=parked, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        srv.shutdown()  # kills the connection under the parked streams
+        for t in threads:
+            t.join(5.0)
+        assert len(errors) == 3
+    finally:
+        gate.set()
+        pool.shutdown()
