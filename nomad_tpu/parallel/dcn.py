@@ -1,0 +1,220 @@
+"""Multi-host (DCN) scale-out of the node-axis solve.
+
+SURVEY.md §7 names the axis: "DCN via jax.distributed for multi-slice".
+The cluster-state node axis spans hosts — each host holds its shard of the
+node tensors in HBM, and ONE jitted water-fill solves globally: XLA
+inserts ICI collectives within a host's mesh row and DCN collectives
+across hosts (the placement-sum psum of the binary search, the global
+top-k of the partial round). Nothing in the kernel changes; the mesh does
+the scaling, exactly like the single-host node-axis sharding in
+parallel/mesh.py.
+
+The host-side analog in the reference is multi-region federation
+(/root/reference/nomad/server.go:503-538) — which the control plane
+implements separately; this module scales a SINGLE region's device solve
+beyond one host.
+
+On real hardware ``initialize`` attaches to the TPU pod's coordinator; the
+CPU dryrun (tests/test_dcn.py, __graft_entry__.dryrun_dcn) runs the same
+code across OS processes with gloo collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nomad_tpu.parallel.mesh import NODE_AXIS
+
+DCN_AXIS = "dcn"
+
+
+class DCNUnsupported(RuntimeError):
+    """jax.distributed cannot initialize in this environment."""
+
+
+def spawn_dcn_workers(
+    n_processes: int = 2, n_nodes: int = 256, count: int = 180,
+    timeout: float = 240.0,
+) -> Tuple[List[Dict], List[str]]:
+    """Launch the multi-process dryrun (dcn_worker.py) and collect each
+    worker's DCN_RESULT. The one launch/collect protocol shared by
+    tests/test_dcn.py and __graft_entry__.dryrun_dcn.
+
+    Worker stdout goes to temp files, not pipes — a worker blocking on a
+    full pipe mid-collective would stall the distributed barrier for
+    everyone. Raises DCNUnsupported when jax.distributed can't initialize
+    here (exit code 3), TimeoutError with collected output on a hang."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = []
+    files = []
+    try:
+        for i in range(n_processes):
+            f = tempfile.TemporaryFile(mode="w+")
+            files.append(f)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "nomad_tpu.parallel.dcn_worker",
+                 str(i), str(n_processes), str(port),
+                 str(n_nodes), str(count)],
+                stdout=f, stderr=subprocess.STDOUT, text=True, env=env,
+            ))
+        deadline = time.monotonic() + timeout
+        for p in procs:
+            p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
+        outs = [_read(f) for f in files]
+        raise TimeoutError(
+            "DCN dryrun timed out:\n" + "\n".join(outs)
+        ) from None
+    finally:
+        outs = [_read(f) for f in files]
+        for f in files:
+            f.close()
+
+    for p, out in zip(procs, outs):
+        if p.returncode == 3 or "DCN_UNSUPPORTED" in out:
+            raise DCNUnsupported(out)
+        if p.returncode != 0:
+            raise AssertionError(f"dcn worker failed (rc={p.returncode}):\n{out}")
+    results = [
+        json.loads(line[len("DCN_RESULT "):])
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("DCN_RESULT ")
+    ]
+    if len(results) != n_processes:
+        raise AssertionError("missing DCN_RESULT lines:\n" + "\n".join(outs))
+    return results, outs
+
+
+def _read(f) -> str:
+    try:
+        f.seek(0)
+        return f.read()
+    except (OSError, ValueError):
+        return ""
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int) -> None:
+    """Join the multi-host runtime. On the cpu backend the cross-process
+    collectives ride gloo (the setting is cpu-client-only, harmless under
+    TPU, where the platform's own fabric carries collectives)."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def dcn_mesh() -> Mesh:
+    """(dcn, node) mesh: the dcn axis crosses process (host) boundaries,
+    the node axis spans one host's local devices (ICI)."""
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n_proc = jax.process_count()
+    arr = np.array(devs).reshape(n_proc, -1)
+    return Mesh(arr, (DCN_AXIS, NODE_AXIS))
+
+
+def node_spec(*trailing) -> P:
+    """Node-axis partition spec spanning hosts: the node dimension shards
+    over the flattened (dcn, node) device grid."""
+    return P((DCN_AXIS, NODE_AXIS), *trailing)
+
+
+def _global(mesh: Mesh, spec: P, array: np.ndarray):
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        array.shape, sharding, lambda idx: array[idx]
+    )
+
+
+def run_dcn_solve(mesh: Mesh, n_nodes: int = 1024,
+                  count: int = 900) -> Dict[str, int]:
+    """The production water-fill (ops/binpack.solve_waterfill) over node
+    tensors globally sharded across every host's devices. Returns summary
+    scalars readable identically on every process (replicated outputs)."""
+    import jax.numpy as jnp
+
+    from nomad_tpu.ops.binpack import solve_waterfill
+
+    total_np = np.zeros((n_nodes, 4), dtype=np.int32)
+    total_np[:, 0] = 4000
+    total_np[:, 1] = 8192
+    total_np[:, 2] = 100 * 1024
+    total_np[:, 3] = 150
+
+    total = _global(mesh, node_spec(None), total_np)
+    sched_cap = _global(mesh, node_spec(None),
+                        total_np[:, :2].astype(np.float32))
+    used0 = _global(mesh, node_spec(None),
+                    np.zeros((n_nodes, 4), dtype=np.int32))
+    zeros_n = np.zeros(n_nodes, dtype=np.int32)
+    job_count0 = _global(mesh, node_spec(), zeros_n)
+    tg_count0 = _global(mesh, node_spec(), zeros_n)
+    bw_avail = _global(mesh, node_spec(),
+                       np.full(n_nodes, 1000, dtype=np.int32))
+    bw_used0 = _global(mesh, node_spec(), zeros_n)
+    eligible = _global(mesh, node_spec(), np.ones(n_nodes, dtype=bool))
+    rep = NamedSharding(mesh, P())
+    ask = jax.device_put(np.array([500, 256, 0, 0], dtype=np.int32), rep)
+    bw_ask = jax.device_put(np.int32(0), rep)
+    count_dev = jax.device_put(np.int32(count), rep)
+    penalty = jax.device_put(np.float32(10.0), rep)
+
+    with mesh:
+        counts, unplaced = solve_waterfill(
+            total, sched_cap, used0, job_count0, tg_count0, bw_avail,
+            bw_used0, eligible, ask, bw_ask, count_dev, penalty,
+            False, False,
+        )
+        placed = jax.jit(
+            lambda c: c.sum(), out_shardings=rep
+        )(counts)
+        spread = jax.jit(
+            lambda c: (c > 0).sum(), out_shardings=rep
+        )(counts)
+
+    return {
+        "n_nodes": n_nodes,
+        "count": count,
+        "placed": int(placed),
+        "unplaced": int(unplaced),
+        "nodes_used": int(spread),
+        "n_processes": jax.process_count(),
+        "n_devices": len(jax.devices()),
+        "counts_sharded_over": list(
+            map(str, counts.sharding.spec)
+        ) if hasattr(counts.sharding, "spec") else [],
+    }

@@ -173,11 +173,18 @@ def test_force_leave_removes_member_and_raft_peer():
         )
         victim = next(s for s in servers if s is not leader)
         victim_id = victim.cluster.node_id
+        survivors = [s for s in servers if s is not victim]
         victim.shutdown()
+        # The shutdown can trigger an election; force-leave must go to the
+        # CURRENT leader (its reconciliation loop commits the removal).
+        leader = wait_for_leader(survivors, timeout=30.0)
         leader.force_leave(victim_id)
         assert victim_id not in leader.cluster.peers
         _wait(
-            lambda: victim_id not in leader.raft.config.peers,
+            lambda: any(
+                s.raft.is_leader and victim_id not in s.raft.config.peers
+                for s in survivors
+            ),
             msg="raft removal after force-leave",
         )
     finally:
