@@ -83,16 +83,20 @@ def spawn_dcn_workers(
         deadline = time.monotonic() + timeout
         for p in procs:
             p.wait(timeout=max(deadline - time.monotonic(), 1.0))
-    except subprocess.TimeoutExpired:
+    except BaseException as e:
+        # ANY launch/wait failure must reap the already-spawned workers —
+        # an orphan blocks inside jax.distributed.initialize for minutes.
         for p in procs:
             if p.poll() is None:
                 p.kill()
         for p in procs:
             p.wait()
-        outs = [_read(f) for f in files]
-        raise TimeoutError(
-            "DCN dryrun timed out:\n" + "\n".join(outs)
-        ) from None
+        if isinstance(e, subprocess.TimeoutExpired):
+            outs = [_read(f) for f in files]
+            raise TimeoutError(
+                "DCN dryrun timed out:\n" + "\n".join(outs)
+            ) from None
+        raise
     finally:
         outs = [_read(f) for f in files]
         for f in files:

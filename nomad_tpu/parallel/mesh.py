@@ -62,6 +62,20 @@ _mesh_lock = threading.Lock()
 _configured_mesh: Optional[Mesh] = None
 _env_checked = False
 
+# Sharding-path observability: tests assert on these so a regression that
+# starts resharding mirror tensors per dispatch (instead of reading them
+# born-sharded) fails loudly rather than silently costing a cross-shard
+# transfer per solve. node_puts: tensors placed sharded at birth;
+# node_reshards: node-axis tensors that arrived at dispatch with the WRONG
+# sharding (should stay 0 on the warm path); replications: small per-eval
+# scalars/vectors copied to every device (bounded per dispatch).
+STATS = {"node_puts": 0, "node_reshards": 0, "replications": 0}
+
+
+def reset_stats() -> None:
+    for key in STATS:
+        STATS[key] = 0
+
 
 def configure_node_sharding(
     n_devices: Optional[int] = None, eval_parallel: int = 1
@@ -132,6 +146,7 @@ def put_node_sharded(x, trailing_dims: int = 0):
     mesh = mesh_for_nodes(n)
     if mesh is None:
         return jnp.asarray(x)
+    STATS["node_puts"] += 1
     spec = P(NODE_AXIS, *(None,) * trailing_dims)
     return jax.device_put(x, NamedSharding(mesh, spec))
 
@@ -150,17 +165,35 @@ def replicate_on_mesh(mesh: Mesh, *xs) -> tuple:
     """Replicate small tensors (asks, penalties, active masks) across the
     mesh so they can join sharded node tensors in one jit call."""
     sharding = NamedSharding(mesh, P())
-    return tuple(jax.device_put(x, sharding) for x in xs)
+    out = []
+    for x in xs:
+        if isinstance(x, jax.Array) and x.sharding == sharding:
+            out.append(x)
+        else:
+            STATS["replications"] += 1
+            out.append(jax.device_put(x, sharding))
+    return tuple(out)
 
 
 def shard_waterfill_args(mesh: Mesh, args10) -> tuple:
     """Place the 10 water-fill tensor args with node-axis shardings.
-    device_put is a no-op for args already sharded correctly (mirror
-    tensors); freshly built per-eval usage reshard once here."""
-    return tuple(
-        jax.device_put(x, NamedSharding(mesh, spec))
-        for x, spec in zip(args10, _WF_SPECS)
-    )
+
+    Mirror tensors and per-eval usage are born sharded (put_node_sharded),
+    so the node-axis args skip device_put entirely; anything arriving with
+    the wrong sharding is counted in STATS["node_reshards"] — the guardrail
+    tests hold that at zero on the warm path."""
+    out = []
+    for x, spec in zip(args10, _WF_SPECS):
+        target = NamedSharding(mesh, spec)
+        if isinstance(x, jax.Array) and x.sharding == target:
+            out.append(x)
+            continue
+        if spec and spec[0] == NODE_AXIS:
+            STATS["node_reshards"] += 1
+        else:
+            STATS["replications"] += 1
+        out.append(jax.device_put(x, target))
+    return tuple(out)
 
 
 def shard_waterfill_batch_args(mesh: Mesh, stacked10, counts, penalties):

@@ -344,3 +344,235 @@ def test_scheduler_differential_rolling_update(seed):
         results[factory_kind] = len(final)
 
     assert results["tpu"] == results["host"], f"seed {seed}: {results}"
+
+
+# ---------------------------------------------------------------------------
+# 3. System-scheduler differential: tpu-system vs host oracle
+
+
+def _random_system_job(rng):
+    constraints = []
+    if rng.random() < 0.6:
+        constraints.append(Constraint(
+            l_target="$attr.kernel.name", r_target="linux", operand="=",
+        ))
+    if rng.random() < 0.3:
+        constraints.append(Constraint(
+            l_target="$attr.driver.docker", r_target="1", operand="=",
+        ))
+    task_res = Resources(
+        cpu=int(rng.integers(20, 1500)),
+        memory_mb=int(rng.integers(16, 4096)),
+    )
+    if rng.random() < 0.3:
+        task_res.networks = [NetworkResource(mbits=int(rng.integers(1, 200)))]
+    return Job(
+        region="global",
+        id=generate_uuid(),
+        name="fuzz-sys",
+        type=structs.JOB_TYPE_SYSTEM,
+        priority=50,
+        datacenters=["dc1"] if rng.random() < 0.5 else ["dc1", "dc2"],
+        constraints=constraints,
+        task_groups=[TaskGroup(
+            name="sys",
+            count=1,
+            restart_policy=RestartPolicy(attempts=1, interval=600.0, delay=5.0),
+            tasks=[Task(name="t", driver="exec", resources=task_res)],
+        )],
+    )
+
+
+@pytest.mark.parametrize("seed", range(0, N_SCHED_SEEDS, 2))
+def test_scheduler_differential_system(seed):
+    """System (one-alloc-per-node) jobs: tpu-system must place on exactly
+    the same number of nodes as the host oracle, never more than one per
+    node (reference oracle: scheduler/system_sched_test.go)."""
+    results = {}
+    for factory_kind in ("host", "tpu"):
+        rng = np.random.default_rng(40_000 + seed)
+        n = int(rng.integers(1, 80))
+        nodes = _random_cluster(rng, n)
+        job = _random_system_job(rng)
+        factory = "system" if factory_kind == "host" else "tpu-system"
+        h = _run_eval(factory, nodes, job)
+        placed, _failed = _placed_and_failed(h)
+        _check_capacity(h, nodes)
+        # One-per-node invariant.
+        per_node = {}
+        for node in nodes:
+            live = [
+                a for a in h.state.allocs_by_node(node.id)
+                if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+            ]
+            assert len(live) <= 1, (seed, node.id, len(live))
+            per_node[node.id] = len(live)
+        results[factory_kind] = (placed, sum(per_node.values()))
+
+    assert results["tpu"] == results["host"], f"seed {seed}: {results}"
+
+
+# ---------------------------------------------------------------------------
+# 4. Port-bearing groups at scale (the small-path routing's parity contract)
+
+
+def _random_port_job(rng, count):
+    """Network asks with reserved AND dynamic ports — the inherently
+    sequential assignment the device path routes host-side
+    (network.go:136-194); parity must survive count > BATCH threshold."""
+    net = NetworkResource(mbits=int(rng.integers(1, 80)))
+    if rng.random() < 0.6:
+        net.reserved_ports = [int(rng.integers(20000, 20004))]
+    if rng.random() < 0.6:
+        net.dynamic_ports = ["http"]
+    if not net.reserved_ports and not net.dynamic_ports:
+        net.reserved_ports = [20001]
+    task_res = Resources(
+        cpu=int(rng.integers(20, 400)),
+        memory_mb=int(rng.integers(16, 512)),
+        networks=[net],
+    )
+    return Job(
+        region="global",
+        id=generate_uuid(),
+        name="fuzz-ports",
+        type=str(rng.choice([structs.JOB_TYPE_SERVICE, structs.JOB_TYPE_BATCH])),
+        priority=50,
+        datacenters=["dc1", "dc2"],
+        task_groups=[TaskGroup(
+            name="web",
+            count=count,
+            restart_policy=RestartPolicy(attempts=1, interval=600.0, delay=5.0),
+            tasks=[Task(name="t", driver="exec", resources=task_res)],
+        )],
+    )
+
+
+@pytest.mark.parametrize("seed", range(0, N_SCHED_SEEDS, 4))
+def test_scheduler_differential_ports_at_scale(seed):
+    """count > 128 (the batched-path threshold) with reserved/dynamic port
+    asks: the device factories route these through the sequential network
+    offer, and the placement count must still match the host oracle —
+    with no port collisions in committed state (allocs_fit port check)."""
+    results = {}
+    for factory_kind in ("host", "tpu"):
+        rng = np.random.default_rng(50_000 + seed)
+        n = int(rng.integers(40, 140))
+        count = int(rng.integers(129, 300))
+        nodes = _random_cluster(rng, n)
+        job = _random_port_job(rng, count)
+        factory = job.type if factory_kind == "host" else f"tpu-{job.type}"
+        h = _run_eval(factory, nodes, job)
+        placed, failed = _placed_and_failed(h)
+        _check_capacity(h, nodes)  # includes NetworkIndex port collisions
+        assert placed + failed == count, (seed, placed, failed, count)
+        # Offered networks must never reuse a (ip, reserved port) pair on a
+        # node — the same port on DIFFERENT IPs of the CIDR is legal
+        # (AssignNetwork yields per-IP, network.go:136-194).
+        for node in nodes:
+            seen = set()
+            for a in h.state.allocs_by_node(node.id):
+                if a.desired_status != structs.ALLOC_DESIRED_STATUS_RUN:
+                    continue
+                for tr in a.task_resources.values():
+                    for net in tr.networks:
+                        for port in net.reserved_ports:
+                            key = (net.ip, port)
+                            assert key not in seen, (seed, node.id, key)
+                            seen.add(key)
+        results[factory_kind] = placed
+
+    assert results["tpu"] == results["host"], f"seed {seed}: {results}"
+
+
+# ---------------------------------------------------------------------------
+# 5. Concurrent coalesced evals racing plan-apply (optimistic concurrency)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_concurrent_coalesced_race_no_overcommit(seed):
+    """Several jobs whose combined ask EXCEEDS cluster capacity are solved
+    concurrently (broker batch -> coalesced dispatch) against the same
+    snapshot; plan-apply's serialized verification must reject the
+    overflow: post-commit, no node is overcommitted and total placements
+    never exceed capacity (nomad/plan_apply.go:167-277 posture)."""
+    import time as _time
+
+    from nomad_tpu.server import Server, ServerConfig
+
+    rng = np.random.default_rng(60_000 + seed)
+    n_nodes = 8
+    per_node_cap = 4  # 4 tasks of 1000cpu on a 4000cpu node
+    capacity = n_nodes * per_node_cap
+    n_jobs = 4
+    # Each job alone fits; together they ask for 2x capacity.
+    per_job = capacity * 2 // n_jobs
+
+    srv = Server(ServerConfig(
+        scheduler_backend="tpu", num_schedulers=2, eval_batch_size=n_jobs,
+        periodic_dispatch=False, prewarm_shapes=False,
+    ))
+    try:
+        nodes = []
+        for i in range(n_nodes):
+            node = Node(
+                id=f"race-{seed}-{i}",
+                datacenter="dc1",
+                name=f"n{i}",
+                attributes={"kernel.name": "linux", "driver.exec": "1"},
+                resources=Resources(
+                    cpu=4000, memory_mb=16384, disk_mb=100_000, iops=1000,
+                ),
+                status=structs.NODE_STATUS_READY,
+            )
+            srv.raft.apply("node_register", {"node": node})
+            nodes.append(node)
+        evals = []
+        for j in range(n_jobs):
+            job = Job(
+                region="global", id=generate_uuid(), name=f"race-{j}",
+                type=structs.JOB_TYPE_BATCH, priority=50,
+                datacenters=["dc1"],
+                task_groups=[TaskGroup(
+                    name="work", count=per_job,
+                    restart_policy=RestartPolicy(
+                        attempts=0, interval=600.0, delay=1.0,
+                    ),
+                    tasks=[Task(name="t", driver="exec",
+                                resources=Resources(cpu=1000, memory_mb=64))],
+                )],
+            )
+            srv.raft.apply("job_register", {"job": job})
+            evals.append(Evaluation(
+                id=generate_uuid(), priority=50, type=job.type,
+                triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+                job_id=job.id, status=structs.EVAL_STATUS_PENDING,
+            ))
+        srv.start()
+        # One batch: all evals land at once and race through plan-apply.
+        srv.raft.apply("eval_update", {"evals": evals})
+        deadline = _time.monotonic() + 90
+        while _time.monotonic() < deadline:
+            done = [srv.state_store.eval_by_id(e.id) for e in evals]
+            if all(d is not None
+                   and d.status != structs.EVAL_STATUS_PENDING for d in done):
+                break
+            _time.sleep(0.02)
+        else:
+            raise AssertionError("evals did not finish")
+
+        total_live = 0
+        for node in nodes:
+            live = [
+                a for a in srv.state_store.allocs_by_node(node.id)
+                if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+            ]
+            total_live += len(live)
+            fit, dim, _ = structs.allocs_fit(node, live)
+            assert fit, (seed, node.id, dim, len(live))
+            assert len(live) <= per_node_cap
+        assert total_live <= capacity
+        # The winners actually landed: the race must not starve everyone.
+        assert total_live > 0
+    finally:
+        srv.shutdown()
