@@ -71,10 +71,205 @@ def _res_vec(res) -> "np.ndarray":
     return np.array(res.as_vector(), dtype=np.int64)
 
 
+class _NodeTable:
+    """Columnar view of the node set for vectorized plan verification:
+    id -> row, plus per-row totals/reserved/liveness. Cached per
+    (store_uid, nodes index) — node rows are immutable between node-table
+    writes, while usage is re-read from the snapshot every call."""
+
+    __slots__ = ("rows", "totals", "reserved", "dead", "scalar_only", "n")
+
+    def __init__(self, snap):
+        import numpy as np
+
+        nodes = snap.nodes()
+        self.n = len(nodes)
+        self.rows = {}
+        self.totals = np.zeros((self.n, 4), dtype=np.int32)
+        self.reserved = np.zeros((self.n, 4), dtype=np.int64)
+        self.dead = np.zeros(self.n, dtype=bool)
+        # reserved networks need the sequential port index: scalar path.
+        self.scalar_only = np.zeros(self.n, dtype=bool)
+        for i, node in enumerate(nodes):
+            self.rows[node.id] = i
+            if node.resources is not None:
+                self.totals[i] = node.resources.as_vector()
+            if node.status != "ready" or node.drain:
+                self.dead[i] = True
+            if node.reserved is not None:
+                self.reserved[i] = node.reserved.as_vector()
+                if node.reserved.networks:
+                    self.scalar_only[i] = True
+
+
+_NODE_TABLE_LOCK = threading.Lock()
+_NODE_TABLE_CACHE: "OrderedDict" = None  # type: ignore[assignment]
+
+
+def _node_table(snap):
+    """Cached _NodeTable for a snapshot, or None for states without the
+    store internals (protocol-only fakes)."""
+    import collections
+
+    global _NODE_TABLE_CACHE
+    uid = getattr(snap, "store_uid", "")
+    if not uid or not hasattr(snap, "alloc_blocks"):
+        return None
+    key = (uid, snap.get_index("nodes"))
+    with _NODE_TABLE_LOCK:
+        if _NODE_TABLE_CACHE is None:
+            _NODE_TABLE_CACHE = collections.OrderedDict()
+        table = _NODE_TABLE_CACHE.get(key)
+        if table is not None:
+            _NODE_TABLE_CACHE.move_to_end(key)
+            return table
+    table = _NodeTable(snap)
+    with _NODE_TABLE_LOCK:
+        _NODE_TABLE_CACHE[key] = table
+        while len(_NODE_TABLE_CACHE) > 4:
+            _NODE_TABLE_CACHE.popitem(last=False)
+    return table
+
+
+class _AskAccum:
+    """Per-node resource ask of a plan's columnar batches and update
+    deltas. Holds batch references; materializes either a dense row array
+    (``to_rows``, one np.add.at per batch — the bulk verifier's form) or a
+    lazy per-node dict (``get`` — the scalar fallback's form, built only
+    when a small plan actually reads it). Unknown node ids keep their
+    vectors in the dict form, so a plan targeting a deregistered node
+    still fails its fit check instead of riding the evict-only shortcut."""
+
+    def __init__(self):
+        self.batches = []  # (node_ids, node_counts, vec)
+        self.deltas = {}   # nid -> int64[4]
+        self.node_ids = set()
+        self._dict = None
+
+    def add_batch(self, node_ids, node_counts, vec) -> None:
+        self.node_ids.update(node_ids)
+        self.batches.append((node_ids, node_counts, vec))
+        self._dict = None
+
+    def add_delta(self, nid: str, delta) -> None:
+        self.node_ids.add(nid)
+        prev = self.deltas.get(nid)
+        self.deltas[nid] = delta if prev is None else prev + delta
+        self._dict = None
+
+    def get(self, nid: str):
+        """Summed ask vector for one node, or None when untouched."""
+        if nid not in self.node_ids:
+            return None
+        if self._dict is None:
+            acc = {}
+            for node_ids, node_counts, vec in self.batches:
+                for run_nid, cnt in zip(node_ids, node_counts):
+                    prev = acc.get(run_nid)
+                    acc[run_nid] = (
+                        vec * cnt if prev is None else prev + vec * cnt
+                    )
+            for d_nid, delta in self.deltas.items():
+                prev = acc.get(d_nid)
+                acc[d_nid] = delta if prev is None else prev + delta
+            self._dict = acc
+        return self._dict.get(nid)
+
+    def to_rows(self, table):
+        """Dense [N, 4] int64 ask over node-table rows (or None if no
+        contributions); unknown node ids drop out — the bulk verifier
+        already answers False for them."""
+        import numpy as np
+
+        if not self.batches and not self.deltas:
+            return None
+        arr = np.zeros((table.n, 4), dtype=np.int64)
+        get = table.rows.get
+        for node_ids, node_counts, vec in self.batches:
+            rows = np.fromiter(
+                (get(nid, -1) for nid in node_ids), dtype=np.int64,
+                count=len(node_ids),
+            )
+            counts = np.asarray(node_counts, dtype=np.int64)
+            valid = rows >= 0
+            np.add.at(arr, rows[valid], vec[None, :] * counts[valid, None])
+        for nid, delta in self.deltas.items():
+            row = get(nid)
+            if row is not None:
+                arr[row] += delta
+        return arr
+
+
+class _AllocVecCache:
+    """Identity-keyed (resources, task_resources) -> (vec, has_networks)
+    cache shared by both bulk verifiers: the TPU scheduler's lean path
+    aliases one Resources object across a task group's allocs, collapsing
+    per-alloc attribute walks into dict hits."""
+
+    def __init__(self):
+        self.vec = {}
+        self.net = {}
+
+    def row(self, alloc):
+        key = id(alloc.resources)
+        vec = self.vec.get(key)
+        if vec is None:
+            vec = _res_vec(alloc.resources)
+            self.vec[key] = vec
+        nkey = (key, id(alloc.task_resources))
+        has_net = self.net.get(nkey)
+        if has_net is None:
+            has_net = bool(
+                alloc.resources is not None and alloc.resources.networks
+            )
+            if not has_net and alloc.task_resources:
+                has_net = any(
+                    tr is not None and tr.networks
+                    for tr in alloc.task_resources.values()
+                )
+            self.net[nkey] = has_net
+        return vec, has_net
+
+    def sum_counted(self, allocs, removed=None):
+        """Identity-counted resource sum of ``allocs`` (minus ``removed``
+        ids). Returns (vec or None, bail) — bail True when any alloc
+        carries network asks (sequential port semantics)."""
+        counts = {}
+        for alloc in allocs:
+            if removed is not None and alloc.id in removed:
+                continue
+            key = (id(alloc.resources), id(alloc.task_resources))
+            n = counts.get(key)
+            if n is None:
+                _vec, has_net = self.row(alloc)
+                if has_net:
+                    return None, True
+                counts[key] = 1
+            else:
+                counts[key] = n + 1
+        total = None
+        for key, n in counts.items():
+            add = self.vec[key[0]] * n
+            total = add if total is None else total + add
+        return total, False
+
+
+def _block_has_net(blk) -> bool:
+    has_net = bool(blk.resources is not None and blk.resources.networks)
+    if not has_net and blk.task_resources:
+        has_net = any(
+            tr is not None and tr.networks
+            for tr in blk.task_resources.values()
+        )
+    return has_net
+
+
 def _existing_block_usage(snap):
     """Per-node usage of stored columnar blocks: {node_id: int64[4]}, plus
     the set of nodes whose blocks carry network asks (those fall back to
-    the scalar path). O(runs), no materialization."""
+    the scalar path). O(runs), no materialization. Dict form — the
+    table-less fallback; the vectorized verifier uses
+    _existing_block_usage_rows."""
     import numpy as np
 
     usage = {}
@@ -82,13 +277,7 @@ def _existing_block_usage(snap):
     getter = getattr(snap, "alloc_blocks", None)
     blocks = getter() if getter is not None else []
     for blk in blocks:
-        has_net = bool(blk.resources is not None and blk.resources.networks)
-        if not has_net and blk.task_resources:
-            has_net = any(
-                tr is not None and tr.networks
-                for tr in blk.task_resources.values()
-            )
-        if has_net:
+        if _block_has_net(blk):
             net_nodes.update(nid for nid, _ in blk.live_node_counts())
             continue
         vec = np.asarray(blk.resource_vector(), dtype=np.int64)
@@ -98,17 +287,155 @@ def _existing_block_usage(snap):
     return usage, net_nodes, blocks
 
 
-def _prevaluate_nodes_bulk(snap, plan: Plan, batch_ask=None):
-    """Bulk-verify the network-free nodes of a large plan with the native
-    kernels (nomad_tpu.native): one scatter-add of every placement's
-    resource row + one vectorized superset check, instead of per-node
-    AllocsFit object walks. Nodes with any network asks (port collisions
-    need the sequential NetworkIndex, funcs.go:73-86) or that fail here in
-    a way the scalar path must diagnose stay out of the returned map and
-    fall through to evaluate_node_plan. ``batch_ask`` maps node_id to the
-    summed int64 resource vector of columnar (AllocBatch) placements.
-    Returns {node_id: fit}.
-    """
+def _existing_block_usage_rows(snap, table):
+    """Vectorized block usage over node-table rows: (usage[N,4] int64 or
+    None, net_rows bool[N] or None, blocks). One np.add.at per block."""
+    import numpy as np
+
+    blocks = snap.alloc_blocks()
+    usage = None
+    net_rows = None
+    get = table.rows.get
+    for blk in blocks:
+        if _block_has_net(blk):
+            if net_rows is None:
+                net_rows = np.zeros(table.n, dtype=bool)
+            for nid, _cnt in blk.live_node_counts():
+                row = get(nid)
+                if row is not None:
+                    net_rows[row] = True
+            continue
+        vec = np.asarray(blk.resource_vector(), dtype=np.int64)
+        if usage is None:
+            usage = np.zeros((table.n, 4), dtype=np.int64)
+        if blk.excluded:
+            pairs = list(blk.live_node_counts())
+            nids = [p[0] for p in pairs]
+            counts = np.asarray([p[1] for p in pairs], dtype=np.int64)
+        else:
+            nids = blk.node_ids
+            counts = np.asarray(blk.node_counts, dtype=np.int64)
+        rows = np.fromiter(
+            (get(nid, -1) for nid in nids), dtype=np.int64, count=len(nids)
+        )
+        valid = rows >= 0
+        np.add.at(usage, rows[valid], vec[None, :] * counts[valid, None])
+    return usage, net_rows, blocks
+
+
+def _prevaluate_nodes_bulk(snap, plan: Plan, ask: _AskAccum = None,
+                           table=None):
+    """Bulk-verify the network-free nodes of a large plan: vectorized
+    accumulation over the cached node table (one scatter-add per batch,
+    per-node python only where object rows exist) + one native superset
+    check. Nodes with any network asks (port collisions need the
+    sequential NetworkIndex, funcs.go:73-86) stay out of the returned map
+    and fall through to evaluate_node_plan. Returns {node_id: fit}."""
+    if table is None:
+        table = _node_table(snap)
+    if ask is None:
+        import numpy as np
+
+        ask = _AskAccum()
+        for b in plan.alloc_batches:
+            ask.add_batch(
+                b.node_ids, b.node_counts,
+                np.asarray(b.resource_vector(), dtype=np.int64),
+            )
+    if table is None:
+        batch_dict = {}
+        for nid in ask.node_ids:
+            vec = ask.get(nid)
+            if vec is not None:
+                batch_dict[nid] = vec
+        return _prevaluate_nodes_bulk_dict(snap, plan, batch_dict)
+    return _prevaluate_nodes_bulk_rows(snap, plan, ask, table)
+
+
+def _prevaluate_nodes_bulk_rows(snap, plan: Plan, ask: _AskAccum, table):
+    import numpy as np
+
+    from nomad_tpu import native
+
+    out = {}
+    ids = [nid for nid, placed in plan.node_allocation.items() if placed]
+    in_alloc = plan.node_allocation
+    ids.extend(nid for nid in ask.node_ids if nid not in in_alloc)
+
+    block_usage, net_rows, blocks = _existing_block_usage_rows(snap, table)
+    obj_nodes = snap.nodes_with_object_allocs()
+    ask_arr = ask.to_rows(table)
+
+    # Per-node python only where object rows force it (placement lists or
+    # existing object allocs); pure columnar nodes ride the arrays.
+    cache = _AllocVecCache()
+    rows_get = table.rows.get
+    dead = table.dead
+    scalar_only = table.scalar_only
+    kept_ids = []
+    kept_rows = []
+    adjust = {}  # position in kept -> extra int64[4]
+
+    for nid in ids:
+        row = rows_get(nid)
+        if row is None or dead[row]:
+            out[nid] = False
+            continue
+        if scalar_only[row] or (net_rows is not None and net_rows[row]):
+            continue  # sequential port semantics: scalar path
+        placements = plan.node_allocation.get(nid, ())
+        extra = None
+        if placements:
+            extra, bail = cache.sum_counted(placements)
+            if bail:
+                continue
+        if nid in obj_nodes:
+            existing = filter_terminal_allocs(
+                snap.allocs_by_node_objects(nid)
+            )
+            removed = {a.id for a in plan.node_update.get(nid, ())}
+            removed.update(a.id for a in placements)
+            ex_vec, bail = cache.sum_counted(existing, removed)
+            if bail:
+                continue
+            if ex_vec is not None:
+                extra = ex_vec if extra is None else extra + ex_vec
+        if block_usage is not None and plan.node_update.get(nid):
+            # Evictions of block members are invisible to the object walk:
+            # subtract them here (stale ids subtract nothing).
+            for a in plan.node_update[nid]:
+                if any(blk.find(a.id) is not None for blk in blocks):
+                    sub = -_res_vec(a.resources)
+                    extra = sub if extra is None else extra + sub
+        if extra is not None:
+            adjust[len(kept_ids)] = extra
+        kept_ids.append(nid)
+        kept_rows.append(row)
+
+    if not kept_ids:
+        return out
+
+    rows_arr = np.asarray(kept_rows, dtype=np.int64)
+    used = table.reserved[rows_arr].copy()
+    if block_usage is not None:
+        used += block_usage[rows_arr]
+    if ask_arr is not None:
+        used += ask_arr[rows_arr]
+    for pos, extra in adjust.items():
+        used[pos] += extra
+    fit, _exhausted = native.fit_check(
+        np.minimum(used, 2**31 - 1).astype(np.int32),
+        table.totals[rows_arr],
+    )
+    for nid, ok in zip(kept_ids, fit.tolist()):
+        out[nid] = ok
+    return out
+
+
+def _prevaluate_nodes_bulk_dict(snap, plan: Plan, batch_ask=None):
+    """Table-less fallback of the bulk verifier (states without the store
+    internals): the per-node python walk. ``batch_ask`` maps node_id to
+    the summed int64 resource vector of columnar placements."""
     import numpy as np
 
     from nomad_tpu import native
@@ -140,31 +467,7 @@ def _prevaluate_nodes_bulk(snap, plan: Plan, batch_ask=None):
     totals_rows = []
     base_rows = []
     kept = []  # node ids eligible for the bulk check, in row order
-
-    # Shared-object caches: the TPU scheduler's lean path aliases one
-    # Resources / task_resources object across a task group's allocs, so
-    # these collapse 100k attribute walks into dict hits.
-    vec_cache = {}
-    net_cache = {}
-
-    def alloc_row(alloc):
-        """(vec, has_networks) for one allocation, cached by identity."""
-        key = id(alloc.resources)
-        vec = vec_cache.get(key)
-        if vec is None:
-            vec = _res_vec(alloc.resources)
-            vec_cache[key] = vec
-        nkey = (key, id(alloc.task_resources))
-        has_net = net_cache.get(nkey)
-        if has_net is None:
-            has_net = bool(alloc.resources is not None and alloc.resources.networks)
-            if not has_net and alloc.task_resources:
-                has_net = any(
-                    tr is not None and tr.networks
-                    for tr in alloc.task_resources.values()
-                )
-            net_cache[nkey] = has_net
-        return vec, has_net
+    cache = _AllocVecCache()
 
     for nid in ids:
         node = snap.node_by_id(nid)
@@ -189,55 +492,19 @@ def _prevaluate_nodes_bulk(snap, plan: Plan, batch_ask=None):
                 if evicted is not None:
                     base = base - evicted
         existing = filter_terminal_allocs(read_objects(nid))
-        bail = False
         if existing:
             removed = {a.id for a in plan.node_update.get(nid, [])}
             removed.update(a.id for a in placements)
-            # Identity-counted accumulation: existing allocs share a few
-            # Resources objects, so this is dict hits + one multiply-add
-            # per distinct shape instead of a numpy add per alloc. Keyed
-            # by the (resources, task_resources) pair — has_net depends on
-            # both (alloc_row's net_cache key).
-            ex_counts = {}
-            for alloc in existing:
-                if alloc.id in removed:
-                    continue
-                key = (id(alloc.resources), id(alloc.task_resources))
-                n = ex_counts.get(key)
-                if n is None:
-                    _vec, has_net = alloc_row(alloc)
-                    if has_net:
-                        bail = True
-                        break
-                    ex_counts[key] = 1
-                else:
-                    ex_counts[key] = n + 1
-            if not bail:
-                for key, n in ex_counts.items():
-                    base = base + vec_cache[key[0]] * n
-        if bail:
-            continue
+            ex_vec, bail = cache.sum_counted(existing, removed)
+            if bail:
+                continue
+            if ex_vec is not None:
+                base = base + ex_vec
 
-        # Placements overwhelmingly alias a handful of Resources objects
-        # (one per task group); count per distinct object, then one
-        # multiply-accumulate per distinct ask shape.
-        counts = {}
-        for alloc in placements:
-            key = (id(alloc.resources), id(alloc.task_resources))
-            n = counts.get(key)
-            if n is None:
-                vec, has_net = alloc_row(alloc)
-                if has_net:
-                    bail = True
-                    break
-                counts[key] = 1
-            else:
-                counts[key] = n + 1
+        pl_vec, bail = cache.sum_counted(placements)
         if bail:
             continue
-        ask = base
-        for key, n in counts.items():
-            ask = ask + vec_cache[key[0]] * n
+        ask = base if pl_vec is None else base + pl_vec
 
         kept.append(nid)
         totals_rows.append(_res_vec(node.resources))
@@ -271,13 +538,14 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
         failed_allocs=plan.failed_allocs,
     )
 
-    # Per-node resource ask of the columnar placements.
-    batch_ask = {}
+    # Per-node resource ask of the columnar placements, held by reference
+    # and materialized per consumer (dense rows for the bulk verifier, a
+    # lazy dict for the scalar fallback).
+    table = _node_table(snap)
+    batch_ask = _AskAccum()
     for b in plan.alloc_batches:
         vec = np.asarray(b.resource_vector(), dtype=np.int64)
-        for nid, cnt in zip(b.node_ids, b.node_counts):
-            prev = batch_ask.get(nid)
-            batch_ask[nid] = vec * cnt if prev is None else prev + vec * cnt
+        batch_ask.add_batch(b.node_ids, b.node_counts, vec)
 
     # In-place update batches contribute their per-node (new - old)
     # resource delta; delta-free nodes only need a liveness check. Wire-
@@ -307,16 +575,14 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
         for key, cnt in counts.items():
             delta = (new_vec - old_vecs[key]) * cnt
             if np.any(delta):
-                nid = key[0]
-                prev = batch_ask.get(nid)
-                batch_ask[nid] = delta if prev is None else prev + delta
+                batch_ask.add_delta(key[0], delta)
 
     bulk_fit = {}
     n_placements = sum(len(v) for v in plan.node_allocation.values())
     n_placements += sum(b.n for b in plan.alloc_batches)
     n_placements += sum(b.n for b in plan.update_batches)
     if n_placements >= FAST_VERIFY_THRESHOLD:
-        bulk_fit = _prevaluate_nodes_bulk(snap, plan, batch_ask)
+        bulk_fit = _prevaluate_nodes_bulk(snap, plan, batch_ask, table)
 
     def batch_res(node_id):
         vec = batch_ask.get(node_id)
@@ -331,13 +597,13 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
 
     fits = {}
     node_ids = (set(plan.node_update) | set(plan.node_allocation)
-                | set(batch_ask) | upd_nodes)
+                | batch_ask.node_ids | upd_nodes)
     for node_id in node_ids:
         fit = bulk_fit.get(node_id)
         if fit is None:
             if (node_id in upd_nodes
                     and not plan.node_allocation.get(node_id)
-                    and node_id not in batch_ask
+                    and node_id not in batch_ask.node_ids
                     and not plan.node_update.get(node_id)):
                 fit = _node_live(snap, node_id)
             else:

@@ -220,6 +220,11 @@ class _StateView:
         """Object-table rows only (the complement of alloc_blocks())."""
         return list(self._t.allocs.values())
 
+    def nodes_with_object_allocs(self) -> Set[str]:
+        """Node ids holding at least one object-table alloc row — lets the
+        vectorized plan verifier walk objects only where objects exist."""
+        return {nid for nid, ids in self._t.allocs_by_node.items() if ids}
+
     def allocs_by_job(self, job_id: str) -> List[Allocation]:
         ids = self._t.allocs_by_job.get(job_id, set())
         out = [self._t.allocs[i] for i in ids]

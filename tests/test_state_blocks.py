@@ -276,6 +276,26 @@ def test_pickle_drops_materialize_cache():
     assert len(data) < len(pickle.dumps(blk.materialize())) / 2
 
 
+def test_small_batch_plan_on_deregistered_node_rejected():
+    """A plan below the bulk threshold whose batch targets a node that was
+    deregistered after the scheduler's snapshot must be rejected with a
+    refresh, not committed via the evict-only shortcut."""
+    from nomad_tpu.server.plan_apply import evaluate_plan
+    from nomad_tpu.structs import Plan
+
+    store, nodes, job = _seeded_store(2)
+    gone = nodes[0]
+    batch = _mk_batch(job, [gone.id, nodes[1].id], [1, 1])
+    store.delete_node(90, gone.id)  # raced deregistration
+
+    plan = Plan(eval_id="ev-x", alloc_batches=[batch])
+    result = evaluate_plan(store.snapshot(), plan)
+    committed = [nid for b in result.alloc_batches for nid in b.node_ids]
+    assert gone.id not in committed
+    assert committed == [nodes[1].id]
+    assert result.refresh_index > 0
+
+
 def test_block_commit_fires_node_watch():
     store, nodes, job = _seeded_store()
     fired = threading.Event()
