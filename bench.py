@@ -342,13 +342,16 @@ def _eval_once(state, job, factory, alloc_index):
             for lst in result.node_allocation.values():
                 allocs.extend(lst)
                 applied["placed"] += len(lst)
-            for b in result.alloc_batches:
-                allocs.extend(b.materialize())
-                applied["placed"] += b.n
-            for b in result.update_batches:
-                allocs.extend(b.materialize())
             if allocs:
                 state.upsert_allocs(alloc_index, allocs)
+            # Columnar results commit columnar, exactly like the FSM.
+            if result.alloc_batches:
+                state.upsert_alloc_blocks(alloc_index, result.alloc_batches)
+                applied["placed"] += sum(b.n for b in result.alloc_batches)
+            if result.update_batches:
+                state.apply_update_batches(
+                    alloc_index, result.update_batches
+                )
             return result, None
 
         def update_eval(self, ev):

@@ -11,7 +11,14 @@ from __future__ import annotations
 from typing import Any, Dict
 
 from nomad_tpu.api.codec import from_dict, to_dict
-from nomad_tpu.structs import AllocBatch, Allocation, Evaluation, Job, Node
+from nomad_tpu.structs import (
+    AllocBatch,
+    Allocation,
+    AllocUpdateBatch,
+    Evaluation,
+    Job,
+    Node,
+)
 
 # msg_type -> {payload_field: element_dataclass or None for plain values}
 _SCHEMAS: Dict[str, Dict[str, Any]] = {
@@ -23,7 +30,8 @@ _SCHEMAS: Dict[str, Dict[str, Any]] = {
     "job_deregister": {"job_id": None},
     "eval_update": {"evals": [Evaluation]},
     "eval_delete": {"evals": None, "allocs": None},
-    "alloc_update": {"allocs": [Allocation], "alloc_batches": "blocks"},
+    "alloc_update": {"allocs": [Allocation], "alloc_batches": "blocks",
+                     "update_batches": "ubatches"},
     "alloc_client_update": {"allocs": [Allocation]},
 }
 
@@ -31,9 +39,10 @@ _SCHEMAS: Dict[str, Dict[str, Any]] = {
 def encode_payload(msg_type: str, payload: dict) -> dict:
     out = {}
     for k, v in payload.items():
-        if _SCHEMAS.get(msg_type, {}).get(k) == "blocks":
-            # Columnar batches carry their own compact wire form — runs +
-            # one hex id block, never per-Allocation rows.
+        spec = _SCHEMAS.get(msg_type, {}).get(k)
+        if spec in ("blocks", "ubatches"):
+            # Columnar batches carry their own compact wire form — runs/id
+            # lists + shared fields, never per-Allocation rows.
             out[k] = [b.to_wire() for b in v]
         else:
             out[k] = to_dict(v)
@@ -53,6 +62,10 @@ def decode_payload(msg_type: str, payload: dict) -> dict:
             # Decode to plain batches; the FSM stamps indexes and the
             # deterministic block id at upsert (state/blocks.py from_batch).
             out[key] = [AllocBatch.from_wire(v) for v in value]
+        elif spec == "ubatches":
+            # Wire form carries member ids; the FSM resolves them against
+            # its own store at apply (deterministic across replicas).
+            out[key] = [AllocUpdateBatch.from_wire(v) for v in value]
         elif isinstance(spec, list):
             out[key] = [from_dict(spec[0], v) for v in value]
         else:

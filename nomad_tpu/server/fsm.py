@@ -101,6 +101,11 @@ class FSM:
         batches = payload.get("alloc_batches") or []
         if batches:
             self.state.upsert_alloc_blocks(index, batches)
+        # Columnar in-place updates: whole-block field swaps where a batch
+        # covers a stored block, row re-stamps elsewhere.
+        ubatches = payload.get("update_batches") or []
+        if ubatches:
+            self.state.apply_update_batches(index, ubatches)
 
     def _apply_alloc_client_update(self, index: int, payload: dict) -> None:
         self.state.update_allocs_from_client(index, payload["allocs"])

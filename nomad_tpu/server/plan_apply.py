@@ -541,7 +541,6 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
     # Per-node resource ask of the columnar placements, held by reference
     # and materialized per consumer (dense rows for the bulk verifier, a
     # lazy dict for the scalar fallback).
-    table = _node_table(snap)
     batch_ask = _AskAccum()
     for b in plan.alloc_batches:
         vec = np.asarray(b.resource_vector(), dtype=np.int64)
@@ -582,7 +581,11 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
     n_placements += sum(b.n for b in plan.alloc_batches)
     n_placements += sum(b.n for b in plan.update_batches)
     if n_placements >= FAST_VERIFY_THRESHOLD:
-        bulk_fit = _prevaluate_nodes_bulk(snap, plan, batch_ask, table)
+        # The node table is only worth building (or cache-fetching) for
+        # plans large enough to ride the bulk verifier.
+        bulk_fit = _prevaluate_nodes_bulk(
+            snap, plan, batch_ask, _node_table(snap)
+        )
 
     def batch_res(node_id):
         vec = batch_ask.get(node_id)
@@ -639,16 +642,14 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
 
 
 def _object_allocs(result: PlanResult) -> list:
-    """The object-row part of a committed plan. Columnar placement batches
-    stay columnar all the way into the state store (state/blocks.py);
-    update batches re-stamp existing rows and materialize here."""
+    """The object-row part of a committed plan. Columnar placement AND
+    update batches stay columnar all the way into the state store
+    (state/blocks.py; FSM applies update batches as block field swaps)."""
     allocs: list = []
     for update_list in result.node_update.values():
         allocs.extend(update_list)
     for alloc_list in result.node_allocation.values():
         allocs.extend(alloc_list)
-    for batch in result.update_batches:
-        allocs.extend(batch.materialize())
     allocs.extend(result.failed_allocs)
     return allocs
 
@@ -746,6 +747,8 @@ class PlanApplier(threading.Thread):
         payload = {"allocs": allocs}
         if result.alloc_batches:
             payload["alloc_batches"] = result.alloc_batches
+        if result.update_batches:
+            payload["update_batches"] = result.update_batches
         future = self.raft.apply("alloc_update", payload)
         telemetry.measure_since(("plan", "submit"), t0)
         if snap is not None:
@@ -762,6 +765,8 @@ class PlanApplier(threading.Thread):
                 snap.upsert_allocs(idx, allocs)
             if result.alloc_batches:
                 snap.upsert_alloc_blocks(idx, result.alloc_batches)
+            if result.update_batches:
+                snap.apply_update_batches(idx, result.update_batches)
         return future
 
     def _async_plan_wait(self, wait_event, future, result, pending: PendingPlan):

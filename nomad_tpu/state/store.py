@@ -24,6 +24,8 @@ from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
 from nomad_tpu.state.blocks import StoredAllocBlock
 from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_DESIRED_STATUS_RUN,
     AllocBatch,
     Allocation,
     Evaluation,
@@ -54,6 +56,12 @@ def item_eval(eval_id: str) -> WatchItem:
 
 
 def item_alloc(alloc_id: str) -> WatchItem:
+    """Single-alloc watch item. Granularity contract: individual
+    operations (object-row writes, per-member promotion/deletion) fire
+    this; BULK columnar transitions (block commit, whole-block in-place
+    swap, whole-eval reap) fire only container items (job/eval/node) —
+    per-member fan-out would cost O(placements) per commit. Endpoints that
+    long-poll one alloc must watch its node or job item."""
     return ("alloc", alloc_id)
 
 
@@ -289,6 +297,9 @@ class StateSnapshot(_StateView):
     def upsert_alloc_blocks(self, index: int, batches) -> None:
         _upsert_alloc_blocks(self._t, index, batches)
 
+    def apply_update_batches(self, index: int, batches) -> None:
+        _apply_update_batches(self._t, index, batches)
+
 
 class StateRestore:
     """Bulk loader used by FSM snapshot restore
@@ -413,6 +424,90 @@ def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
         alloc.modify_index = index
         _insert_alloc_row(t, alloc)
     t.indexes["allocs"] = index
+
+
+def _apply_update_batches(t: _Tables, index: int, batches) -> List[WatchItem]:
+    """Columnar in-place updates: whole-block field swap when a batch
+    covers all live members of a stored block; promotion for partial
+    coverage; row re-stamp for object allocs. Returns watch items."""
+    items: List[WatchItem] = [item_table("allocs")]
+    for b in batches:
+        members: Dict[str, Set[int]] = {}
+        object_rows: List[Allocation] = []
+        for alloc_or_id in (b.allocs or b.alloc_ids):
+            aid = (alloc_or_id if isinstance(alloc_or_id, str)
+                   else alloc_or_id.id)
+            row = t.allocs.get(aid)
+            if row is not None:
+                object_rows.append(row)
+                continue
+            found = _find_block_member(t, aid)
+            if found is not None:
+                members.setdefault(found[0], set()).add(found[1])
+            # Unknown ids: removed while the plan was in flight — exactly
+            # the staleness plan evaluation tolerates.
+        for bid, positions in members.items():
+            blk = t.blocks[bid]
+            if len(positions) == blk.n_live:
+                # Whole block: O(1) field swap, re-keyed by eval/job.
+                new_blk = blk.with_update(
+                    b.job, b.resources, b.task_resources,
+                    b.metrics, b.eval_id, index,
+                )
+                t.blocks[bid] = new_blk
+                if new_blk.eval_id != blk.eval_id:
+                    ids = t.blocks_by_eval.get(blk.eval_id)
+                    if ids is not None:
+                        ids.discard(bid)
+                        if not ids:
+                            del t.blocks_by_eval[blk.eval_id]
+                    t.blocks_by_eval.setdefault(
+                        new_blk.eval_id, set()).add(bid)
+                if new_blk.job_id != blk.job_id:
+                    ids = t.blocks_by_job.get(blk.job_id)
+                    if ids is not None:
+                        ids.discard(bid)
+                        if not ids:
+                            del t.blocks_by_job[blk.job_id]
+                    t.blocks_by_job.setdefault(
+                        new_blk.job_id, set()).add(bid)
+                items.append(item_alloc_job(new_blk.job_id))
+                items.append(item_alloc_eval(blk.eval_id))
+                items.append(item_alloc_eval(new_blk.eval_id))
+                items.extend(item_alloc_node(n) for n in new_blk.node_ids)
+            else:
+                for pos in positions:
+                    object_rows.append(blk.materialize_pos(pos))
+                _exclude_block_members(t, {bid: positions})
+        for existing in object_rows:
+            new = existing.copy()
+            new.eval_id = b.eval_id
+            new.job = b.job
+            new.job_id = b.job.id if b.job is not None else new.job_id
+            if b.resources is not None:
+                new.resources = b.resources
+            if b.task_resources:
+                new.task_resources = b.task_resources
+            new.metrics = b.metrics
+            new.desired_status = ALLOC_DESIRED_STATUS_RUN
+            new.desired_description = ""
+            new.client_status = ALLOC_CLIENT_STATUS_PENDING
+            new.modify_index = index
+            if existing.id not in t.allocs:
+                new.create_index = existing.create_index or index
+            if existing.eval_id != new.eval_id:
+                ids = t.allocs_by_eval.get(existing.eval_id)
+                if ids is not None:
+                    ids.discard(existing.id)
+            _insert_alloc_row(t, new)
+            items.extend([
+                item_alloc(new.id),
+                item_alloc_job(new.job_id),
+                item_alloc_node(new.node_id),
+                item_alloc_eval(new.eval_id),
+            ])
+    t.indexes["allocs"] = index
+    return items
 
 
 def _upsert_alloc_blocks(t: _Tables, index: int, batches) -> List[WatchItem]:
@@ -647,6 +742,17 @@ class StateStore(_StateView):
         expansion); blocking queries on the touched nodes/job/eval fire."""
         with self._lock:
             items = _upsert_alloc_blocks(self._t, index, batches)
+        self.watch.notify(items)
+
+    def apply_update_batches(self, index: int, batches) -> None:
+        """Commit columnar in-place updates (AllocUpdateBatch). A batch
+        covering ALL live members of a stored block applies as one block
+        field swap (state/blocks.py with_update); partial coverage
+        promotes the touched members; object rows re-stamp in place. The
+        observable result is exactly the batch's materialize() expansion
+        upserted row-wise."""
+        with self._lock:
+            items = _apply_update_batches(self._t, index, batches)
         self.watch.notify(items)
 
     def update_alloc_from_client(self, index: int, alloc: Allocation) -> None:

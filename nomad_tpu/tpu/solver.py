@@ -460,30 +460,83 @@ class TPUGenericScheduler(GenericScheduler):
                 vec_cache[key] = v
             return v
 
-        # Per-node current usage -> headroom, shared across groups.
-        headroom: Dict[str, Optional[np.ndarray]] = {}
+        # Per-node current usage -> headroom, shared across groups. With
+        # the store's node table available, the base (totals - reserved -
+        # columnar block usage) is three array ops; per-node python runs
+        # only where object rows or plan entries exist. Existing allocs of
+        # a committed columnar job would otherwise materialize per node
+        # right here.
+        from nomad_tpu.server.plan_apply import (
+            _existing_block_usage_rows,
+            _node_table,
+        )
 
-        def node_headroom(nid):
-            h = headroom.get(nid, False)
-            if h is not False:
+        headroom: Dict[str, Optional[np.ndarray]] = {}
+        table = _node_table(state)
+        plan = self.ctx.plan
+        if table is not None:
+            block_usage, net_rows, blocks = _existing_block_usage_rows(
+                state, table
+            )
+            headroom_base = table.totals.astype(np.int64) - table.reserved
+            if block_usage is not None:
+                headroom_base = headroom_base - block_usage
+            obj_nodes = state.nodes_with_object_allocs()
+
+            def node_headroom(nid):
+                h = headroom.get(nid, False)
+                if h is not False:
+                    return h
+                row = table.rows.get(nid)
+                if row is None:
+                    headroom[nid] = None
+                    return None
+                if net_rows is not None and net_rows[row]:
+                    # Network-carrying block usage isn't in the base (it
+                    # needs the sequential port index): no columnar
+                    # headroom claim — the per-alloc path decides.
+                    headroom[nid] = None
+                    return None
+                h = headroom_base[row].copy()
+                if (nid in obj_nodes or plan.node_update.get(nid)
+                        or plan.node_allocation.get(nid)):
+                    counts: Dict[int, int] = {}
+                    for a in self.ctx.proposed_allocs_objects(nid):
+                        key = id(a.resources)
+                        counts[key] = counts.get(key, 0) + 1
+                        if key not in vec_cache:
+                            vec(a.resources)
+                    for key, n in counts.items():
+                        h -= vec_cache[key] * n
+                    # Evicted block members: the base counted them; the
+                    # object walk can't subtract them, so credit back.
+                    for a in plan.node_update.get(nid, ()):
+                        if any(blk.find(a.id) is not None for blk in blocks):
+                            h += vec(a.resources)
+                headroom[nid] = h
                 return h
-            node = state.node_by_id(nid)
-            if node is None or node.resources is None:
-                headroom[nid] = None
-                return None
-            used = vec(node.reserved).copy()
-            # Identity-counted accumulation over the proposed view
-            counts: Dict[int, int] = {}
-            for a in self.ctx.proposed_allocs(nid):
-                key = id(a.resources)
-                counts[key] = counts.get(key, 0) + 1
-                if key not in vec_cache:
-                    vec(a.resources)
-            for key, n in counts.items():
-                used += vec_cache[key] * n
-            h = vec(node.resources) - used
-            headroom[nid] = h
-            return h
+        else:
+            def node_headroom(nid):
+                h = headroom.get(nid, False)
+                if h is not False:
+                    return h
+                node = state.node_by_id(nid)
+                if node is None or node.resources is None:
+                    headroom[nid] = None
+                    return None
+                used = vec(node.reserved).copy()
+                # Identity-counted accumulation over the proposed view
+                counts: Dict[int, int] = {}
+                for a in self.ctx.proposed_allocs(nid):
+                    key = id(a.resources)
+                    counts[key] = counts.get(key, 0) + 1
+                    if key not in vec_cache:
+                        vec(a.resources)
+                for key, n in counts.items():
+                    used += vec_cache[key] * n
+                h = vec(node.resources) - used
+                headroom[nid] = h
+                return h
 
         batches = []
         all_leftovers = []

@@ -316,3 +316,88 @@ def test_block_member_delete_fires_node_watch():
     store.delete_eval(101, [], [batch.alloc_id(0)])
     assert fired.wait(1.0)
     assert store.alloc_count() == 1
+
+
+def _mk_update_batch(batch, job2, cpu=200):
+    from nomad_tpu.structs import AllocUpdateBatch
+
+    return AllocUpdateBatch(
+        eval_id="ev-upd",
+        job=job2,
+        tg_name=batch.tg_name,
+        resources=Resources(cpu=cpu, memory_mb=128),
+        alloc_ids=[batch.alloc_id(i) for i in range(batch.n)],
+    )
+
+
+def test_whole_block_inplace_update_swaps_fields():
+    """An update batch covering every live member applies as ONE block
+    field swap: reads show the new job/resources with bumped modify index
+    and preserved create index — and the store stays columnar."""
+    import copy
+
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id, nodes[1].id], [2, 3])
+    store.upsert_alloc_blocks(100, [batch])
+
+    job2 = copy.deepcopy(job)
+    job2.priority = 77
+    store.apply_update_batches(120, [_mk_update_batch(batch, job2)])
+
+    assert len(store.alloc_blocks()) == 1  # still columnar: no dissolution
+    got = store.allocs_by_job(job.id)
+    assert len(got) == 5
+    for a in got:
+        assert a.eval_id == "ev-upd"
+        assert a.job.priority == 77
+        assert a.resources.cpu == 200
+        assert a.modify_index == 120
+        assert a.create_index == 100
+        assert a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+    # Same ids and node placement as before the update.
+    assert {a.id for a in got} == {batch.alloc_id(i) for i in range(5)}
+    assert len(store.allocs_by_node(nodes[1].id)) == 3
+    # Eval re-key: the block now indexes under the update's eval.
+    assert len(store.allocs_by_eval("ev-upd")) == 5
+    assert store.allocs_by_eval("ev-1") == []
+
+
+def test_partial_inplace_update_promotes_members():
+    """Updating a subset of a block's members promotes exactly those to
+    object rows; siblings keep the old fields through the block."""
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id], [4])
+    store.upsert_alloc_blocks(100, [batch])
+
+    upd = _mk_update_batch(batch, job)
+    upd.alloc_ids = upd.alloc_ids[:1]  # one member only
+    store.apply_update_batches(120, [upd])
+
+    target = store.alloc_by_id(batch.alloc_id(0))
+    assert target.resources.cpu == 200 and target.modify_index == 120
+    sibling = store.alloc_by_id(batch.alloc_id(2))
+    assert sibling.resources.cpu == 100 and sibling.modify_index == 100
+    assert store.alloc_count() == 4
+
+
+def test_update_batch_wire_roundtrip_applies_on_replica():
+    """The raft log form (ids + shared fields) must produce the same state
+    on a replica that decodes it."""
+    import copy
+
+    from nomad_tpu.raft.log_codec import decode_payload, encode_payload
+
+    store, nodes, job = _seeded_store()
+    batch = _mk_batch(job, [nodes[0].id, nodes[1].id], [1, 2])
+    store.upsert_alloc_blocks(100, [batch])
+
+    job2 = copy.deepcopy(job)
+    ub = _mk_update_batch(batch, job2, cpu=333)
+    wire = encode_payload("alloc_update", {"update_batches": [ub]})
+    decoded = decode_payload("alloc_update", wire)
+    store.apply_update_batches(130, decoded["update_batches"])
+
+    got = store.allocs_by_job(job.id)
+    assert len(got) == 3
+    assert all(a.resources.cpu == 333 and a.modify_index == 130 for a in got)
+    assert len(store.alloc_blocks()) == 1
