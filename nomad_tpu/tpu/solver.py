@@ -897,8 +897,106 @@ class TPUSystemScheduler(SystemScheduler):
     """SystemScheduler with a vectorized per-node fit: all pinned placements
     of a task group are checked in one dispatch (factory: tpu-system)."""
 
+    # One-per-node placements at or above this count flow columnar
+    # (AllocBatch with unit runs) instead of per-Allocation objects.
+    BATCH_PLACE_THRESHOLD = 64
+
     def make_stack(self, ctx: EvalContext) -> TPUStack:
         return TPUStack(ctx, system=True)
+
+    def _place_system_batch(self, tg, tg_constr, missing_list, mirror,
+                            fit_np, metrics) -> bool:
+        """Columnar system placement: one AllocBatch of unit runs over the
+        fitting pinned nodes. Applies only to large network-free groups
+        with each node appearing once (the normal system diff shape —
+        repeats and network offers take the per-alloc path). Returns True
+        when the group was handled."""
+        from nomad_tpu.structs import AllocBatch
+
+        if len(missing_list) < self.BATCH_PLACE_THRESHOLD:
+            return False
+        if tg_constr.size.networks or any(
+            t.resources is not None and t.resources.networks
+            for t in tg.tasks
+        ):
+            return False
+        from nomad_tpu.scheduler import SchedulerError
+
+        # Pass 1 — pure validation, NO side effects: a bail-out here falls
+        # back to the sequential path, which must not see half-recorded
+        # metrics.
+        parsed = []
+        seen = set()
+        for missing in missing_list:
+            nid = missing.alloc.node_id
+            if nid in seen:
+                return False  # repeated node: sequential accounting path
+            seen.add(nid)
+            name = missing.name
+            lb = name.rfind("[")
+            if lb < 0 or not name.endswith("]"):
+                return False
+            try:
+                idx_val = int(name[lb + 1:-1])
+            except ValueError:
+                return False
+            parsed.append((nid, idx_val))
+
+        # Pass 2 — fit decisions and metrics.
+        node_ids = []
+        name_idx = []
+        failed = 0
+        first_failed_idx = 0
+        index = mirror.index
+        for nid, idx_val in parsed:
+            row = index.get(nid)
+            if row is None:
+                # Same invariant the sequential path enforces: a pinned
+                # placement must name a known eligible node.
+                raise SchedulerError(f"could not find node {nid!r}")
+            if fit_np[row]:
+                node_ids.append(nid)
+                name_idx.append(idx_val)
+            else:
+                if failed == 0:
+                    first_failed_idx = idx_val
+                failed += 1
+                metrics.exhausted_node(mirror.nodes[row], "resources")
+
+        placed = len(node_ids)
+        if placed:
+            import os as _os
+
+            batch = AllocBatch(
+                eval_id=self.eval.id,
+                job=self.job,
+                tg_name=tg.name,
+                resources=tg_constr.size,
+                task_resources={t.name: t.resources for t in tg.tasks},
+                metrics=metrics,
+                node_ids=node_ids,
+                node_counts=[1] * placed,
+                name_idx=np.asarray(name_idx, dtype=np.int64),
+                ids_hex=_os.urandom(16 * placed).hex(),
+            )
+            self.plan.append_batch(batch)
+        if failed:
+            failed_alloc = Allocation(
+                id=generate_uuid(),
+                eval_id=self.eval.id,
+                name=f"{self.job.name}.{tg.name}[{first_failed_idx}]",
+                job_id=self.job.id,
+                job=self.job,
+                task_group=tg.name,
+                resources=tg_constr.size,
+                metrics=metrics,
+                desired_status=ALLOC_DESIRED_STATUS_FAILED,
+                desired_description="failed to find a node for placement",
+                client_status=ALLOC_CLIENT_STATUS_FAILED,
+            )
+            failed_alloc.metrics.coalesced_failures += failed - 1
+            self.plan.append_failed(failed_alloc)
+        return True
 
     def compute_placements(self, place: List[AllocTuple]) -> None:
         node_by_id = {node.id: node for node in self.nodes}
@@ -937,6 +1035,11 @@ class TPUSystemScheduler(SystemScheduler):
                 prep.job_distinct, prep.tg_distinct,
             )
             fit_np = np.asarray(fit)
+
+            if self._place_system_batch(tg, tg_constr, missing_list,
+                                        mirror, fit_np, metrics):
+                continue
+
             # Host-side in-group accounting: if a node receives more than one
             # placement in this group, deduct earlier asks before re-checking
             # (job validation enforces count==1 for system jobs, but the diff
