@@ -231,23 +231,41 @@ def run_coalesced(nodes):
         for node in nodes:
             srv.raft.apply("node_register", {"node": node})
         jobs = []
-        for _ in range(COALESCE_EVALS + 1):  # +1: dedicated warmup job
+        for i in range(2 * COALESCE_EVALS):  # half warmup, half timed
             _nodes, job = build_cluster()
-            job.task_groups[0].count = N_TASKS // COALESCE_EVALS
+            # Warm jobs use a tiny count on the SAME columnar path (>128
+            # rides the water-fill; compile shapes key on node bucket and
+            # batch size, not the count value), so warmup doesn't consume
+            # the capacity the timed batch is measured against.
+            job.task_groups[0].count = (
+                129 if i < COALESCE_EVALS else N_TASKS // COALESCE_EVALS
+            )
             srv.raft.apply("job_register", {"job": job})
             jobs.append(job)
 
-        # Warmup eval compiles the batched program shapes before timing.
-        warm_job = jobs.pop()
-        warm = Evaluation(
-            id=generate_uuid(), priority=warm_job.priority,
-            type=warm_job.type,
-            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
-            job_id=warm_job.id, status=structs.EVAL_STATUS_PENDING,
-        )
+        # Warmup batch: the SAME concurrent shape as the timed batch, so
+        # the vmapped coalesced-dispatch programs (batch-size buckets)
+        # compile before timing — steady-state throughput is the metric;
+        # cold-compile behavior is covered by the prewarm/nack-touch tests.
+        warm_jobs, jobs = jobs[:COALESCE_EVALS], jobs[COALESCE_EVALS:]
+        warm_evals = [
+            Evaluation(
+                id=generate_uuid(), priority=job.priority, type=job.type,
+                triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+                job_id=job.id, status=structs.EVAL_STATUS_PENDING,
+            )
+            for job in warm_jobs
+        ]
         srv.start()
-        srv.raft.apply("eval_update", {"evals": [warm]})
-        _wait_evals_complete(srv, [warm.id], timeout=300.0)
+        srv.raft.apply("eval_update", {"evals": warm_evals})
+        _wait_evals_complete(srv, [ev.id for ev in warm_evals], timeout=300.0)
+        # Worker drain timing decides which eval-axis batch buckets the
+        # warm batch hit; compile the rest deterministically.
+        from nomad_tpu.ops.binpack import bucket
+        from nomad_tpu.ops.coalesce import warm_batch_shapes
+
+        dc1_nodes = sum(1 for n in nodes if n.datacenter == "dc1")
+        warm_batch_shapes(bucket(dc1_nodes))
 
         evals = [
             Evaluation(

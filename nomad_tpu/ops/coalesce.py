@@ -27,6 +27,11 @@ import numpy as np
 from nomad_tpu import telemetry
 from nomad_tpu.ops.binpack import solve_waterfill
 
+# Cap on the vmapped eval-axis batch: dispatch in chunks of at most this
+# many entries so the power-of-two bucket set {1, 2, 4, 8} is the ENTIRE
+# steady-state compile surface (warm_batch_shapes compiles exactly these).
+MAX_BATCH_BUCKET = 8
+
 
 @partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
 def solve_waterfill_batched(
@@ -146,21 +151,29 @@ class CoalescingSolver:
             groups.setdefault(key, []).append(e)
 
         for (n, jd, td), entries in groups.items():
-            try:
-                self._dispatch_group(entries, jd, td)
-            except Exception:
-                # Fail open: solve each entry individually so waiters
-                # never hang on a batch-level error. An entry whose retry
-                # also fails carries the exception to its fetch() caller.
-                for e in entries:
-                    try:
-                        counts_dev, remaining_dev = self._solve_one(e)
-                        e.group = _Group(counts_dev[None], remaining_dev[None])
-                        e.index = 0
-                    except Exception as exc:
-                        e.error = exc
-                    finally:
-                        e.event.set()
+            # Chunk at the largest warmed eval-axis bucket: the compile
+            # surface stays exactly the warmed set (1, 2, 4, 8) no matter
+            # how deep a load spike's drain is.
+            for start in range(0, len(entries), MAX_BATCH_BUCKET):
+                chunk = entries[start:start + MAX_BATCH_BUCKET]
+                try:
+                    self._dispatch_group(chunk, jd, td)
+                except Exception:
+                    # Fail open: solve each entry individually so waiters
+                    # never hang on a batch-level error. An entry whose
+                    # retry also fails carries the exception to its
+                    # fetch() caller.
+                    for e in chunk:
+                        try:
+                            counts_dev, remaining_dev = self._solve_one(e)
+                            e.group = _Group(
+                                counts_dev[None], remaining_dev[None]
+                            )
+                            e.index = 0
+                        except Exception as exc:
+                            e.error = exc
+                        finally:
+                            e.event.set()
 
     @staticmethod
     def _solve_one(e: _Entry):
@@ -192,27 +205,8 @@ class CoalescingSolver:
             return
 
         self.coalesced += len(entries)
-        # Pad the eval axis to a power-of-two bucket so the jit cache sees
-        # a handful of batch shapes, not one per load level. Padding rows
-        # repeat entry 0 with count=0 (a no-op solve).
-        from nomad_tpu.ops.binpack import bucket
-
-        b = bucket(len(entries), floor=2)
-        rows = [e.args for e in entries]
-        rows.extend([entries[0].args[:10] + (0, 0.0, jd, td)] * (b - len(rows)))
-        cols = list(zip(*(r[:10] for r in rows)))
-        stacked = [jnp.stack(col) for col in cols]
-        counts = jnp.asarray([r[10] for r in rows], dtype=jnp.int32)
-        penalties = jnp.asarray([r[11] for r in rows], dtype=jnp.float32)
-        from nomad_tpu.parallel import mesh as mesh_lib
-
-        mesh = mesh_lib.mesh_for_nodes(stacked[0].shape[1])
-        if mesh is not None:
-            stacked, counts, penalties = mesh_lib.shard_waterfill_batch_args(
-                mesh, stacked, counts, penalties
-            )
-        counts_dev, remaining_dev = solve_waterfill_batched(
-            *stacked, counts, penalties, jd, td,
+        counts_dev, remaining_dev = _stack_and_solve(
+            [e.args for e in entries], jd, td
         )
         group = _Group(counts_dev, remaining_dev)
         for i, e in enumerate(entries):
@@ -221,5 +215,57 @@ class CoalescingSolver:
             e.event.set()
 
 
+def _stack_and_solve(rows, jd: bool, td: bool):
+    """Pad the eval axis to its power-of-two bucket, shard on the mesh,
+    dispatch the vmapped water-fill. The ONE stacking implementation —
+    shared by the dispatcher and warm_batch_shapes so warmup provably
+    compiles the exact shapes real dispatches use. Padding rows repeat
+    row 0 with count=0 (a no-op solve)."""
+    from nomad_tpu.ops.binpack import bucket
+    from nomad_tpu.parallel import mesh as mesh_lib
+
+    b = bucket(len(rows), floor=2)
+    rows = list(rows)
+    rows.extend([rows[0][:10] + (0, 0.0, jd, td)] * (b - len(rows)))
+    cols = list(zip(*(r[:10] for r in rows)))
+    stacked = [jnp.stack(col) for col in cols]
+    counts = jnp.asarray([r[10] for r in rows], dtype=jnp.int32)
+    penalties = jnp.asarray([r[11] for r in rows], dtype=jnp.float32)
+    mesh = mesh_lib.mesh_for_nodes(stacked[0].shape[1])
+    if mesh is not None:
+        stacked, counts, penalties = mesh_lib.shard_waterfill_batch_args(
+            mesh, stacked, counts, penalties
+        )
+    return solve_waterfill_batched(*stacked, counts, penalties, jd, td)
+
+
 # Process-wide engine shared by all workers (like GLOBAL_MIRROR_CACHE).
 GLOBAL_SOLVER = CoalescingSolver()
+
+
+def warm_batch_shapes(n_padded: int, buckets=(1, 2, 4, 8), stop=None) -> int:
+    """Pre-compile the water-fill for each eval-axis bucket at one
+    node-axis bucket. Dispatch chunking caps real batches at
+    MAX_BATCH_BUCKET, so the default buckets are the ENTIRE steady-state
+    compile surface — and both paths run through the coalescer's own code
+    (_solve_one / _stack_and_solve), so warm shapes can't drift from real
+    dispatch shapes. Values are no-op solves (count 0). Returns the
+    number of dispatches issued."""
+    zero4 = jnp.zeros((n_padded, 4), dtype=jnp.int32)
+    zcap = jnp.zeros((n_padded, 2), dtype=jnp.float32)
+    zvec = jnp.zeros((n_padded,), dtype=jnp.int32)
+    elig = jnp.zeros((n_padded,), dtype=bool)
+    args = (zero4, zcap, zero4, zvec, zvec, zvec, zvec, elig,
+            jnp.zeros((4,), dtype=jnp.int32), jnp.int32(0),
+            0, 0.0, False, False)
+    done = 0
+    for b in buckets:
+        if stop is not None and stop():
+            return done
+        if b == 1:
+            counts_dev, _rem = CoalescingSolver._solve_one(_Entry(args))
+        else:
+            counts_dev, _rem = _stack_and_solve([args] * b, False, False)
+        jax.block_until_ready(counts_dev)
+        done += 1
+    return done

@@ -1178,6 +1178,11 @@ def warm_shapes(snapshot, counts=(8, 16, 32, 64, 128, 129), logger=None,
             else:
                 stack.solve_group_counts(tg, count)
             dispatches += 1
+        # Coalesced multi-eval dispatches pad the eval axis to power-of-two
+        # buckets; warm those shapes too (ops/coalesce.py).
+        from nomad_tpu.ops.coalesce import warm_batch_shapes
+
+        dispatches += warm_batch_shapes(mirror.padded, stop=stop)
     log.info(
         "warmed %d solve program(s) across %d node bucket(s) in %.1fs",
         dispatches, len(seen), time.perf_counter() - t0,

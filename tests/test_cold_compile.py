@@ -136,7 +136,9 @@ def test_warm_shapes_compiles_cluster_buckets():
     snap = store.snapshot()
     counts = (1, 129)
     dispatches = tpu_solver.warm_shapes(snap, counts=counts)
-    assert dispatches == 2 * len(counts)
+    # Per node bucket: one dispatch per count, plus the coalesced
+    # eval-axis batch buckets (1, 2, 4, 8 — ops/coalesce.warm_batch_shapes).
+    assert dispatches == 2 * (len(counts) + 4)
 
     # The warmed mirror is the one a real eval adopts (cache hit).
     hits0 = GLOBAL_MIRROR_CACHE.hits

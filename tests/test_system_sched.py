@@ -307,3 +307,73 @@ def test_system_retry_limit(factory):
     assert len(h.plans) > 0
     assert h.state.allocs_by_job(job.id) == []
     h.assert_eval_status(structs.EVAL_STATUS_FAILED)
+
+
+def test_system_columnar_batch_path_matches_host():
+    """>= BATCH_PLACE_THRESHOLD network-free nodes: the columnar system
+    path (TPUSystemScheduler._place_system_batch) must place one per node
+    like the host oracle, committing as an AllocBatch."""
+    from nomad_tpu.structs import Resources
+
+    results = {}
+    for factory in ("system", "tpu-system"):
+        h = Harness()
+        for i in range(80):
+            node = mock.node()
+            node.id = f"sysb-{i:03d}"
+            h.state.upsert_node(h.next_index(), node)
+        job = mock.system_job()
+        for t in job.task_groups[0].tasks:
+            t.resources = Resources(cpu=100, memory_mb=64)  # network-free
+        h.state.upsert_job(h.next_index(), job)
+        ev = Evaluation(
+            id=generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        )
+        h.process(factory, ev)
+        live = [
+            a for a in h.state.allocs_by_job(job.id)
+            if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+        ]
+        assert len(live) == 80
+        assert len({a.node_id for a in live}) == 80
+        assert all(a.name == f"{job.name}.{job.task_groups[0].name}[0]"
+                   for a in live)
+        if factory == "tpu-system":
+            assert any(p.alloc_batches for p in h.plans), (
+                "expected the columnar system path"
+            )
+        results[factory] = len(live)
+    assert results["system"] == results["tpu-system"]
+
+
+def test_system_columnar_partial_fit_coalesces_failures():
+    """Some nodes can't fit the system task: placements land columnar on
+    the fitting nodes; failures coalesce into one failed alloc with the
+    count, exactly like the sequential path."""
+    from nomad_tpu.structs import Resources
+
+    h = Harness()
+    for i in range(70):
+        node = mock.node()
+        node.id = f"sysp-{i:03d}"
+        if i < 20:  # too small for the ask
+            node.resources = Resources(cpu=50, memory_mb=32)
+        h.state.upsert_node(h.next_index(), node)
+    job = mock.system_job()
+    for t in job.task_groups[0].tasks:
+        t.resources = Resources(cpu=500, memory_mb=256)
+    h.state.upsert_job(h.next_index(), job)
+    ev = Evaluation(
+        id=generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+    h.process("tpu-system", ev)
+    live = [
+        a for a in h.state.allocs_by_job(job.id)
+        if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+    ]
+    assert len(live) == 50
+    failed = [a for p in h.plans for a in p.failed_allocs]
+    assert len(failed) == 1
+    assert failed[0].metrics.coalesced_failures == 19  # 20 failures total
