@@ -51,6 +51,11 @@ class AgentConfig:
     rpc_host: str = ""
     rpc_port: int = 4647
     start_join: List[str] = field(default_factory=list)
+    # Atlas/SCADA-analog uplink (command/agent/scada.go): only active when
+    # an explicit endpoint is configured — there is no hardcoded SaaS.
+    atlas_infrastructure: str = ""
+    atlas_token: str = ""
+    atlas_endpoint: str = ""
 
     @classmethod
     def dev(cls) -> "AgentConfig":
@@ -101,6 +106,9 @@ class AgentConfig:
             rpc_host=fc.addresses.rpc or fc.bind_addr or "127.0.0.1",
             rpc_port=fc.ports.rpc,
             start_join=list(fc.server.start_join),
+            atlas_infrastructure=fc.atlas.infrastructure,
+            atlas_token=fc.atlas.token,
+            atlas_endpoint=fc.atlas.endpoint,
         )
 
 
@@ -113,6 +121,12 @@ class Agent:
         self.client: Optional[Client] = None
         self.http: Optional[object] = None
         self.client_config: Optional[ClientConfig] = None
+        if config.atlas_endpoint:
+            # Validate before any side effects (listeners, raft) so a
+            # malformed endpoint fails at construction, not mid-start.
+            from nomad_tpu.scada import _split_endpoint
+
+            _split_endpoint(config.atlas_endpoint)
 
         if config.server_enabled:
             self._setup_server()
@@ -235,8 +249,28 @@ class Agent:
             self.logger.getChild("http"),
         )
         self.http.start()
+        self.uplink = None
+        if self.config.atlas_endpoint:
+            from nomad_tpu.scada import UplinkProvider
+
+            # An endpoint alone is enough (the Atlas docstring promises
+            # "endpoint set -> agent dials"); infrastructure falls back to
+            # the node name so the broker still gets a session key.
+            self.uplink = UplinkProvider(
+                endpoint=self.config.atlas_endpoint,
+                infrastructure=self.config.atlas_infrastructure
+                or self.config.node_name or "default",
+                token=self.config.atlas_token,
+                http_addr=f"{self.config.http_host}:{self.http.port}",
+                meta={"region": self.config.region,
+                      "datacenter": self.config.datacenter},
+                logger=self.logger.getChild("scada"),
+            )
+            self.uplink.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "uplink", None) is not None:
+            self.uplink.shutdown()
         if self.http is not None:
             self.http.shutdown()
         if self.client is not None:

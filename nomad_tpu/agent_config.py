@@ -86,9 +86,11 @@ class Telemetry:
 
 @dataclass
 class Atlas:
-    """config.go AtlasConfig block. Parsed for config compatibility; the
-    SCADA uplink itself (command/agent/scada.go dials HashiCorp infra) is
-    intentionally not implemented — see nomad_tpu.scada."""
+    """config.go AtlasConfig block. When ``endpoint`` is set the agent
+    dials it and exposes the HTTP API over the tunnel
+    (nomad_tpu.scada.UplinkProvider, ref command/agent/scada.go); without
+    an explicit endpoint the uplink stays off — the reference's default
+    points at a defunct third-party SaaS."""
 
     infrastructure: str = ""
     token: str = ""

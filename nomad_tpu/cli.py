@@ -125,7 +125,13 @@ def _read_agent_config(args):
         flags.ports.http = args.http_port
     config = config.merge(flags)
 
-    if config.atlas.infrastructure:
+    if config.atlas.endpoint:
+        # Mirror the agent's session-key fallback (agent.py start()) so
+        # the banner names the key a broker will actually see.
+        infra = config.atlas.infrastructure or config.name or "default"
+        print(f"==> Atlas/SCADA uplink: {config.atlas.endpoint} "
+              f"(infrastructure: {infra})")
+    elif config.atlas.infrastructure:
         from nomad_tpu.scada import scada_unavailable_reason
 
         print(f"==> Atlas/SCADA disabled: {scada_unavailable_reason()}")

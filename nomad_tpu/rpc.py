@@ -94,6 +94,66 @@ def _recv_frame(sock: socket.socket) -> Any:
     return json.loads(_recv_exact(sock, length))
 
 
+def serve_frames(
+    conn: socket.socket,
+    dispatch: Callable[[Any], dict],
+    shutdown: threading.Event,
+    logger: logging.Logger,
+    write_lock: Optional[threading.Lock] = None,
+    thread_name: str = "rpc-stream",
+) -> None:
+    """Per-connection serve loop shared by RPCServer and the SCADA-analog
+    uplink provider: each inbound frame runs on its own thread; responses
+    interleave on the shared connection under a write lock, correlated by
+    seq — so a parked long-poll never head-of-line blocks control traffic.
+    In-flight requests per connection are capped: acquiring the semaphore
+    before reading the next frame applies TCP backpressure to a flooding
+    peer instead of spawning unbounded threads.
+
+    Runs until the connection drops or ``shutdown`` is set; transport
+    errors propagate to the caller (which owns socket cleanup). A handler
+    result that fails to serialize is answered with an error frame so the
+    peer fails fast instead of timing out."""
+    if write_lock is None:
+        write_lock = threading.Lock()
+    inflight = threading.Semaphore(MAX_INFLIGHT_PER_CONN)
+
+    def handle(req: Any) -> None:
+        try:
+            resp = dispatch(req)
+            try:
+                with write_lock:
+                    _send_frame(conn, resp)
+            except (ConnectionError, OSError):
+                pass
+            except Exception as e:
+                logger.warning(
+                    "rpc: response for %s not serializable: %s",
+                    req.get("method") if isinstance(req, dict) else req, e,
+                )
+                err = {"seq": req.get("seq") if isinstance(req, dict) else None,
+                       "error": f"response serialization failed: {e}",
+                       "result": None}
+                try:
+                    with write_lock:
+                        _send_frame(conn, err)
+                except Exception:
+                    _hard_close(conn)
+        finally:
+            inflight.release()
+
+    while not shutdown.is_set():
+        inflight.acquire()
+        try:
+            req = _recv_frame(conn)
+        except BaseException:
+            inflight.release()
+            raise
+        threading.Thread(
+            target=handle, args=(req,), daemon=True, name=thread_name,
+        ).start()
+
+
 class RPCServer:
     """Serves registered handlers on a TCP listener (rpc.go:21-72 listen/
     handleConn, minus the protocol-byte demux — raft runs on its own RPC
@@ -144,57 +204,12 @@ class RPCServer:
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        # Each request runs on its own thread; responses interleave on the
-        # shared connection under a write lock, correlated by seq — so a
-        # parked long-poll never head-of-line blocks control traffic.
-        # In-flight requests per connection are capped: acquiring the
-        # semaphore before reading the next frame applies TCP backpressure
-        # to a flooding peer instead of spawning unbounded threads.
-        write_lock = threading.Lock()
-        inflight = threading.Semaphore(MAX_INFLIGHT_PER_CONN)
-
-        def handle(req: dict) -> None:
-            try:
-                resp = self._dispatch(req)
-                try:
-                    with write_lock:
-                        _send_frame(conn, resp)
-                except (ConnectionError, OSError):
-                    pass
-                except Exception as e:
-                    # Unserializable handler result: answer with an error
-                    # frame so the caller fails fast instead of timing out.
-                    self.logger.warning(
-                        "rpc: response for %s not serializable: %s",
-                        req.get("method"), e,
-                    )
-                    err = {"seq": req.get("seq"),
-                           "error": f"response serialization failed: {e}",
-                           "result": None}
-                    try:
-                        with write_lock:
-                            _send_frame(conn, err)
-                    except Exception:
-                        _hard_close(conn)
-            finally:
-                inflight.release()
-
         with self._conns_lock:
             self._conns.add(conn)
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             _set_send_timeout(conn, SEND_TIMEOUT)
-            while not self._shutdown.is_set():
-                inflight.acquire()
-                try:
-                    req = _recv_frame(conn)
-                except BaseException:
-                    inflight.release()
-                    raise
-                threading.Thread(
-                    target=handle, args=(req,), daemon=True,
-                    name="rpc-stream",
-                ).start()
+            serve_frames(conn, self._dispatch, self._shutdown, self.logger)
         except (ConnectionError, OSError, ValueError):
             pass
         finally:

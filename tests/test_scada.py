@@ -1,0 +1,107 @@
+"""SCADA-analog uplink tests (reference: command/agent/scada.go — the
+provider dials a broker and exposes the agent HTTP API over the tunnel)."""
+
+import json
+import time
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.scada import UplinkBroker, UplinkProvider
+
+
+def wait_for(fn, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def broker():
+    b = UplinkBroker(token="sekrit")
+    yield b
+    b.shutdown()
+
+
+@pytest.fixture()
+def agent(tmp_path, broker):
+    config = AgentConfig.dev()
+    config.data_dir = str(tmp_path)
+    config.http_port = 0
+    config.scheduler_backend = "host"
+    config.atlas_infrastructure = "acme/prod"
+    config.atlas_token = "sekrit"
+    config.atlas_endpoint = broker.addr
+    a = Agent(config)
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def test_handshake_registers_session(broker, agent):
+    assert wait_for(lambda: "acme/prod" in broker.sessions())
+    hs = broker.sessions()["acme/prod"]
+    assert hs["service"] == "nomad-tpu"
+    assert hs["capabilities"] == {"http": 1}
+    assert hs["meta"]["datacenter"] == "dc1"
+    assert broker.ping("acme/prod")
+
+
+def test_http_through_tunnel(broker, agent):
+    assert wait_for(lambda: "acme/prod" in broker.sessions())
+    resp = broker.http("acme/prod", "GET", "/v1/agent/self")
+    assert resp["status"] == 200
+    info = json.loads(resp["body"])
+    assert info["config"]["server_enabled"] is True
+
+    # Query-meta headers survive the tunnel (the uplink serves the same
+    # /v1 surface as the local listener).
+    resp = broker.http("acme/prod", "GET", "/v1/nodes")
+    assert resp["status"] == 200
+    assert "X-Nomad-Index" in resp["headers"]
+
+    resp = broker.http("acme/prod", "GET", "/v1/job/nope")
+    assert resp["status"] == 404
+
+
+def test_provider_reconnects_after_drop(broker, agent):
+    assert wait_for(lambda: "acme/prod" in broker.sessions())
+    first_sessions = agent.uplink.sessions
+    broker.drop("acme/prod")
+    assert wait_for(lambda: agent.uplink.sessions > first_sessions, timeout=10)
+    assert wait_for(lambda: "acme/prod" in broker.sessions(), timeout=10)
+    resp = broker.http("acme/prod", "GET", "/v1/status/leader")
+    assert resp["status"] == 200
+
+
+def test_bad_token_rejected(tmp_path):
+    broker = UplinkBroker(token="right")
+    provider = UplinkProvider(
+        endpoint=broker.addr, infrastructure="x", token="wrong",
+        http_addr="127.0.0.1:1",
+    )
+    provider.start()
+    try:
+        time.sleep(0.5)
+        assert broker.sessions() == {}
+        assert provider.sessions == 0
+    finally:
+        provider.shutdown()
+        broker.shutdown()
+
+
+def test_no_endpoint_means_no_uplink(tmp_path):
+    config = AgentConfig.dev()
+    config.data_dir = str(tmp_path)
+    config.http_port = 0
+    config.scheduler_backend = "host"
+    config.atlas_infrastructure = "acme/prod"  # but no endpoint
+    a = Agent(config)
+    a.start()
+    try:
+        assert a.uplink is None
+    finally:
+        a.shutdown()
