@@ -25,12 +25,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from nomad_tpu import telemetry
+from nomad_tpu.ops import pallas_solve
 from nomad_tpu.ops.binpack import solve_waterfill
 
 # Cap on the vmapped eval-axis batch: dispatch in chunks of at most this
 # many entries so the power-of-two bucket set {1, 2, 4, 8} is the ENTIRE
 # steady-state compile surface (warm_batch_shapes compiles exactly these).
 MAX_BATCH_BUCKET = 8
+
+
+def _pallas_fallback() -> None:
+    """First pallas failure disables the kernel for the process and is
+    counted, so Stats() shows which solve path production is actually on."""
+    pallas_solve.mark_pallas_failed()
+    telemetry.incr_counter(("scheduler", "coalesce", "pallas_fallback"))
 
 
 @partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
@@ -178,14 +186,35 @@ class CoalescingSolver:
     @staticmethod
     def _solve_one(e: _Entry):
         """Single-entry water-fill dispatch, node-axis sharded over the
-        configured mesh when one exists (parallel/mesh.py)."""
+        configured mesh when one exists (parallel/mesh.py). On an
+        unsharded TPU backend the whole solve runs as one VMEM-resident
+        pallas kernel (ops/pallas_solve.py), falling back to the jnp
+        path if the kernel ever fails to lower/execute."""
         from nomad_tpu.parallel import mesh as mesh_lib
 
         args10 = e.args[:10]
         count = jnp.int32(e.args[10])
         penalty = jnp.float32(e.args[11])
         mesh = mesh_lib.mesh_for_nodes(args10[0].shape[0])
-        if mesh is not None:
+        if mesh is None:
+            mode = pallas_solve.pallas_mode()
+            if mode != "off":
+                try:
+                    out = pallas_solve.solve_waterfill_pallas(
+                        *args10, count, penalty, e.args[12], e.args[13],
+                        interpret=mode == "interpret",
+                    )
+                    # Dispatch is async: until this shape bucket has
+                    # proven clean, block here so a runtime kernel fault
+                    # hits THIS except, not the caller's fetch().
+                    key = (args10[0].shape, e.args[12], e.args[13])
+                    if not pallas_solve.is_proven(key):
+                        jax.block_until_ready(out)
+                        pallas_solve.mark_proven(key)
+                    return out
+                except Exception:
+                    _pallas_fallback()
+        else:
             args10 = mesh_lib.shard_waterfill_args(mesh, args10)
             count, penalty = mesh_lib.replicate_on_mesh(mesh, count, penalty)
         return solve_waterfill(*args10, count, penalty, e.args[12], e.args[13])
@@ -232,7 +261,24 @@ def _stack_and_solve(rows, jd: bool, td: bool):
     counts = jnp.asarray([r[10] for r in rows], dtype=jnp.int32)
     penalties = jnp.asarray([r[11] for r in rows], dtype=jnp.float32)
     mesh = mesh_lib.mesh_for_nodes(stacked[0].shape[1])
-    if mesh is not None:
+    if mesh is None:
+        mode = pallas_solve.pallas_mode()
+        if mode != "off":
+            try:
+                out = pallas_solve.solve_waterfill_pallas_batched(
+                    *stacked, counts, penalties, jd, td,
+                    interpret=mode == "interpret",
+                )
+                # See _solve_one: prove each shape bucket synchronously
+                # so async kernel faults reach the fallback.
+                key = (stacked[0].shape, jd, td)
+                if not pallas_solve.is_proven(key):
+                    jax.block_until_ready(out)
+                    pallas_solve.mark_proven(key)
+                return out
+            except Exception:
+                _pallas_fallback()
+    else:
         stacked, counts, penalties = mesh_lib.shard_waterfill_batch_args(
             mesh, stacked, counts, penalties
         )

@@ -1,0 +1,331 @@
+"""Pallas TPU kernel for the closed-form water-fill solve.
+
+The jnp path (ops/binpack.py solve_waterfill) lowers as several XLA ops
+with an O(N log N) argsort for the partial-round top-k. This kernel runs
+the ENTIRE water-fill — per-node capacity, the level binary search, the
+BestFit score, and the top-k partial round — as one VMEM-resident program
+per eval:
+
+- Every tensor for a 16k-node bucket fits comfortably in VMEM (~2 MB),
+  so HBM is read once and never revisited; the level binary search's 32
+  reductions all hit on-chip memory.
+- The argsort is replaced with a rank-space binary search over the
+  monotone uint32 image of the float32 scores (32 fixed VPU passes,
+  O(32·N) work instead of a sort network), with ties broken by ascending
+  node index exactly like the jnp path's stable argsort.
+- Node tensors arrive TRANSPOSED ([D, N] instead of [N, D]) so the node
+  axis lies on the 128-wide lane dimension; the transpose happens outside
+  the kernel where XLA fuses it into the mirror update.
+
+The batched variant grids over the eval axis — each program solves one
+eval of the coalesced batch (ops/coalesce.py), so K in-flight evals still
+cost one dispatch.
+
+Semantics are bit-identical to solve_waterfill (differential-tested in
+tests/test_pallas_solve.py); the coalescer auto-falls-back to the jnp
+path if lowering fails on the running backend, so the kernel can never
+take the control plane down. Reference semantics: AllocsFit/ScoreFit
+(/root/reference/nomad/structs/funcs.go:44-124) and the Select loop it
+reformulates (/root/reference/scheduler/stack.go:131-159).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Python scalars, not jnp values: the kernel must not capture traced
+# constants (pallas requires closures to be static).
+_BIG = 2**30
+_NEG_INF = float("-inf")
+
+
+def _monotone_u32(score: jnp.ndarray) -> jnp.ndarray:
+    """Map float32 -> uint32 preserving total order (IEEE-754 trick:
+    flip all bits of negatives, flip only the sign bit of positives).
+    Lets the kth-largest search run in integer bit space, where binary
+    search terminates in exactly 32 steps."""
+    bits = jax.lax.bitcast_convert_type(score, jnp.uint32)
+    neg = bits >> 31 == 1
+    return jnp.where(neg, ~bits, bits | jnp.uint32(0x80000000))
+
+
+def _waterfill_kernel(
+    # SMEM scalar blocks (per eval)
+    ask_ref,       # (1, D) i32
+    bw_ask_ref,    # (1, 1) i32
+    count_ref,     # (1, 1) i32
+    penalty_ref,   # (1, 1) f32
+    # VMEM blocks (per eval; node axis on lanes)
+    total_ref,     # (1, D, N) i32
+    used_ref,      # (1, D, N) i32
+    sched_cap_ref, # (1, 2, N) f32
+    jc_ref,        # (1, 1, N) i32
+    tc_ref,        # (1, 1, N) i32
+    bw_avail_ref,  # (1, 1, N) i32
+    bw_used_ref,   # (1, 1, N) i32
+    elig_ref,      # (1, 1, N) i32 (0/1)
+    # outputs
+    counts_ref,    # (1, 1, N) i32
+    remaining_ref, # (1, 1) i32 SMEM
+    *, d_res: int, job_distinct: bool, tg_distinct: bool,
+):
+    count = count_ref[0, 0]
+    bw_ask = bw_ask_ref[0, 0]
+    penalty = penalty_ref[0, 0]
+
+    # All node vectors stay 2D (1, N): the node axis on lanes, a unit
+    # sublane — the shape TPU vector ops want.
+    elig = elig_ref[0, 0:1, :] != 0
+    jc = jc_ref[0, 0:1, :]
+    tc = tc_ref[0, 0:1, :]
+    bw_avail = bw_avail_ref[0, 0:1, :]
+    bw_used = bw_used_ref[0, 0:1, :]
+
+    # -- per-node capacity in copies of this ask (binpack.py cap block) --
+    n = jc.shape[1]
+    cap = jnp.full((1, n), _BIG, dtype=jnp.int32)
+    nonneg = jnp.ones((1, n), dtype=jnp.bool_)
+    for d in range(d_res):
+        a = ask_ref[0, d]
+        avail_d = total_ref[0, d:d + 1, :] - used_ref[0, d:d + 1, :]
+        nonneg = nonneg & (avail_d >= 0)
+        dim_cap = avail_d // jnp.maximum(a, 1)
+        cap = jnp.where(a > 0, jnp.minimum(cap, dim_cap), cap)
+    bw_free = bw_avail - bw_used
+    nonneg = nonneg & (bw_free >= 0)
+    bw_cap = jnp.where(bw_ask > 0, bw_free // jnp.maximum(bw_ask, 1), _BIG)
+    cap = jnp.minimum(cap, bw_cap)
+    if job_distinct:
+        cap = jnp.minimum(cap, jnp.where(jc == 0, 1, 0))
+    if tg_distinct:
+        cap = jnp.minimum(cap, jnp.where(tc == 0, 1, 0))
+    cap = jnp.where(elig & nonneg, jnp.clip(cap, 0, count), 0)
+
+    # -- largest L with sum(min(cap, L)) <= count: 32-step bisection ----
+    def bs_body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo + 1) // 2
+        ok = jnp.minimum(cap, mid).sum() <= count
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1))
+
+    level, _ = jax.lax.fori_loop(
+        0, 32, bs_body, (jnp.int32(0), count), unroll=False
+    )
+    base = jnp.minimum(cap, level)
+    remaining = count - base.sum()
+
+    # -- partial round: score nodes with headroom (binpack.py
+    #    _greedy_step_state on the post-base utilization) --------------
+    fit = elig
+    for d in range(d_res):
+        a = ask_ref[0, d]
+        used_b = used_ref[0, d:d + 1, :] + base * a
+        fit = fit & (used_b + a <= total_ref[0, d:d + 1, :])
+    fit = fit & ((bw_used + base * bw_ask + bw_ask) <= bw_avail)
+    if job_distinct:
+        fit = fit & ((jc + base) == 0)
+    if tg_distinct:
+        fit = fit & ((tc + base) == 0)
+
+    ten = jnp.float32(10.0)
+    score_acc = jnp.zeros((1, n), dtype=jnp.float32)
+    for d in range(2):
+        scap = sched_cap_ref[0, d:d + 1, :]
+        a = ask_ref[0, d]
+        used_b = (used_ref[0, d:d + 1, :] + (base + 1) * a).astype(jnp.float32)
+        free = 1.0 - used_b / jnp.maximum(scap, 1.0)
+        free = jnp.where(scap > 0, free, _NEG_INF)
+        score_acc = score_acc + jnp.power(ten, free)
+    score = jnp.clip(20.0 - score_acc, 0.0, 18.0)
+    score = score - penalty * (jc + base).astype(jnp.float32)
+    score = jnp.where(fit, score, _NEG_INF)
+
+    candidates = fit & (cap > level)
+
+    # -- top-`remaining` by score among candidates, ties by ascending
+    #    node index (the stable-argsort order of the jnp path) ----------
+    u = jnp.where(candidates, _monotone_u32(score), jnp.uint32(0))
+
+    def kth_body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo + 1) // 2
+        cnt = (candidates & (u >= mid)).sum(dtype=jnp.int32)
+        ok = cnt >= remaining
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1))
+
+    # hi starts at 0xFFFFFFFE, not 0xFFFFFFFF: real scores never map to
+    # the all-ones image (that is a positive-NaN), and a full-range start
+    # would overflow (hi - lo + 1) to zero on the first midpoint.
+    thresh, _ = jax.lax.fori_loop(
+        0, 32, kth_body,
+        (jnp.uint32(0), jnp.uint32(0xFFFFFFFE)), unroll=False,
+    )
+    above = candidates & (u > thresh)
+    boundary = candidates & (u == thresh)
+    fill = remaining - above.sum(dtype=jnp.int32)
+    order = jnp.cumsum(boundary.astype(jnp.int32), axis=-1)
+    selected = above | (boundary & (order <= fill))
+    selected = selected & (remaining > 0)
+
+    counts = base + selected.astype(jnp.int32)
+    counts_ref[0, 0:1, :] = counts
+    remaining_ref[0, 0] = count - counts.sum()
+
+
+@partial(
+    jax.jit,
+    static_argnames=("job_distinct", "tg_distinct", "interpret"),
+)
+def solve_waterfill_pallas_batched(
+    total,       # [B, N, D] i32
+    sched_cap,   # [B, N, 2] f32
+    used0,       # [B, N, D] i32
+    job_count0,  # [B, N] i32
+    tg_count0,   # [B, N] i32
+    bw_avail,    # [B, N] i32
+    bw_used0,    # [B, N] i32
+    eligible,    # [B, N] bool
+    ask,         # [B, D] i32
+    bw_ask,      # [B] i32
+    count,       # [B] i32
+    penalty,     # [B] f32
+    job_distinct: bool,
+    tg_distinct: bool,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched water-fill, one grid step per eval. Same contract as
+    coalesce.solve_waterfill_batched: returns (counts [B, N], remaining
+    [B])."""
+    b, n, d_res = total.shape
+    # Node axis onto lanes: [B, N, D] -> [B, D, N] (fused upstream by XLA).
+    total_t = jnp.transpose(total, (0, 2, 1))
+    used_t = jnp.transpose(used0, (0, 2, 1))
+    cap_t = jnp.transpose(sched_cap, (0, 2, 1))
+    as_row = lambda v: v.reshape(b, 1, n).astype(jnp.int32)
+
+    smem = lambda shape: pl.BlockSpec(
+        shape, lambda i: (i,) + (0,) * (len(shape) - 1),
+        memory_space=pltpu.SMEM,
+    )
+    vmem = lambda shape: pl.BlockSpec(
+        shape, lambda i: (i,) + (0,) * (len(shape) - 1),
+        memory_space=pltpu.VMEM,
+    )
+
+    kernel = partial(
+        _waterfill_kernel, d_res=d_res,
+        job_distinct=job_distinct, tg_distinct=tg_distinct,
+    )
+    counts, remaining = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[
+            smem((1, d_res)),            # ask
+            smem((1, 1)),                # bw_ask
+            smem((1, 1)),                # count
+            smem((1, 1)),                # penalty
+            vmem((1, d_res, n)),         # total
+            vmem((1, d_res, n)),         # used
+            vmem((1, 2, n)),             # sched_cap
+            vmem((1, 1, n)),             # job_count
+            vmem((1, 1, n)),             # tg_count
+            vmem((1, 1, n)),             # bw_avail
+            vmem((1, 1, n)),             # bw_used
+            vmem((1, 1, n)),             # eligible
+        ],
+        out_specs=[
+            vmem((1, 1, n)),             # counts
+            smem((1, 1)),                # remaining
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1, n), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        ask.astype(jnp.int32),
+        bw_ask.reshape(b, 1).astype(jnp.int32),
+        count.reshape(b, 1).astype(jnp.int32),
+        penalty.reshape(b, 1).astype(jnp.float32),
+        total_t, used_t, cap_t,
+        as_row(job_count0), as_row(tg_count0),
+        as_row(bw_avail), as_row(bw_used0),
+        as_row(eligible),
+    )
+    return counts.reshape(b, n), remaining.reshape(b)
+
+
+def solve_waterfill_pallas(
+    total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+    eligible, ask, bw_ask, count, penalty,
+    job_distinct: bool, tg_distinct: bool, interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-eval wrapper: same contract as binpack.solve_waterfill."""
+    counts, remaining = solve_waterfill_pallas_batched(
+        total[None], sched_cap[None], used0[None], job_count0[None],
+        tg_count0[None], bw_avail[None], bw_used0[None], eligible[None],
+        jnp.asarray(ask)[None], jnp.asarray(bw_ask).reshape(1),
+        jnp.asarray(count, dtype=jnp.int32).reshape(1),
+        jnp.asarray(penalty, dtype=jnp.float32).reshape(1),
+        job_distinct, tg_distinct, interpret=interpret,
+    )
+    return counts[0], remaining[0]
+
+
+# -- enablement ------------------------------------------------------------
+
+_STATE = {"failed": False, "proven": set()}
+
+
+def is_proven(key) -> bool:
+    """True once a compiled dispatch of this shape bucket has executed
+    cleanly. Until then the coalescer blocks on the result INSIDE its try
+    block, so an async execution fault (Mosaic runtime error, device OOM)
+    still reaches the fallback instead of surfacing at an uncovered
+    fetch(). Per-shape: a new node/batch bucket is a new program."""
+    return key in _STATE["proven"]
+
+
+def mark_proven(key) -> None:
+    _STATE["proven"].add(key)
+
+
+def pallas_mode() -> str:
+    """'off' | 'compiled' | 'interpret', from NOMAD_TPU_PALLAS:
+    '1'/'compiled' force the compiled kernel, 'interpret' runs the
+    interpreter (CPU-testable), '0' disables. Default: compiled on a TPU
+    backend, off elsewhere."""
+    if _STATE["failed"]:
+        return "off"
+    env = os.environ.get("NOMAD_TPU_PALLAS", "").strip().lower()
+    if env in ("0", "off"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    if env in ("1", "compiled", "on"):
+        return "compiled"
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return "off"
+    return "compiled" if backend not in ("cpu",) else "off"
+
+
+def mark_pallas_failed() -> None:
+    """Called by the coalescer when lowering/executing the kernel raises:
+    disables the pallas path for the process so every later dispatch goes
+    straight to the jnp water-fill."""
+    _STATE["failed"] = True
+
+
+def reset_pallas_failed() -> None:
+    _STATE["failed"] = False
+    _STATE["proven"] = set()

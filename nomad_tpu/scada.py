@@ -332,6 +332,7 @@ class UplinkBroker:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        accepted = False
         try:
             conn.settimeout(HANDSHAKE_TIMEOUT)
             _set_send_timeout(conn, SEND_TIMEOUT)
@@ -340,7 +341,6 @@ class UplinkBroker:
             if not isinstance(hello, dict) or not isinstance(
                 hello.get("args", {}), dict
             ):
-                conn.close()
                 return
             args = hello.get("args", {})
             if hello.get("method") != "handshake":
@@ -358,9 +358,14 @@ class UplinkBroker:
             _send_frame(conn, {"seq": hello.get("seq"), "error": None,
                                "result": {"ok": True}})
             conn.settimeout(None)
-        except (OSError, ValueError):
-            conn.close()
+            accepted = True
+        except Exception:
+            # Non-protocol bytes (a TLS probe, a port scan) raise RPCError
+            # or worse — never let a daemon thread die with a traceback.
             return
+        finally:
+            if not accepted:
+                conn.close()
         # Never retain the shared secret: sessions() is dashboard-facing.
         args = {k: v for k, v in args.items() if k != "token"}
         sess = _BrokerSession(conn, args)

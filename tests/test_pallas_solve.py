@@ -1,0 +1,141 @@
+"""Differential tests: the pallas water-fill kernel vs the jnp path.
+
+The kernel must be bit-identical to ops/binpack.solve_waterfill (which is
+itself differential-fuzzed against the host oracle), so the pallas path
+inherits the whole oracle-parity chain. Runs in interpret mode on the CPU
+backend; the compiled path is exercised on real TPU by bench.py."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nomad_tpu.ops import pallas_solve
+from nomad_tpu.ops.binpack import solve_waterfill
+from nomad_tpu.ops.coalesce import solve_waterfill_batched
+from nomad_tpu.ops.pallas_solve import (
+    solve_waterfill_pallas,
+    solve_waterfill_pallas_batched,
+)
+
+
+def random_instance(rng, n, d=4):
+    total = rng.integers(100, 5000, size=(n, d)).astype(np.int32)
+    used = (total * rng.uniform(0, 0.9, size=(n, d))).astype(np.int32)
+    sched_cap = total[:, :2].astype(np.float32)
+    jc = rng.integers(0, 3, size=n).astype(np.int32)
+    tc = rng.integers(0, 2, size=n).astype(np.int32)
+    bw_avail = rng.integers(0, 1000, size=n).astype(np.int32)
+    bw_used = (bw_avail * rng.uniform(0, 1.0, size=n)).astype(np.int32)
+    elig = rng.random(n) < 0.8
+    ask = rng.integers(0, 500, size=d).astype(np.int32)
+    bw_ask = int(rng.integers(0, 100))
+    count = int(rng.integers(0, 3 * n))
+    penalty = float(rng.choice([0.0, 5.0, 10.0]))
+    return (
+        jnp.asarray(total), jnp.asarray(sched_cap), jnp.asarray(used),
+        jnp.asarray(jc), jnp.asarray(tc), jnp.asarray(bw_avail),
+        jnp.asarray(bw_used), jnp.asarray(elig), jnp.asarray(ask),
+        jnp.int32(bw_ask), jnp.int32(count), jnp.float32(penalty),
+    )
+
+
+def assert_match(args, jd, td):
+    c0, r0 = solve_waterfill(*args, jd, td)
+    c1, r1 = solve_waterfill_pallas(*args, jd, td, interpret=True)
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    assert int(r0) == int(r1)
+
+
+def test_differential_random():
+    rng = np.random.default_rng(7)
+    for _ in range(4):
+        assert_match(random_instance(rng, 64), False, False)
+
+
+def test_differential_distinct_flags():
+    rng = np.random.default_rng(8)
+    assert_match(random_instance(rng, 64), True, False)
+    assert_match(random_instance(rng, 64), False, True)
+
+
+def test_edge_cases():
+    rng = np.random.default_rng(9)
+    args = list(random_instance(rng, 64))
+    # count=0: nothing places
+    args[10] = jnp.int32(0)
+    assert_match(tuple(args), False, False)
+    # demand exceeding total capacity: all capacity used, rest unplaced
+    args[10] = jnp.int32(10_000_000)
+    assert_match(tuple(args), False, False)
+    # nothing eligible
+    args[7] = jnp.zeros_like(args[7])
+    args[10] = jnp.int32(50)
+    assert_match(tuple(args), False, False)
+
+
+def test_tie_break_matches_stable_argsort():
+    # Identical nodes -> identical scores: the partial round must pick
+    # the lowest node indices, like the jnp path's stable argsort.
+    n = 64
+    total = jnp.full((n, 4), 1000, dtype=jnp.int32)
+    args = (
+        total, total[:, :2].astype(jnp.float32),
+        jnp.zeros((n, 4), jnp.int32),
+        jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+        jnp.full((n,), 100, jnp.int32), jnp.zeros((n,), jnp.int32),
+        jnp.ones((n,), bool), jnp.asarray([10, 10, 0, 0], jnp.int32),
+        jnp.int32(0), jnp.int32(7), jnp.float32(0.0),
+    )
+    c0, r0 = solve_waterfill(*args, False, False)
+    c1, r1 = solve_waterfill_pallas(*args, False, False, interpret=True)
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    assert int(np.asarray(c1).sum()) == 7
+    assert np.asarray(c1)[:7].sum() == 7  # lowest indices won the tie
+
+
+def test_batched_matches_vmapped():
+    rng = np.random.default_rng(11)
+    rows = [random_instance(rng, 64) for _ in range(3)]
+    # Pad to a uniform batch the way the coalescer stacks entries.
+    cols = list(zip(*(r[:10] for r in rows)))
+    stacked = [jnp.stack(c) for c in cols]
+    counts = jnp.asarray([int(r[10]) for r in rows], dtype=jnp.int32)
+    pens = jnp.asarray([float(r[11]) for r in rows], dtype=jnp.float32)
+    c0, r0 = solve_waterfill_batched(*stacked, counts, pens, False, False)
+    c1, r1 = solve_waterfill_pallas_batched(
+        *stacked, counts, pens, False, False, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    np.testing.assert_array_equal(np.asarray(r0), np.asarray(r1))
+
+
+def test_coalescer_uses_pallas_in_interpret_mode(monkeypatch):
+    from nomad_tpu.ops.coalesce import CoalescingSolver
+
+    monkeypatch.setenv("NOMAD_TPU_PALLAS", "interpret")
+    pallas_solve.reset_pallas_failed()
+    assert pallas_solve.pallas_mode() == "interpret"
+    rng = np.random.default_rng(12)
+    args = random_instance(rng, 64)
+    solver = CoalescingSolver()
+    fetch = solver.submit(*args[:10], int(args[10]), float(args[11]))
+    counts, unplaced = fetch()
+    c0, r0 = solve_waterfill(*args, False, False)
+    np.testing.assert_array_equal(np.asarray(c0), counts)
+    assert int(r0) == unplaced
+
+
+def test_fallback_disables_pallas(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_PALLAS", "interpret")
+    pallas_solve.reset_pallas_failed()
+    assert pallas_solve.pallas_mode() == "interpret"
+    pallas_solve.mark_pallas_failed()
+    assert pallas_solve.pallas_mode() == "off"
+    pallas_solve.reset_pallas_failed()
+
+
+def test_mode_defaults_off_on_cpu(monkeypatch):
+    monkeypatch.delenv("NOMAD_TPU_PALLAS", raising=False)
+    pallas_solve.reset_pallas_failed()
+    assert pallas_solve.pallas_mode() == "off"  # tests pin the cpu backend
