@@ -538,32 +538,41 @@ def run_config5():
     }
 
 
+def _measure_headline():
+    """The one headline measurement protocol (config 3): build, warm one
+    pass, clear, RUNS timed passes, medians. Shared by main() and the
+    cpu-fallback path so the two emitted figures stay comparable.
+    Returns (solve_p50, e2e_p50, placed, nodes)."""
+    nodes, job = build_cluster()
+    state = build_state(nodes, job)
+    _TimingStack.install()
+
+    # Warmup: compile caches for the shape buckets
+    run_once(state, job)
+    _TimingStack.solve_times.clear()
+
+    e2e_times = []
+    placed = 0
+    for _ in range(RUNS):
+        e2e, placed = run_once(state, job)
+        e2e_times.append(e2e)
+
+    if not _TimingStack.solve_times:
+        raise RuntimeError(
+            "no device solves recorded — the TPU factories fell back "
+            "to the host scheduler mid-run"
+        )
+    solve_p50 = statistics.median(_TimingStack.solve_times)
+    e2e_p50 = statistics.median(e2e_times)
+    return solve_p50, e2e_p50, placed, nodes
+
+
 def main():
     backend = "unknown"
     try:
         backend = acquire_device()
 
-        nodes, job = build_cluster()
-        state = build_state(nodes, job)
-        _TimingStack.install()
-
-        # Warmup: compile caches for the shape buckets
-        run_once(state, job)
-        _TimingStack.solve_times.clear()
-
-        e2e_times = []
-        placed = 0
-        for _ in range(RUNS):
-            e2e, placed = run_once(state, job)
-            e2e_times.append(e2e)
-
-        if not _TimingStack.solve_times:
-            raise RuntimeError(
-                "no device solves recorded — the TPU factories fell back "
-                "to the host scheduler mid-run"
-            )
-        solve_p50 = statistics.median(_TimingStack.solve_times)
-        e2e_p50 = statistics.median(e2e_times)
+        solve_p50, e2e_p50, placed, nodes = _measure_headline()
         placements_per_sec = placed / solve_p50
 
         coalesce_wall, coalesce_placed, coalesce_dispatches = run_coalesced(
@@ -604,18 +613,62 @@ def main():
         )
     except BaseException as e:  # always emit the JSON line, never a traceback
         traceback.print_exc(file=sys.stderr)
-        emit(
-            {
-                "metric": "placements_per_sec@10k_nodes_x_100k_tasks",
-                "value": 0,
-                "unit": "placements/s",
-                "vs_baseline": 0,
-                "backend": backend,
-                "error": f"{type(e).__name__}: {e}",
-            }
-        )
+        payload = {
+            "metric": "placements_per_sec@10k_nodes_x_100k_tasks",
+            "value": 0,
+            "unit": "placements/s",
+            "vs_baseline": 0,
+            "backend": backend,
+            "error": f"{type(e).__name__}: {e}",
+        }
+        if isinstance(e, RuntimeError) and "device backend unavailable" in str(e):
+            # Device tier is provably unreachable (the error above carries
+            # the staged probe forensics). Measure the headline on the CPU
+            # backend anyway so the record holds a real, honestly-labeled
+            # number instead of only a zero. rc stays 1; value stays 0.
+            try:
+                payload["cpu_fallback"] = _cpu_fallback_headline()
+            except BaseException as fe:
+                payload["cpu_fallback"] = {
+                    "error": f"{type(fe).__name__}: {fe}"
+                }
+        emit(payload)
         _exit(1)
     _exit(0)
+
+
+def _cpu_fallback_headline():
+    """Headline measurement on the CPU backend, used only when device
+    acquisition failed. The subprocess-isolated probe design means this
+    process never touched jax, so it can still claim the CPU cleanly:
+    NOMAD_TPU_PROBE_FORCE_CPU re-pins the platform for the next probe
+    child AND the in-process init (scheduler/__init__.py manager loop)."""
+    os.environ["NOMAD_TPU_PROBE_FORCE_CPU"] = "1"
+    from nomad_tpu.scheduler import device_probe_status, wait_for_device
+
+    solver = wait_for_device(timeout=300)
+    status = device_probe_status()
+    if solver is None:
+        raise RuntimeError(f"cpu fallback also unavailable: {status}")
+    # The manager may have been past the force-cpu check and finished the
+    # REAL device init during our wait — label whatever actually claimed.
+    fb_backend = str(status.get("backend", "cpu"))
+    solve_p50, e2e_p50, placed, _nodes = _measure_headline()
+    return {
+        "backend": fb_backend,
+        "note": (
+            f"measured on the {fb_backend} backend after device "
+            "acquisition timed out"
+            + ("; NOT a TPU number" if fb_backend == "cpu" else
+               " (device came up during the fallback wait)")
+        ),
+        "placements_per_sec": round(placed / solve_p50, 1),
+        "solve_ms_p50": round(solve_p50 * 1000, 2),
+        "e2e_eval_ms_p50": round(e2e_p50 * 1000, 2),
+        "placed": placed,
+        "n_nodes": N_NODES,
+        "n_tasks": N_TASKS,
+    }
 
 
 def _exit(code: int) -> None:

@@ -41,6 +41,28 @@ def _pallas_fallback() -> None:
     telemetry.incr_counter(("scheduler", "coalesce", "pallas_fallback"))
 
 
+def _pallas_dispatch(batched: bool, args, jd: bool, td: bool, shape):
+    """Try the pallas kernel; None means 'use the jnp path' (mode off or
+    the kernel just failed and was disabled). Each shape bucket's first
+    dispatch is proven synchronously so an async runtime fault (Mosaic
+    error, device OOM) reaches the except here, not a caller's fetch()."""
+    mode = pallas_solve.pallas_mode()
+    if mode == "off":
+        return None
+    fn = (pallas_solve.solve_waterfill_pallas_batched if batched
+          else pallas_solve.solve_waterfill_pallas)
+    try:
+        out = fn(*args, jd, td, interpret=mode == "interpret")
+        key = (shape, jd, td)
+        if not pallas_solve.is_proven(key):
+            jax.block_until_ready(out)
+            pallas_solve.mark_proven(key)
+        return out
+    except Exception:
+        _pallas_fallback()
+        return None
+
+
 @partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
 def solve_waterfill_batched(
     total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
@@ -197,23 +219,12 @@ class CoalescingSolver:
         penalty = jnp.float32(e.args[11])
         mesh = mesh_lib.mesh_for_nodes(args10[0].shape[0])
         if mesh is None:
-            mode = pallas_solve.pallas_mode()
-            if mode != "off":
-                try:
-                    out = pallas_solve.solve_waterfill_pallas(
-                        *args10, count, penalty, e.args[12], e.args[13],
-                        interpret=mode == "interpret",
-                    )
-                    # Dispatch is async: until this shape bucket has
-                    # proven clean, block here so a runtime kernel fault
-                    # hits THIS except, not the caller's fetch().
-                    key = (args10[0].shape, e.args[12], e.args[13])
-                    if not pallas_solve.is_proven(key):
-                        jax.block_until_ready(out)
-                        pallas_solve.mark_proven(key)
-                    return out
-                except Exception:
-                    _pallas_fallback()
+            out = _pallas_dispatch(
+                False, (*args10, count, penalty), e.args[12], e.args[13],
+                args10[0].shape,
+            )
+            if out is not None:
+                return out
         else:
             args10 = mesh_lib.shard_waterfill_args(mesh, args10)
             count, penalty = mesh_lib.replicate_on_mesh(mesh, count, penalty)
@@ -244,14 +255,10 @@ class CoalescingSolver:
             e.event.set()
 
 
-def _stack_and_solve(rows, jd: bool, td: bool):
-    """Pad the eval axis to its power-of-two bucket, shard on the mesh,
-    dispatch the vmapped water-fill. The ONE stacking implementation —
-    shared by the dispatcher and warm_batch_shapes so warmup provably
-    compiles the exact shapes real dispatches use. Padding rows repeat
-    row 0 with count=0 (a no-op solve)."""
+def _stack_rows(rows, jd: bool, td: bool):
+    """Pad the eval axis to its power-of-two bucket and stack the arg
+    columns. Padding rows repeat row 0 with count=0 (a no-op solve)."""
     from nomad_tpu.ops.binpack import bucket
-    from nomad_tpu.parallel import mesh as mesh_lib
 
     b = bucket(len(rows), floor=2)
     rows = list(rows)
@@ -260,24 +267,24 @@ def _stack_and_solve(rows, jd: bool, td: bool):
     stacked = [jnp.stack(col) for col in cols]
     counts = jnp.asarray([r[10] for r in rows], dtype=jnp.int32)
     penalties = jnp.asarray([r[11] for r in rows], dtype=jnp.float32)
+    return stacked, counts, penalties
+
+
+def _stack_and_solve(rows, jd: bool, td: bool):
+    """Stack the eval axis (_stack_rows), shard on the mesh, dispatch the
+    batched water-fill. The ONE stacking implementation — shared by the
+    dispatcher and warm_batch_shapes so warmup provably compiles the exact
+    shapes real dispatches use."""
+    from nomad_tpu.parallel import mesh as mesh_lib
+
+    stacked, counts, penalties = _stack_rows(rows, jd, td)
     mesh = mesh_lib.mesh_for_nodes(stacked[0].shape[1])
     if mesh is None:
-        mode = pallas_solve.pallas_mode()
-        if mode != "off":
-            try:
-                out = pallas_solve.solve_waterfill_pallas_batched(
-                    *stacked, counts, penalties, jd, td,
-                    interpret=mode == "interpret",
-                )
-                # See _solve_one: prove each shape bucket synchronously
-                # so async kernel faults reach the fallback.
-                key = (stacked[0].shape, jd, td)
-                if not pallas_solve.is_proven(key):
-                    jax.block_until_ready(out)
-                    pallas_solve.mark_proven(key)
-                return out
-            except Exception:
-                _pallas_fallback()
+        out = _pallas_dispatch(
+            True, (*stacked, counts, penalties), jd, td, stacked[0].shape
+        )
+        if out is not None:
+            return out
     else:
         stacked, counts, penalties = mesh_lib.shard_waterfill_batch_args(
             mesh, stacked, counts, penalties
@@ -304,7 +311,13 @@ def warm_batch_shapes(n_padded: int, buckets=(1, 2, 4, 8), stop=None) -> int:
     args = (zero4, zcap, zero4, zvec, zvec, zvec, zvec, elig,
             jnp.zeros((4,), dtype=jnp.int32), jnp.int32(0),
             0, 0.0, False, False)
+    from nomad_tpu.parallel import mesh as mesh_lib
+
     done = 0
+    # The jnp fallback warm only matters where a pallas fault can route to
+    # it: unsharded deployments (a mesh never reaches _pallas_dispatch).
+    warm_jnp = (pallas_solve.pallas_mode() != "off"
+                and mesh_lib.mesh_for_nodes(n_padded) is None)
     for b in buckets:
         if stop is not None and stop():
             return done
@@ -313,5 +326,20 @@ def warm_batch_shapes(n_padded: int, buckets=(1, 2, 4, 8), stop=None) -> int:
         else:
             counts_dev, _rem = _stack_and_solve([args] * b, False, False)
         jax.block_until_ready(counts_dev)
+        if warm_jnp:
+            # The dispatches above warmed the pallas programs; compile the
+            # jnp water-fill at the same shapes too, so a mid-run pallas
+            # fault degrades to a WARM fallback, not cold compiles at peak.
+            if b == 1:
+                jnp_out, _ = solve_waterfill(
+                    *args[:10], jnp.int32(0), jnp.float32(0.0), False, False
+                )
+            else:
+                stacked, counts, penalties = _stack_rows([args] * b, False,
+                                                         False)
+                jnp_out, _ = solve_waterfill_batched(
+                    *stacked, counts, penalties, False, False
+                )
+            jax.block_until_ready(jnp_out)
         done += 1
     return done

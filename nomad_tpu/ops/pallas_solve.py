@@ -38,8 +38,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+# jax.experimental.pallas costs >1s to import; it is pulled in lazily at
+# first trace (inside solve_waterfill_pallas_batched) so control-plane
+# startup and CPU-only deployments never pay for it.
 
 # Python scalars, not jnp values: the kernel must not capture traced
 # constants (pallas requires closures to be static).
@@ -204,6 +205,9 @@ def solve_waterfill_pallas_batched(
     """Batched water-fill, one grid step per eval. Same contract as
     coalesce.solve_waterfill_batched: returns (counts [B, N], remaining
     [B])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     b, n, d_res = total.shape
     # Node axis onto lanes: [B, N, D] -> [B, D, N] (fused upstream by XLA).
     total_t = jnp.transpose(total, (0, 2, 1))
@@ -316,7 +320,8 @@ def pallas_mode() -> str:
         backend = jax.default_backend()
     except Exception:
         return "off"
-    return "compiled" if backend not in ("cpu",) else "off"
+    # TPU only (the kernel is pltpu): a GPU backend must not attempt it.
+    return "compiled" if backend in ("tpu", "axon") else "off"
 
 
 def mark_pallas_failed() -> None:

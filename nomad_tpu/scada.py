@@ -362,6 +362,9 @@ class UplinkBroker:
         except Exception:
             # Non-protocol bytes (a TLS probe, a port scan) raise RPCError
             # or worse — never let a daemon thread die with a traceback.
+            # Logged at debug with the stack so an internal handshake bug
+            # is still distinguishable from scanner noise.
+            self.logger.debug("broker: handshake failed", exc_info=True)
             return
         finally:
             if not accepted:

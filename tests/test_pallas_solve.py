@@ -124,6 +124,10 @@ def test_coalescer_uses_pallas_in_interpret_mode(monkeypatch):
     c0, r0 = solve_waterfill(*args, False, False)
     np.testing.assert_array_equal(np.asarray(c0), counts)
     assert int(r0) == unplaced
+    # The pallas path must have actually run — a silent fallback to the
+    # jnp solver would produce identical results and mask a regression.
+    assert not pallas_solve._STATE["failed"]
+    assert len(pallas_solve._STATE["proven"]) >= 1
 
 
 def test_fallback_disables_pallas(monkeypatch):
@@ -139,3 +143,34 @@ def test_mode_defaults_off_on_cpu(monkeypatch):
     monkeypatch.delenv("NOMAD_TPU_PALLAS", raising=False)
     pallas_solve.reset_pallas_failed()
     assert pallas_solve.pallas_mode() == "off"  # tests pin the cpu backend
+
+
+# The fuzz corpus: the same randomized instances the waterfill/rounds/
+# greedy three-way agreement runs on (test_fuzz_differential.py), so the
+# pallas kernel joins the oracle-parity chain at its widest point.
+N_PALLAS_FUZZ_SEEDS = int(__import__("os").environ.get(
+    "NOMAD_TPU_PALLAS_FUZZ_SEEDS", 16))
+
+
+@pytest.mark.parametrize("seed", range(N_PALLAS_FUZZ_SEEDS))
+def test_fuzz_pallas_vs_waterfill(seed):
+    from test_fuzz_differential import _random_solve_inputs
+
+    rng = np.random.default_rng(10_000 + seed)  # same corpus as threeway
+    s = _random_solve_inputs(rng)
+    sched_cap = s["total"][:, :2].astype(np.float32)
+    args = (
+        jnp.asarray(s["total"]), jnp.asarray(sched_cap),
+        jnp.asarray(s["used"]), jnp.asarray(s["job_count"]),
+        jnp.asarray(s["tg_count"]), jnp.asarray(s["bw_avail"]),
+        jnp.asarray(s["bw_used"]), jnp.asarray(s["eligible"]),
+        jnp.asarray(s["ask"]), jnp.int32(s["bw_ask"]),
+        jnp.int32(s["count"]), jnp.float32(s["penalty"]),
+    )
+    c0, r0 = solve_waterfill(*args, s["jd"], s["td"])
+    c1, r1 = solve_waterfill_pallas(*args, s["jd"], s["td"], interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(c0), np.asarray(c1),
+        err_msg=f"pallas != waterfill (seed {seed})",
+    )
+    assert int(r0) == int(r1), seed
