@@ -621,18 +621,25 @@ def main():
             "backend": backend,
             "error": f"{type(e).__name__}: {e}",
         }
-        if isinstance(e, RuntimeError) and "device backend unavailable" in str(e):
-            # Device tier is provably unreachable (the error above carries
-            # the staged probe forensics). Measure the headline on the CPU
-            # backend anyway so the record holds a real, honestly-labeled
-            # number instead of only a zero. rc stays 1; value stays 0.
-            try:
-                payload["cpu_fallback"] = _cpu_fallback_headline()
-            except BaseException as fe:
-                payload["cpu_fallback"] = {
-                    "error": f"{type(fe).__name__}: {fe}"
-                }
+        # The one-line stdout contract comes FIRST — a harness timeout
+        # killing the process mid-fallback must never cost the JSON line.
         emit(payload)
+        device_dead = isinstance(e, RuntimeError) and (
+            "device backend unavailable" in str(e)
+            or "jax initialized on the CPU" in str(e)
+        )
+        if device_dead:
+            # Device tier is unreachable (the error above carries the
+            # staged probe forensics). Measure the headline on the CPU
+            # backend anyway and print it to STDERR as a labeled JSON
+            # line — the capture tail holds a real, honestly-labeled
+            # number next to the zero-value contract line.
+            try:
+                fb = _cpu_fallback_headline()
+            except BaseException as fe:
+                fb = {"error": f"{type(fe).__name__}: {fe}"}
+            print("CPU_FALLBACK " + json.dumps(fb), file=sys.stderr,
+                  flush=True)
         _exit(1)
     _exit(0)
 
@@ -646,7 +653,7 @@ def _cpu_fallback_headline():
     os.environ["NOMAD_TPU_PROBE_FORCE_CPU"] = "1"
     from nomad_tpu.scheduler import device_probe_status, wait_for_device
 
-    solver = wait_for_device(timeout=300)
+    solver = wait_for_device(timeout=150)
     status = device_probe_status()
     if solver is None:
         raise RuntimeError(f"cpu fallback also unavailable: {status}")

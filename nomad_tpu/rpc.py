@@ -72,6 +72,19 @@ class RemoteError(RPCError):
     """An error raised by the remote handler."""
 
 
+class RPCUndeliveredError(RPCError):
+    """Transport failed BEFORE the request reached the peer (connect
+    failure, or sendall raised so the length-prefixed frame is incomplete
+    and the peer's codec drops the connection without dispatching). Safe
+    to retry even for non-idempotent RPCs — the handler never ran."""
+
+
+class RPCTimeoutError(RPCError):
+    """The per-call deadline expired with the request possibly executed
+    remotely (response lost or late). NOT safe to blindly retry
+    non-idempotent RPCs."""
+
+
 def _send_frame(sock: socket.socket, obj: Any) -> None:
     data = json.dumps(obj).encode()
     sock.sendall(_LEN.pack(len(data)) + data)
@@ -259,7 +272,10 @@ class _MuxConn:
         waiter = _Waiter()
         with self.lock:
             if self.dead is not None:
-                raise RPCError(f"connection to {self.addr} is down: {self.dead}")
+                # Nothing was sent yet: undelivered, retryable.
+                raise RPCUndeliveredError(
+                    f"connection to {self.addr} is down: {self.dead}"
+                )
             self.pending[seq] = waiter
         return waiter
 
@@ -320,10 +336,12 @@ class ConnPool:
         except (ConnectionError, OSError, ValueError) as e:
             mux.forget(seq)
             self._invalidate(addr, mux)
-            raise RPCError(f"rpc to {addr} failed: {e}") from e
+            # sendall raised -> the frame is incomplete -> the peer never
+            # dispatched it: undelivered, retryable.
+            raise RPCUndeliveredError(f"rpc to {addr} failed: {e}") from e
         if not waiter.event.wait(timeout or self.timeout):
             mux.forget(seq)
-            raise RPCError(f"rpc to {addr} timed out: {method}")
+            raise RPCTimeoutError(f"rpc to {addr} timed out: {method}")
         resp = waiter.resp
         if resp is None:  # reader died: transport failure
             self._invalidate(addr, mux)
@@ -341,7 +359,9 @@ class ConnPool:
         try:
             sock = socket.create_connection((host, int(port)), timeout=self.timeout)
         except OSError as e:
-            raise RPCError(f"failed to connect to {addr}: {e}") from e
+            raise RPCUndeliveredError(
+                f"failed to connect to {addr}: {e}"
+            ) from e
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Kernel send timeout bounds sendall on a peer that stopped
         # reading (the write_lock holder must never block forever);

@@ -17,7 +17,13 @@ from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.api.codec import from_dict, to_dict
 from nomad_tpu.raft import NotLeaderError, RaftConfig, RaftNode
-from nomad_tpu.rpc import ConnPool, RPCError, RPCServer, RemoteError
+from nomad_tpu.rpc import (
+    ConnPool,
+    RPCError,
+    RPCServer,
+    RPCUndeliveredError,
+    RemoteError,
+)
 from nomad_tpu.server.server import Server, ServerConfig
 from nomad_tpu.structs import (
     Allocation,
@@ -206,14 +212,36 @@ class ClusterServer(Server):
         """Forward an RPC to the current leader. Waits briefly for leader
         discovery (a follower learns the leader from the first heartbeat of a
         term); raises NotLeaderError if none appears — callers back off and
-        retry like the reference worker (worker.go:398-411)."""
+        retry like the reference worker (worker.go:398-411).
+
+        Undelivered requests (stale leader address across an election, a
+        connection the peer closed before the frame went out) are retried
+        twice against the freshly-discovered leader — the handler provably
+        never ran, so even non-idempotent RPCs are safe to replay.
+        Timeouts and lost responses are NOT retried: the request may have
+        executed, and the delivery guarantees belong to the caller (the
+        broker's Nack machinery, raft-upsert idempotency)."""
         import time as _time
 
         deadline = _time.monotonic() + 1.0
+        # At most one retry per address: a severed-but-healthy leader conn
+        # reconnects on the first retry; a blackholed leader (connect
+        # timeout) must not burn attempt x connect-timeout before failing.
+        undelivered_to: dict = {}
         while True:
             leader = self.raft.leader_addr
             if leader:
-                return self.pool.call(leader, method, args, timeout=timeout)
+                try:
+                    return self.pool.call(leader, method, args,
+                                          timeout=timeout)
+                except RPCUndeliveredError:
+                    if undelivered_to.get(leader, 0) >= 1 or \
+                            len(undelivered_to) >= 3:
+                        raise
+                    undelivered_to[leader] = 1
+                    deadline = _time.monotonic() + 1.0
+                    _time.sleep(0.1)
+                    continue
             if self.raft.is_leader or _time.monotonic() >= deadline:
                 raise NotLeaderError("")
             _time.sleep(0.02)

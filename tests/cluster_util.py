@@ -1,0 +1,54 @@
+"""Shared helpers for multi-server cluster tests.
+
+``retry_write`` is the testutil.WaitForResult posture of the reference
+(/root/reference/testutil/wait.go:13-29): cluster writes may race a leader
+transition — the server surfaces NotLeaderError / transport errors exactly
+like the reference's raftApply, and the CLIENT retries. Under CPU
+contention (a parallel test suite, a busy CI box) the in-process clusters'
+150-300ms election timeouts churn, so direct server-method calls in tests
+need the same retry discipline real clients have.
+"""
+
+from __future__ import annotations
+
+import time
+
+from nomad_tpu.raft import NotLeaderError
+from nomad_tpu.rpc import RPCError, RemoteError
+from nomad_tpu.server.cluster import ClusterConfig
+
+
+def relaxed_cluster_cfg(**kw) -> ClusterConfig:
+    """Raft timing for IN-PROCESS test clusters. The production defaults
+    (50ms heartbeat / 150-300ms elections) assume parallel servers; with
+    3 servers' threads in one GIL, a busy test process can stall a
+    leader's heartbeat past the election deadline and churn leadership
+    mid-test. Doubling the window makes churn rare while keeping failover
+    tests fast (elections still settle in under a second)."""
+    kw.setdefault("heartbeat_interval", 0.1)
+    kw.setdefault("election_timeout_min", 0.4)
+    kw.setdefault("election_timeout_max", 0.8)
+    return ClusterConfig(**kw)
+
+
+def retry_write(fn, timeout: float = 15.0, interval: float = 0.1):
+    """Run ``fn`` until it stops raising leader-transition errors or the
+    timeout expires; returns fn's result. Last error re-raised on expiry.
+
+    RemoteError is retried ONLY when it is a NotLeaderError that crossed
+    the wire — a genuine handler failure (validation, missing resource)
+    must surface immediately, not burn the whole timeout."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return fn()
+        except RemoteError as e:
+            if "NotLeaderError" not in str(e) and "not the leader" not in str(e):
+                raise
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(interval)
+        except (NotLeaderError, RPCError, TimeoutError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(interval)

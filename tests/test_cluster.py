@@ -12,6 +12,8 @@ from nomad_tpu.rpc import ConnPool, RPCError, RPCServer, RemoteError
 from nomad_tpu.server import ServerConfig
 from nomad_tpu.server.cluster import ClusterServer, form_cluster, wait_for_leader
 
+from cluster_util import relaxed_cluster_cfg, retry_write
+
 
 # ---------------------------------------------------------------------------
 # RPC layer
@@ -59,7 +61,7 @@ def cluster3():
     servers = form_cluster(3, ServerConfig(
         scheduler_backend="host", num_schedulers=1,
         min_heartbeat_ttl=30.0,
-    ))
+    ), base_cluster=relaxed_cluster_cfg())
     yield servers
     for srv in servers:
         srv.shutdown()
@@ -104,12 +106,13 @@ def test_three_server_election_and_replication(cluster3):
         raise AssertionError("cluster never converged on one leader")
     assert len(followers) == 2
 
-    # Write through the leader; replicated state visible on all servers
+    # Write through the leader; replicated state visible on all servers.
+    # Writes retry across leader churn (the client posture, wait.go:13-29).
     node = mock.node()
-    leader.node_register(node)
+    retry_write(lambda: leader.node_register(node))
     job = mock.job()
     job.task_groups[0].count = 3
-    eval_id, _ = leader.job_register(job)
+    eval_id, _ = retry_write(lambda: leader.job_register(job))
     ev = leader.wait_for_eval(eval_id, timeout=15.0)
     assert ev.status == structs.EVAL_STATUS_COMPLETE
 
@@ -131,12 +134,12 @@ def test_follower_forwards_writes(cluster3):
     follower = next(s for s in cluster3 if s is not leader)
 
     node = mock.node()
-    reply = follower.node_register(node)
+    reply = retry_write(lambda: follower.node_register(node))
     assert reply["index"] > 0
 
     job = mock.job()
     job.task_groups[0].count = 2
-    eval_id, _ = follower.job_register(job)
+    eval_id, _ = retry_write(lambda: follower.job_register(job))
 
     # The eval completes cluster-wide; read from the follower's replica
     deadline = time.monotonic() + 15
@@ -149,7 +152,7 @@ def test_follower_forwards_writes(cluster3):
     assert len(follower.state_store.allocs_by_job(job.id)) == 2
 
     # Deregister via the follower too
-    eval_id2, _ = follower.job_deregister(job.id)
+    eval_id2, _ = retry_write(lambda: follower.job_deregister(job.id))
     deadline = time.monotonic() + 15
     while time.monotonic() < deadline:
         ev2 = follower.state_store.eval_by_id(eval_id2)

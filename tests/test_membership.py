@@ -14,6 +14,7 @@ import pytest
 
 from nomad_tpu import mock, structs
 from nomad_tpu.server import ServerConfig
+from cluster_util import relaxed_cluster_cfg, retry_write
 from nomad_tpu.server.cluster import (
     ClusterConfig,
     ClusterServer,
@@ -23,7 +24,7 @@ from nomad_tpu.server.cluster import (
 
 
 def _fast_cluster_cfg(**kw):
-    return ClusterConfig(
+    return relaxed_cluster_cfg(
         probe_interval=0.1, probe_timeout=0.25, suspicion_threshold=2, **kw
     )
 
@@ -71,10 +72,10 @@ def test_dead_follower_is_detected_evicted_and_quorum_updates():
 
         # Writes still commit: quorum is now 2 of 2, not 2 of 3 blocked
         # on a ghost member.
-        leader.node_register(mock.node())
+        retry_write(lambda: leader.node_register(mock.node()))
         job = mock.job()
         job.task_groups[0].count = 1
-        eval_id, _ = leader.job_register(job)
+        eval_id, _ = retry_write(lambda: leader.job_register(job))
         ev = leader.wait_for_eval(eval_id, timeout=15.0)
         assert ev.status == structs.EVAL_STATUS_COMPLETE
     finally:
@@ -91,10 +92,10 @@ def test_server_added_at_runtime_replicates_and_can_win_election():
     extra = None
     try:
         leader = wait_for_leader(servers, timeout=30.0)
-        leader.node_register(mock.node())
+        retry_write(lambda: leader.node_register(mock.node()))
         job = mock.job()
         job.task_groups[0].count = 2
-        eval_id, _ = leader.job_register(job)
+        eval_id, _ = retry_write(lambda: leader.job_register(job))
         leader.wait_for_eval(eval_id, timeout=15.0)
 
         # A third server joins at runtime via start_join.
@@ -133,7 +134,7 @@ def test_server_added_at_runtime_replicates_and_can_win_election():
         # in charge.
         job2 = mock.job()
         job2.task_groups[0].count = 1
-        eval_id2, _ = new_leader.job_register(job2)
+        eval_id2, _ = retry_write(lambda: new_leader.job_register(job2))
         ev2 = new_leader.wait_for_eval(eval_id2, timeout=15.0)
         assert ev2.status == structs.EVAL_STATUS_COMPLETE
     finally:
