@@ -123,6 +123,19 @@ def diff_system_allocs(
 ) -> DiffResult:
     """Per-node diff for system jobs; migrate becomes stop
     (reference: util.go:133-173)."""
+    if not allocs:
+        # Fresh registration: with no existing allocations every node's
+        # diff degenerates to place-everything — one flat loop instead of
+        # a full diff_allocs per node (the 10k-node hot case).
+        required = materialize_task_groups(job)
+        items = list(required.items())
+        result = DiffResult()
+        for node in nodes:
+            for name, tg in items:
+                tup = AllocTuple(name, tg)
+                tup.alloc = Allocation(node_id=node.id)
+                result.place.append(tup)
+        return result
     node_allocs: Dict[str, List[Allocation]] = {}
     for alloc in allocs:
         node_allocs.setdefault(alloc.node_id, []).append(alloc)

@@ -240,6 +240,14 @@ class _StateView:
             out.extend(self._t.blocks[bid].materialize())
         return out
 
+    def has_allocs_for_job(self, job_id: str) -> bool:
+        """Existence check WITHOUT materializing columnar blocks — the
+        guard fast paths (fresh-registration detection) need only the
+        answer, not 100k Allocation objects."""
+        if self._t.allocs_by_job.get(job_id):
+            return True
+        return bool(self._t.blocks_by_job.get(job_id))
+
     def allocs_by_node(self, node_id: str) -> List[Allocation]:
         out = self.allocs_by_node_objects(node_id)
         for blk in self._t.blocks.values():

@@ -911,8 +911,6 @@ class TPUSystemScheduler(SystemScheduler):
         with each node appearing once (the normal system diff shape —
         repeats and network offers take the per-alloc path). Returns True
         when the group was handled."""
-        from nomad_tpu.structs import AllocBatch
-
         if len(missing_list) < self.BATCH_PLACE_THRESHOLD:
             return False
         if tg_constr.size.networks or any(
@@ -924,44 +922,70 @@ class TPUSystemScheduler(SystemScheduler):
 
         # Pass 1 — pure validation, NO side effects: a bail-out here falls
         # back to the sequential path, which must not see half-recorded
-        # metrics.
+        # metrics. System names repeat one string per task group
+        # ("job.tg[0]" on every node), so the bracket parse is memoized.
         parsed = []
         seen = set()
+        name_memo: Dict[str, Optional[int]] = {}
         for missing in missing_list:
             nid = missing.alloc.node_id
             if nid in seen:
                 return False  # repeated node: sequential accounting path
             seen.add(nid)
             name = missing.name
-            lb = name.rfind("[")
-            if lb < 0 or not name.endswith("]"):
-                return False
-            try:
-                idx_val = int(name[lb + 1:-1])
-            except ValueError:
+            idx_val = name_memo.get(name, -2)
+            if idx_val == -2:
+                lb = name.rfind("[")
+                if lb < 0 or not name.endswith("]"):
+                    idx_val = None
+                else:
+                    try:
+                        idx_val = int(name[lb + 1:-1])
+                    except ValueError:
+                        idx_val = None
+                name_memo[name] = idx_val
+            if idx_val is None:
                 return False
             parsed.append((nid, idx_val))
 
-        # Pass 2 — fit decisions and metrics.
-        node_ids = []
-        name_idx = []
+        # Pass 2 — fit decisions and metrics. The common case (every
+        # pinned node fits) is one vectorized gather; the python loop only
+        # runs to attribute metrics to the failing nodes.
+        index = mirror.index
+        rows = [index.get(nid) for nid, _ in parsed]
+        if any(r is None for r in rows):
+            # Same invariant the sequential path enforces: a pinned
+            # placement must name a known eligible node.
+            bad = parsed[rows.index(None)][0]
+            raise SchedulerError(f"could not find node {bad!r}")
+        fits = fit_np[np.asarray(rows, dtype=np.int64)]
         failed = 0
         first_failed_idx = 0
-        index = mirror.index
-        for nid, idx_val in parsed:
-            row = index.get(nid)
-            if row is None:
-                # Same invariant the sequential path enforces: a pinned
-                # placement must name a known eligible node.
-                raise SchedulerError(f"could not find node {nid!r}")
-            if fit_np[row]:
-                node_ids.append(nid)
-                name_idx.append(idx_val)
-            else:
-                if failed == 0:
-                    first_failed_idx = idx_val
-                failed += 1
-                metrics.exhausted_node(mirror.nodes[row], "resources")
+        if bool(fits.all()):
+            node_ids = [nid for nid, _ in parsed]
+            name_idx = [idx for _, idx in parsed]
+        else:
+            node_ids = []
+            name_idx = []
+            for (nid, idx_val), row, ok in zip(parsed, rows, fits):
+                if ok:
+                    node_ids.append(nid)
+                    name_idx.append(idx_val)
+                else:
+                    if failed == 0:
+                        first_failed_idx = idx_val
+                    failed += 1
+                    metrics.exhausted_node(mirror.nodes[row], "resources")
+
+        self._emit_system_batch(tg, tg_constr, metrics, node_ids, name_idx,
+                                failed, first_failed_idx)
+        return True
+
+    def _emit_system_batch(self, tg, tg_constr, metrics, node_ids, name_idx,
+                           failed: int, first_failed_idx: int) -> None:
+        """Append the columnar placement batch (+ one coalesced failed
+        alloc) for a system task group."""
+        from nomad_tpu.structs import AllocBatch
 
         placed = len(node_ids)
         if placed:
@@ -996,45 +1020,109 @@ class TPUSystemScheduler(SystemScheduler):
             )
             failed_alloc.metrics.coalesced_failures += failed - 1
             self.plan.append_failed(failed_alloc)
+
+    def _system_fit(self, tg, tg_constr, mirror):
+        """One dispatch: fit for every node at once. Returns (prep,
+        fit_np) or None when no node is eligible (stack.prepare bail)."""
+        from nomad_tpu.ops.binpack import _greedy_step_state
+        from nomad_tpu.parallel import mesh as mesh_lib
+
+        prep = self.stack.prepare(tg, tg_constr)
+        if prep is None:
+            return None
+        ask, bw_ask, zero = prep.ask, prep.bw_ask, jnp.float32(0.0)
+        mesh = mesh_lib.mesh_for_nodes(mirror.total.shape[0])
+        if mesh is not None:
+            ask, bw_ask, zero = mesh_lib.replicate_on_mesh(
+                mesh, ask, bw_ask, zero
+            )
+        _score, fit = _greedy_step_state(
+            mirror.total, mirror.sched_cap, prep.used, prep.job_count,
+            prep.tg_count, mirror.bw_avail, prep.bw_used, prep.mask,
+            ask, bw_ask, zero,
+            prep.job_distinct, prep.tg_distinct,
+        )
+        return prep, np.asarray(fit)
+
+    def compute_job_allocs(self) -> None:
+        if self._fresh_columnar_allocs():
+            return
+        super().compute_job_allocs()
+
+    def _fresh_columnar_allocs(self) -> bool:
+        """Fully columnar fresh registration: a system job with no existing
+        allocations places one AllocBatch of unit runs per task group
+        straight from the mirror's fit mask — the per-node diff and its
+        10k AllocTuple/Allocation objects never exist. Falls back (False)
+        for small clusters, repeat counts, network asks, or any existing
+        allocs — those take the reference-shaped diff path."""
+        job = self.job
+        if job is None or len(self.nodes) < self.BATCH_PLACE_THRESHOLD:
+            return False
+        # Existence check only — materializing the alloc table here would
+        # double the cost the fallback path pays again (a job with only
+        # terminal allocs conservatively takes the diff path).
+        if self.state.has_allocs_for_job(self.eval.job_id):
+            return False
+        for tg in job.task_groups:
+            if tg.count > 1:
+                return False
+            if task_group_constraints(tg).size.networks or any(
+                t.resources is not None and t.resources.networks
+                for t in tg.tasks
+            ):
+                return False
+        self.limit_reached = False
+        _nodes, mirror = GLOBAL_MIRROR_CACHE.get(self.state, job.datacenters)
+        self.stack.set_mirror(mirror)
+        n = len(mirror.nodes)
+        for tg in job.task_groups:
+            self.ctx.reset()
+            tg_constr = task_group_constraints(tg)
+            metrics = self.ctx.metrics()
+            res = self._system_fit(tg, tg_constr, mirror)
+            if res is None:
+                continue  # same posture as compute_placements' prep bail
+            _prep, fit_np = res
+            fits = fit_np[:n]
+            placed_rows = np.nonzero(fits)[0]
+            nodes = mirror.nodes
+            node_ids = [nodes[i].id for i in placed_rows]
+            failed_rows = np.nonzero(~fits)[0]
+            for i in failed_rows:
+                metrics.exhausted_node(nodes[i], "resources")
+            self._emit_system_batch(
+                tg, tg_constr, metrics, node_ids,
+                np.zeros(len(node_ids), dtype=np.int64),
+                len(failed_rows), 0,
+            )
         return True
 
     def compute_placements(self, place: List[AllocTuple]) -> None:
         node_by_id = {node.id: node for node in self.nodes}
-        self.stack.set_nodes(self.nodes)
-        mirror = self.stack.mirror
+        # self.nodes IS ready_nodes_in_dcs(state, dcs) (system.py:95) — the
+        # exact set the mirror cache keys on, so repeat system evals of one
+        # state generation share a resident mirror like the generic path.
+        _nodes, mirror = GLOBAL_MIRROR_CACHE.get(
+            self.state, self.job.datacenters
+        )
+        self.stack.set_mirror(mirror)
 
         groups: Dict[int, Tuple[TaskGroup, List[AllocTuple]]] = {}
         for missing in place:
             key = id(missing.task_group)
             groups.setdefault(key, (missing.task_group, []))[1].append(missing)
 
-        from nomad_tpu.ops.binpack import _greedy_step_state
         from nomad_tpu.scheduler import SchedulerError
 
         for tg, missing_list in groups.values():
             self.ctx.reset()
             tg_constr = task_group_constraints(tg)
             metrics = self.ctx.metrics()
-            prep = self.stack.prepare(tg, tg_constr)
-            if prep is None:
+            res = self._system_fit(tg, tg_constr, mirror)
+            if res is None:
                 continue
-
-            # One dispatch: fit + score for every node at once.
-            from nomad_tpu.parallel import mesh as mesh_lib
-
-            ask, bw_ask, zero = prep.ask, prep.bw_ask, jnp.float32(0.0)
-            mesh = mesh_lib.mesh_for_nodes(mirror.total.shape[0])
-            if mesh is not None:
-                ask, bw_ask, zero = mesh_lib.replicate_on_mesh(
-                    mesh, ask, bw_ask, zero
-                )
-            _score, fit = _greedy_step_state(
-                mirror.total, mirror.sched_cap, prep.used, prep.job_count,
-                prep.tg_count, mirror.bw_avail, prep.bw_used, prep.mask,
-                ask, bw_ask, zero,
-                prep.job_distinct, prep.tg_distinct,
-            )
-            fit_np = np.asarray(fit)
+            prep, fit_np = res
 
             if self._place_system_batch(tg, tg_constr, missing_list,
                                         mirror, fit_np, metrics):
