@@ -556,25 +556,44 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
     for b in plan.update_batches:
         b.resolve(snap)
         new_vec = np.asarray(b.resource_vector(), dtype=np.int64)
-        counts = {}
-        old_vecs = {}
+        if b.src_node_ids:
+            # Block-columnar form: one shared old vector, node runs as
+            # columns — the whole batch is a single accumulator entry.
+            upd_nodes.update(b.src_node_ids)
+            old_vec = (
+                np.asarray(b.src_resources.as_vector(), dtype=np.int64)
+                if b.src_resources is not None
+                else np.zeros(4, dtype=np.int64)
+            )
+            delta = new_vec - old_vec
+            if np.any(delta):
+                batch_ask.add_batch(
+                    b.src_node_ids, b.src_node_counts, delta
+                )
+            continue
+        # One old-vector per Resources identity (a batch's allocs share a
+        # handful), node multiplicities per identity — then the whole
+        # delta lands as ONE accumulator batch, expanded vectorized by
+        # to_rows; no per-alloc numpy at all.
+        res_vecs = {}
+        per_res_counts: Dict[int, Dict[str, int]] = {}
         for a in b.allocs:
             upd_nodes.add(a.node_id)
-            key = (a.node_id, id(a.resources))
-            n = counts.get(key)
-            if n is None:
-                counts[key] = 1
-                old_vecs[key] = (
+            rid = id(a.resources)
+            if rid not in res_vecs:
+                res_vecs[rid] = (
                     np.asarray(a.resources.as_vector(), dtype=np.int64)
                     if a.resources is not None
                     else np.zeros(4, dtype=np.int64)
                 )
-            else:
-                counts[key] = n + 1
-        for key, cnt in counts.items():
-            delta = (new_vec - old_vecs[key]) * cnt
+            cnts = per_res_counts.setdefault(rid, {})
+            cnts[a.node_id] = cnts.get(a.node_id, 0) + 1
+        for rid, cnts in per_res_counts.items():
+            delta = new_vec - res_vecs[rid]
             if np.any(delta):
-                batch_ask.add_delta(key[0], delta)
+                batch_ask.add_batch(
+                    list(cnts.keys()), list(cnts.values()), delta
+                )
 
     bulk_fit = {}
     n_placements = sum(len(v) for v in plan.node_allocation.values())

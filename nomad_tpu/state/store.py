@@ -248,6 +248,16 @@ class _StateView:
             return True
         return bool(self._t.blocks_by_job.get(job_id))
 
+    def job_has_object_allocs(self, job_id: str) -> bool:
+        """Whether any of the job's allocations live as object rows (vs
+        columnar blocks) — the gate for fully block-level reconciles."""
+        return bool(self._t.allocs_by_job.get(job_id))
+
+    def job_alloc_blocks(self, job_id: str) -> List["StoredAllocBlock"]:
+        """The job's stored columnar blocks, un-materialized."""
+        return [self._t.blocks[bid]
+                for bid in self._t.blocks_by_job.get(job_id, ())]
+
     def allocs_by_node(self, node_id: str) -> List[Allocation]:
         out = self.allocs_by_node_objects(node_id)
         for blk in self._t.blocks.values():

@@ -725,7 +725,15 @@ class AllocBatch:
         self.metrics = metrics
         self.node_ids: List[str] = node_ids or []
         self.node_counts: List[int] = node_counts or []
-        self.name_idx = name_idx  # numpy int array or list
+        # Always an int64 ndarray: every consumer (block reconcile, name
+        # materialization) may index or .max() it, and construction paths
+        # (filter_nodes partial keep, from_wire) otherwise hand in lists.
+        import numpy as _np
+
+        self.name_idx = (
+            None if name_idx is None
+            else _np.asarray(name_idx, dtype=_np.int64)
+        )
         self.ids_hex = ids_hex
 
     @property
@@ -874,11 +882,13 @@ class AllocUpdateBatch:
     """
 
     __slots__ = ("eval_id", "job", "tg_name", "resources", "task_resources",
-                 "metrics", "allocs", "alloc_ids")
+                 "metrics", "allocs", "alloc_ids",
+                 "src_node_ids", "src_node_counts", "src_resources")
 
     def __init__(self, eval_id="", job=None, tg_name="", resources=None,
                  task_resources=None, metrics=None, allocs=None,
-                 alloc_ids=None):
+                 alloc_ids=None, src_node_ids=None, src_node_counts=None,
+                 src_resources=None):
         self.eval_id = eval_id
         self.job = job
         self.tg_name = tg_name
@@ -890,6 +900,15 @@ class AllocUpdateBatch:
         self.alloc_ids: List[str] = alloc_ids or [
             a.id for a in (allocs or [])
         ]
+        # Block-columnar source form (the fully object-free path): when a
+        # whole StoredAllocBlock updates in place, the batch carries the
+        # block's node run-length encoding and the SHARED old Resources —
+        # plan evaluation computes per-node deltas from these columns and
+        # never materializes a member. alloc_ids stay populated (position
+        # order) for the store's member addressing.
+        self.src_node_ids: List[str] = src_node_ids or []
+        self.src_node_counts: List[int] = src_node_counts or []
+        self.src_resources: Optional[Resources] = src_resources
 
     @property
     def n(self) -> int:
@@ -906,7 +925,11 @@ class AllocUpdateBatch:
     def resolve(self, snap) -> None:
         """Rebind alloc references from ids against a state snapshot (the
         wire path). Unknown ids are dropped — they were removed while the
-        plan was in flight, exactly the staleness plan evaluation guards."""
+        plan was in flight, exactly the staleness plan evaluation guards.
+        The block-columnar form needs no rebinding: its delta accounting
+        reads the source columns and the store addresses members by id."""
+        if self.src_node_ids:
+            return
         if self.allocs and len(self.allocs) == len(self.alloc_ids):
             return
         out = []
@@ -918,6 +941,29 @@ class AllocUpdateBatch:
         self.alloc_ids = [a.id for a in out]
 
     def filter_nodes(self, fit: Dict[str, bool]) -> "AllocUpdateBatch":
+        if self.src_node_ids:
+            if all(fit.get(nid, False) for nid in self.src_node_ids):
+                return self
+            # Drop unfit nodes' runs: alloc_ids are in position order, so
+            # each run owns a contiguous id slice.
+            keep_ids: List[str] = []
+            keep_nids: List[str] = []
+            keep_counts: List[int] = []
+            pos = 0
+            for nid, cnt in zip(self.src_node_ids, self.src_node_counts):
+                if fit.get(nid, False):
+                    keep_ids.extend(self.alloc_ids[pos:pos + cnt])
+                    keep_nids.append(nid)
+                    keep_counts.append(cnt)
+                pos += cnt
+            return AllocUpdateBatch(
+                eval_id=self.eval_id, job=self.job, tg_name=self.tg_name,
+                resources=self.resources,
+                task_resources=self.task_resources,
+                metrics=self.metrics, alloc_ids=keep_ids,
+                src_node_ids=keep_nids, src_node_counts=keep_counts,
+                src_resources=self.src_resources,
+            )
         if all(fit.get(a.node_id, False) for a in self.allocs):
             return self
         kept = [a for a in self.allocs if fit.get(a.node_id, False)]
@@ -956,6 +1002,9 @@ class AllocUpdateBatch:
             "task_resources": to_dict(self.task_resources),
             "metrics": to_dict(self.metrics),
             "alloc_ids": list(self.alloc_ids),
+            "src_node_ids": list(self.src_node_ids),
+            "src_node_counts": list(self.src_node_counts),
+            "src_resources": to_dict(self.src_resources),
         }
 
     @staticmethod
@@ -973,6 +1022,9 @@ class AllocUpdateBatch:
             },
             metrics=from_dict(AllocMetric, d.get("metrics")),
             alloc_ids=d.get("alloc_ids") or [],
+            src_node_ids=d.get("src_node_ids") or [],
+            src_node_counts=d.get("src_node_counts") or [],
+            src_resources=from_dict(Resources, d.get("src_resources")),
         )
 
 

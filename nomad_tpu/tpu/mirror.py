@@ -253,6 +253,21 @@ class NodeMirror:
         # size above. Identity-counted per (node, old resources).
         for b in ctx.plan.update_batches:
             new_vec = np.asarray(b.resource_vector(), dtype=np.int64)
+            if b.src_node_ids:
+                # Block-columnar form: one shared old vector, node runs as
+                # columns (mirrors plan_apply.evaluate_plan's handling).
+                old_vec = (
+                    np.asarray(b.src_resources.as_vector(), dtype=np.int64)
+                    if b.src_resources is not None
+                    else np.zeros(4, dtype=np.int64)
+                )
+                delta = new_vec - old_vec
+                if delta.any():
+                    for nid, cnt in zip(b.src_node_ids, b.src_node_counts):
+                        i = self.index.get(nid)
+                        if i is not None:
+                            used[i] += (delta * cnt).astype(np.int32)
+                continue
             counts: Dict[Tuple[str, int], int] = {}
             vecs: Dict[int, np.ndarray] = {}
             for a in b.allocs:

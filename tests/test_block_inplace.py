@@ -1,0 +1,268 @@
+"""Block-level in-place updates: whole StoredAllocBlocks reconcile and
+re-stamp without materializing a member (the src_* columnar form of
+AllocUpdateBatch). Reference semantics preserved: util.go:54-131 diff,
+util.go:265-302 tasksUpdated, util.go:316-398 inplaceUpdate feasibility."""
+
+import copy
+import logging
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.server.plan_apply import evaluate_plan
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    AllocUpdateBatch,
+    Evaluation,
+    Resources,
+    generate_uuid,
+)
+
+BATCH = 300  # above TPUGenericScheduler.BATCH_PLACE_THRESHOLD
+
+
+def _big_job(count=BATCH, cpu=100, mem=128):
+    job = mock.job()
+    job.type = structs.JOB_TYPE_BATCH
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources = Resources(cpu=cpu, memory_mb=mem)
+    return job
+
+
+def _eval_for(job):
+    return Evaluation(
+        id=generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+
+
+class _BlockPlanner:
+    """Planner that commits columnar results columnar — the FSM posture
+    (fsm.py applies alloc_batches via upsert_alloc_blocks and
+    update_batches via apply_update_batches), unlike the Harness which
+    materializes everything to objects."""
+
+    def __init__(self, state):
+        self.state = state
+        self.plans = []
+        self._index = 1000
+
+    def submit_plan(self, plan):
+        self.plans.append(plan)
+        self._index += 1
+        result = evaluate_plan(self.state.snapshot(), plan)
+        result.alloc_index = self._index
+        allocs = []
+        for lst in result.node_update.values():
+            allocs.extend(lst)
+        for lst in result.node_allocation.values():
+            allocs.extend(lst)
+        allocs.extend(result.failed_allocs)
+        if allocs:
+            self.state.upsert_allocs(self._index, allocs)
+        if result.alloc_batches:
+            self.state.upsert_alloc_blocks(self._index, result.alloc_batches)
+        if result.update_batches:
+            self.state.apply_update_batches(self._index, result.update_batches)
+        return result, None
+
+    def update_eval(self, ev):
+        pass
+
+    def create_eval(self, ev):
+        pass
+
+
+def _cluster(n_nodes=10):
+    state = StateStore()
+    for i in range(n_nodes):
+        node = mock.node()
+        node.id = f"node-{i:03d}"
+        state.upsert_node(i + 1, node)
+    return state
+
+
+def _process(state, planner, job):
+    sched = new_scheduler("tpu-batch", state.snapshot(), planner,
+                         logging.getLogger("test"))
+    sched.process(_eval_for(job))
+
+
+def test_block_inplace_update_never_materializes():
+    state = _cluster()
+    planner = _BlockPlanner(state)
+    job = _big_job()
+    state.upsert_job(500, job)
+    _process(state, planner, job)
+    blocks = state.job_alloc_blocks(job.id)
+    assert blocks and sum(b.n for b in blocks) == BATCH
+    assert not state.job_has_object_allocs(job.id)
+    before_ids = {b.block_id for b in blocks}
+
+    # Resource-only bump: tasks_updated is false, so the whole block
+    # re-stamps in place through the src-columnar batch.
+    job2 = copy.deepcopy(job)
+    job2.task_groups[0].tasks[0].resources.cpu += 7
+    state.upsert_job(501, job2)
+
+    import nomad_tpu.state.blocks as blocks_mod
+
+    calls = {"span": 0}
+    orig = blocks_mod.StoredAllocBlock.materialize
+
+    def spy(self):
+        calls["span"] += 1
+        return orig(self)
+
+    blocks_mod.StoredAllocBlock.materialize = spy
+    try:
+        _process(state, planner, job2)
+    finally:
+        blocks_mod.StoredAllocBlock.materialize = orig
+
+    plan = planner.plans[-1]
+    assert plan.update_batches, "expected the block-columnar path"
+    b = plan.update_batches[0]
+    assert b.src_node_ids, "expected src-columnar form"
+    assert b.n == BATCH
+    assert calls["span"] == 0, "block members were materialized"
+    assert not plan.node_allocation and not plan.alloc_batches
+
+    # Store state: same blocks, swapped fields.
+    after = state.job_alloc_blocks(job.id)
+    assert {blk.block_id for blk in after} == before_ids
+    for blk in after:
+        assert blk.resources.cpu == 107
+        assert blk.job.modify_index == job2.modify_index
+    # Materialized view agrees.
+    allocs = [a for a in state.allocs_by_job(job.id)
+              if a.desired_status == "run"]
+    assert len(allocs) == BATCH
+    assert all(a.resources.cpu == 107 for a in allocs)
+
+
+def test_block_inplace_same_version_is_noop():
+    state = _cluster()
+    planner = _BlockPlanner(state)
+    job = _big_job()
+    state.upsert_job(500, job)
+    _process(state, planner, job)
+    n_plans = len(planner.plans)
+    # Same job version re-eval: everything is 'ignore'; the plan is a noop
+    # and is never submitted.
+    _process(state, planner, job)
+    assert len(planner.plans) == n_plans
+
+
+def test_block_inplace_overflow_falls_back():
+    """Growth beyond node headroom cannot whole-block admit: the eval
+    falls back to the object machinery (evict/replace or per-alloc)."""
+    state = _cluster(n_nodes=4)
+    planner = _BlockPlanner(state)
+    # 4 mock nodes hold ~31GB schedulable memory total: 300x64MB fits,
+    # 300x112MB cannot.
+    job = _big_job(count=BATCH, cpu=30, mem=64)
+    state.upsert_job(500, job)
+    _process(state, planner, job)
+    assert sum(b.n for b in state.job_alloc_blocks(job.id)) == BATCH
+
+    job2 = copy.deepcopy(job)
+    job2.task_groups[0].tasks[0].resources.memory_mb = 112
+    state.upsert_job(501, job2)
+    _process(state, planner, job2)
+    plan = planner.plans[-1]
+    # No unsound whole-block update was committed.
+    for b in plan.update_batches:
+        assert not b.src_node_ids or b.n < BATCH
+    # Node capacity is respected post-commit.
+    from nomad_tpu.structs import allocs_fit
+
+    for i in range(4):
+        nid = f"node-{i:03d}"
+        node = state.node_by_id(nid)
+        live = [a for a in state.allocs_by_node(nid)
+                if a.desired_status == "run"]
+        fit, _dim, _used = allocs_fit(node, live)
+        assert fit, f"node {nid} overcommitted"
+
+
+def test_block_inplace_tainted_node_falls_back():
+    state = _cluster()
+    planner = _BlockPlanner(state)
+    job = _big_job()
+    state.upsert_job(500, job)
+    _process(state, planner, job)
+    # Drain a node holding members: block-wise reconcile must refuse and
+    # the object path must migrate those members.
+    victim = state.job_alloc_blocks(job.id)[0].node_ids[0]
+    node = state.node_by_id(victim).copy()
+    node.drain = True
+    state.upsert_node(502, node)
+    job2 = copy.deepcopy(job)
+    job2.task_groups[0].tasks[0].resources.cpu += 7
+    state.upsert_job(503, job2)
+    _process(state, planner, job2)
+    live = [a for a in state.allocs_by_job(job.id)
+            if a.desired_status == "run"]
+    assert all(a.node_id != victim for a in live)
+    assert len(live) == BATCH
+
+
+def test_inplace_distinct_identity_allocs_never_overcommit():
+    """After a snapshot restore every alloc carries its own Resources
+    object: many single-member (node, identity) groups share each node.
+    Grown in-place updates must still respect per-node headroom — the
+    vectorized admission must not double-admit against un-deducted
+    base rows."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from sched_harness import Harness
+
+    h = Harness()
+    for _ in range(4):
+        h.state.upsert_node(h.next_index(), mock.node())
+    job = _big_job(count=BATCH, cpu=30, mem=64)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+    # Restore shape: break Resources identity sharing alloc by alloc.
+    for a in h.state.allocs_by_job(job.id):
+        a.resources = copy.deepcopy(a.resources)
+
+    job2 = copy.deepcopy(job)
+    job2.task_groups[0].tasks[0].resources.memory_mb = 100  # tight grow
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("tpu-batch", _eval_for(job2))
+
+    from nomad_tpu.structs import allocs_fit
+
+    for node in h.state.nodes():
+        live = [a for a in h.state.allocs_by_node(node.id)
+                if a.desired_status == "run"]
+        fit, _dim, _used = allocs_fit(node, live)
+        assert fit, f"node {node.id} overcommitted"
+
+
+def test_src_update_batch_wire_roundtrip_and_filter():
+    b = AllocUpdateBatch(
+        eval_id="e1", job=mock.job(), tg_name="web",
+        resources=Resources(cpu=107, memory_mb=128),
+        metrics=None,
+        alloc_ids=[f"id-{i}" for i in range(5)],
+        src_node_ids=["n1", "n2"], src_node_counts=[2, 3],
+        src_resources=Resources(cpu=100, memory_mb=128),
+    )
+    d = b.to_wire()
+    back = AllocUpdateBatch.from_wire(d)
+    assert back.src_node_ids == ["n1", "n2"]
+    assert back.src_node_counts == [2, 3]
+    assert back.src_resources.cpu == 100
+    assert back.alloc_ids == b.alloc_ids
+    back.resolve(None)  # no-op for src form: must not touch the snapshot
+
+    kept = back.filter_nodes({"n1": True, "n2": False})
+    assert kept.src_node_ids == ["n1"]
+    assert kept.alloc_ids == ["id-0", "id-1"]
+    assert kept.n == 2
