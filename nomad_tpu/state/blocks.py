@@ -132,6 +132,34 @@ class StoredAllocBlock(AllocBatch):
         self._materialize_span(self._template(), node_id, start, start + cnt, out)
         return out
 
+    def materialize_prefix(self, k: int) -> List[Allocation]:
+        """Materialize the first ``k`` LIVE members (run-ordered, excluded
+        positions skipped) — the rolling-update eviction slice. Span ends
+        are bounded by remaining need so a dense single-node run never
+        materializes past k: O(k + excluded-in-prefix + runs touched)."""
+        out: List[Allocation] = []
+        template = self._template()
+        pos = 0
+        for nid, cnt in zip(self.node_ids, self.node_counts):
+            if len(out) >= k:
+                break
+            start, end_run = pos, pos + cnt
+            while start < end_run and len(out) < k:
+                # Each chunk asks for exactly the remaining need; excluded
+                # positions inside it yield fewer, and the loop advances.
+                end = min(end_run, start + (k - len(out)))
+                self._materialize_span(template, nid, start, end, out)
+                start = end
+            pos = end_run
+        return out
+
+    def live_positions(self) -> List[int]:
+        """Run-ordered positions of live (non-excluded) members."""
+        if not self.excluded:
+            return list(range(self.n))
+        excluded = self.excluded
+        return [i for i in range(self.n) if i not in excluded]
+
     def materialize_pos(self, pos: int) -> Allocation:
         out: List[Allocation] = []
         self._materialize_span(

@@ -137,6 +137,11 @@ class _Tables:
         self.allocs_by_eval: Dict[str, Set[str]] = {}
         self.blocks_by_job: Dict[str, Set[str]] = {}
         self.blocks_by_eval: Dict[str, Set[str]] = {}
+        # Non-terminal OBJECT rows per job — the O(1) gate for block-level
+        # reconciles (a rolling update accumulates terminal stop rows that
+        # a scan-based gate would re-walk on every eval). Maintained by
+        # _insert_alloc_row/_replace_alloc_row/the GC pop.
+        self.live_objs_by_job: Dict[str, int] = {}
 
     def copy(self) -> "_Tables":
         new = _Tables()
@@ -152,6 +157,7 @@ class _Tables:
         new.allocs_by_eval = {k: set(v) for k, v in self.allocs_by_eval.items()}
         new.blocks_by_job = {k: set(v) for k, v in self.blocks_by_job.items()}
         new.blocks_by_eval = {k: set(v) for k, v in self.blocks_by_eval.items()}
+        new.live_objs_by_job = dict(self.live_objs_by_job)
         return new
 
 
@@ -249,9 +255,13 @@ class _StateView:
         return bool(self._t.blocks_by_job.get(job_id))
 
     def job_has_object_allocs(self, job_id: str) -> bool:
-        """Whether any of the job's allocations live as object rows (vs
-        columnar blocks) — the gate for fully block-level reconciles."""
-        return bool(self._t.allocs_by_job.get(job_id))
+        """Whether any NON-TERMINAL allocations of the job live as object
+        rows (vs columnar blocks) — the O(1) gate for fully block-level
+        reconciles (counter maintained at every row write). Terminal rows
+        (stopped/evicted/failed) are invisible to the five-way diff, so a
+        mid-rolling-update job whose stops accumulated as objects still
+        reconciles block-wise."""
+        return self._t.live_objs_by_job.get(job_id, 0) > 0
 
     def job_alloc_blocks(self, job_id: str) -> List["StoredAllocBlock"]:
         """The job's stored columnar blocks, un-materialized."""
@@ -378,7 +388,22 @@ def _find_block_member(t: _Tables, alloc_id: str):
     return None
 
 
+def _decr_live_objs(t: _Tables, job_id: str) -> None:
+    n = t.live_objs_by_job.get(job_id, 0) - 1
+    if n > 0:
+        t.live_objs_by_job[job_id] = n
+    else:
+        t.live_objs_by_job.pop(job_id, None)
+
+
 def _insert_alloc_row(t: _Tables, alloc: Allocation) -> None:
+    prev = t.allocs.get(alloc.id)
+    if prev is not None and not prev.terminal_status():
+        _decr_live_objs(t, prev.job_id)
+    if not alloc.terminal_status():
+        t.live_objs_by_job[alloc.job_id] = (
+            t.live_objs_by_job.get(alloc.job_id, 0) + 1
+        )
     t.allocs[alloc.id] = alloc
     t.allocs_by_job.setdefault(alloc.job_id, set()).add(alloc.id)
     t.allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
@@ -696,6 +721,8 @@ class StateStore(_StateView):
             block_members: Dict[str, Set[int]] = {}
             for alloc_id in alloc_ids:
                 alloc = t.allocs.pop(alloc_id, None)
+                if alloc is not None and not alloc.terminal_status():
+                    _decr_live_objs(t, alloc.job_id)
                 if alloc is None:
                     if t.blocks:
                         found = _find_block_member(t, alloc_id)
@@ -811,6 +838,9 @@ class StateStore(_StateView):
                 new.client_status = alloc.client_status
                 new.client_description = alloc.client_description
                 new.modify_index = index
+                # terminal_status() is desired-status-only (structs.go:
+                # 1179-1188 parity), so a client-field update can never
+                # move the live-object counter.
                 t.allocs[alloc.id] = new
                 items.extend(
                     [

@@ -51,6 +51,7 @@ from nomad_tpu.structs import (
     ALLOC_CLIENT_STATUS_PENDING,
     ALLOC_DESIRED_STATUS_FAILED,
     ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
     Allocation,
     Job,
     Node,
@@ -397,6 +398,11 @@ class TPUGenericScheduler(GenericScheduler):
                 self.ctx.plan.append_update_batch(b)
 
         big, small = [], []
+        # In a block-world job (reconciled block-wise above) replacements
+        # must stay columnar regardless of count: small object placements
+        # would flip the live-object gate and knock every later rolling
+        # round off the block path.
+        force_columnar = blocked is not None
         for tg in job.task_groups:
             have = existing_idx.get(tg.name)
             if have:
@@ -414,7 +420,10 @@ class TPUGenericScheduler(GenericScheduler):
                 t.resources is not None and t.resources.networks
                 for t in tg.tasks
             )
-            if len(missing) >= self.BATCH_PLACE_THRESHOLD and not has_networks:
+            if not has_networks and (
+                force_columnar
+                or len(missing) >= self.BATCH_PLACE_THRESHOLD
+            ):
                 big.append((tg, missing))
             else:
                 small.append((tg, missing))
@@ -726,11 +735,8 @@ class TPUGenericScheduler(GenericScheduler):
         occupied: Dict[str, set] = {}
         live_total: Dict[str, int] = {}
         pending: list = []
+        destructive: list = []
         for blk in blocks:
-            if blk.excluded:
-                # Promoted members: their object rows (or their absence
-                # after GC) need the object-aware reconcile.
-                return None
             tg = tg_by_name.get(blk.tg_name)
             if tg is None:
                 return None  # group removed: stops needed
@@ -738,12 +744,24 @@ class TPUGenericScheduler(GenericScheduler):
                 row = rows_get(nid)
                 if row is None or dead[row]:
                     return None  # tainted node: migrations needed
+            # Excluded positions are promoted members whose object rows
+            # are terminal (the live-object gate above ruled out
+            # non-terminal ones): only the LIVE view participates. The
+            # common exclusion-free block stays fully vectorized.
             idx = blk.name_idx
-            if idx.size and int(idx.max()) >= tg.count:
-                return None  # scale-down: stops needed
             occ = occupied.setdefault(blk.tg_name, set())
-            occ.update(int(i) for i in idx)
-            live_total[blk.tg_name] = live_total.get(blk.tg_name, 0) + blk.n
+            if blk.excluded:
+                live_idx = [int(idx[i]) for i in blk.live_positions()]
+                if live_idx and max(live_idx) >= tg.count:
+                    return None  # scale-down: stops needed
+                occ.update(live_idx)
+            else:
+                if idx.size and int(idx.max()) >= tg.count:
+                    return None  # scale-down: stops needed
+                occ.update(idx.tolist())
+            live_total[blk.tg_name] = (
+                live_total.get(blk.tg_name, 0) + blk.n_live
+            )
             if blk.job is job or (
                 blk.job is not None and blk.job.modify_index == job_mi
             ):
@@ -751,14 +769,21 @@ class TPUGenericScheduler(GenericScheduler):
             old_job = blk.job
             old_tg = old_job.lookup_task_group(blk.tg_name) if old_job else None
             if (old_tg is None
-                    or tasks_updated(tg, old_tg)
-                    or not self._constraints_unchanged(old_job, old_tg, tg)
                     or any(t.resources is not None and t.resources.networks
                            for t in tg.tasks)
                     or any(tr is not None and tr.networks
                            for tr in (blk.task_resources or {}).values())):
-                return None  # destructive / network reoffer path
-            pending.append((tg, blk))
+                return None  # network reoffer / reshaped group: object path
+            if (tasks_updated(tg, old_tg)
+                    or not self._constraints_unchanged(old_job, old_tg, tg)):
+                # Destructive change: block-wise only under a rolling
+                # update strategy (evict max_parallel members, place
+                # replacements); evict-everything takes the object path.
+                if not job.update.rolling():
+                    return None
+                destructive.append((tg, blk))
+            else:
+                pending.append((tg, blk))
         for tg_name, occ in occupied.items():
             if live_total[tg_name] != len(occ):
                 return None  # duplicate indices: needs the object diff
@@ -772,7 +797,44 @@ class TPUGenericScheduler(GenericScheduler):
                 "sched: %s: %d block-columnar in-place updates",
                 self.eval, sum(b.n for b in batches),
             )
+        if destructive:
+            self._evict_block_prefixes(destructive, occupied)
         return occupied
+
+    def _evict_block_prefixes(self, destructive, occupied) -> None:
+        """Rolling destructive update over whole blocks: evict the first
+        max_parallel members (materializing ONLY those — the 10k-member
+        steady state materializes max_parallel allocs, not the job), free
+        their name indices so the caller's missing-index placement refills
+        them columnar, and flag limit_reached so the next rolling eval is
+        scheduled (util.go:400-416 evictAndPlace semantics)."""
+        from nomad_tpu.scheduler.generic import ALLOC_UPDATING
+
+        limit = self.job.update.max_parallel
+        plan = self.ctx.plan
+        for tg, blk in destructive:
+            if limit <= 0:
+                self.limit_reached = True
+                break
+            k = min(limit, blk.n_live)
+            for a in blk.materialize_prefix(k):
+                plan.append_update(
+                    a, ALLOC_DESIRED_STATUS_STOP, ALLOC_UPDATING
+                )
+            occ = occupied[blk.tg_name]
+            if blk.excluded:
+                for p in blk.live_positions()[:k]:
+                    occ.discard(int(blk.name_idx[p]))
+            else:
+                for i in blk.name_idx[:k].tolist():
+                    occ.discard(i)
+            limit -= k
+            if k < blk.n_live:
+                self.limit_reached = True
+        self.logger.debug(
+            "sched: %s: rolling block eviction, limit_reached=%s",
+            self.eval, self.limit_reached,
+        )
 
     @staticmethod
     def _headroom_base(state, table):
@@ -811,19 +873,30 @@ class TPUGenericScheduler(GenericScheduler):
                 if blk.resources is not None
                 else np.zeros(4, dtype=np.int64)
             )
+            # Live run-length view: identical to the raw columns for
+            # exclusion-free blocks, filtered otherwise.
+            if blk.excluded:
+                live_runs = list(blk.live_node_counts())
+                live_nids = [nid for nid, _ in live_runs]
+                live_counts = [c for _, c in live_runs]
+                live_ids = [blk.alloc_id(i) for i in blk.live_positions()]
+            else:
+                live_nids = list(blk.node_ids)
+                live_counts = list(blk.node_counts)
+                live_ids = [blk.alloc_id(i) for i in range(blk.n)]
             delta = new_vec - old_vec
             if np.any(delta > 0):
                 rows = np.fromiter(
-                    (table.rows[nid] for nid in blk.node_ids),
-                    dtype=np.int64, count=len(blk.node_ids),
+                    (table.rows[nid] for nid in live_nids),
+                    dtype=np.int64, count=len(live_nids),
                 )
                 if net_rows is not None and bool(net_rows[rows].any()):
                     return None
                 if any(nid in obj_nodes or plan.node_update.get(nid)
                        or plan.node_allocation.get(nid)
-                       for nid in blk.node_ids):
+                       for nid in live_nids):
                     return None
-                counts = np.asarray(blk.node_counts, dtype=np.int64)
+                counts = np.asarray(live_counts, dtype=np.int64)
                 need = delta[None, :] * counts[:, None]
                 h = base[rows]
                 ok = np.all((h - need >= 0) | (delta[None, :] <= 0), axis=1)
@@ -837,9 +910,9 @@ class TPUGenericScheduler(GenericScheduler):
                 resources=size,
                 task_resources={t.name: t.resources for t in tg.tasks},
                 metrics=self.ctx.metrics(),
-                alloc_ids=[blk.alloc_id(i) for i in range(blk.n)],
-                src_node_ids=list(blk.node_ids),
-                src_node_counts=list(blk.node_counts),
+                alloc_ids=live_ids,
+                src_node_ids=live_nids,
+                src_node_counts=live_counts,
                 src_resources=blk.resources,
             ))
         return batches

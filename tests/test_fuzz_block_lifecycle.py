@@ -173,3 +173,12 @@ def test_block_vs_object_lifecycle(seed):
                     if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN]
             fit, _dim, _u = allocs_fit(node, live)
             assert fit, (seed, op, node.id)
+
+        # The O(1) live-object counter must equal a full scan at every
+        # step (it gates the block-level reconcile).
+        t = state_b._t
+        scan = {}
+        for a in t.allocs.values():
+            if not a.terminal_status():
+                scan[a.job_id] = scan.get(a.job_id, 0) + 1
+        assert scan == t.live_objs_by_job, (seed, op)
