@@ -17,7 +17,14 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from nomad_tpu.api.codec import from_dict, to_dict
-from nomad_tpu.structs import Allocation, Evaluation, Job, Node
+from nomad_tpu.structs import (
+    MAX_QUERY_TIME,
+    MAX_QUERY_TIME_PAD,
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+)
 
 DEFAULT_ADDRESS = "http://127.0.0.1:4646"
 
@@ -79,7 +86,9 @@ class ApiClient:
         if data is not None:
             req.add_header("Content-Type", "application/json")
         try:
-            with urllib.request.urlopen(req, timeout=330) as resp:
+            with urllib.request.urlopen(
+                req, timeout=MAX_QUERY_TIME + MAX_QUERY_TIME_PAD
+            ) as resp:
                 meta = QueryMeta(
                     last_index=int(resp.headers.get("X-Nomad-Index", 0)),
                     last_contact=float(

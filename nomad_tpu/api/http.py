@@ -158,7 +158,11 @@ class HTTPServer:
         min_index = int(query.get("index", 0))
         if min_index == 0:
             return
-        wait = parse_duration(query.get("wait", "5m"))
+        # MaxQueryTime cap (rpc.go:283-291): client-supplied waits clamp
+        # so a poll can never park unboundedly.
+        from nomad_tpu.structs import MAX_QUERY_TIME
+
+        wait = min(parse_duration(query.get("wait", "5m")), MAX_QUERY_TIME)
         import time as _time
 
         end = _time.monotonic() + wait

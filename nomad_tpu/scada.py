@@ -230,7 +230,21 @@ class UplinkProvider:
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
-        conn = http.client.HTTPConnection(self.http_addr, timeout=30)
+        # The local hop lives as long as the CALLER's budget (timeout_s,
+        # sent by UplinkBroker.http) so an abandoned long-poll frees its
+        # in-flight slot when the broker side gives up — capped just past
+        # the server's MaxQueryTime clamp.
+        from nomad_tpu.structs import MAX_QUERY_TIME, MAX_QUERY_TIME_PAD
+
+        raw = args.get("timeout_s")
+        try:
+            budget = 30.0 if raw is None else float(raw)
+        except (TypeError, ValueError):
+            budget = 30.0
+        cap = MAX_QUERY_TIME + MAX_QUERY_TIME_PAD
+        conn = http.client.HTTPConnection(
+            self.http_addr, timeout=max(1.0, min(budget, cap))
+        )
         try:
             conn.request(verb, path, body=data, headers=headers)
             resp = conn.getresponse()
@@ -452,10 +466,13 @@ class UplinkBroker:
     def http(self, infrastructure: str, verb: str, path: str,
              body: Any = None, timeout: float = 30.0) -> dict:
         """Issue an HTTP request through a connected provider; returns
-        {"status", "headers", "body"}."""
+        {"status", "headers", "body"}. ``timeout`` is also shipped to the
+        provider so its local hop (and in-flight slot) never outlives the
+        caller — pass a larger value for blocking queries (?index&wait)."""
         return self._request(
             infrastructure, "http",
-            {"verb": verb, "path": path, "body": body}, timeout,
+            {"verb": verb, "path": path, "body": body,
+             "timeout_s": timeout}, timeout,
         )
 
     def ping(self, infrastructure: str, timeout: float = 10.0) -> bool:

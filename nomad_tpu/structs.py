@@ -46,6 +46,13 @@ JOB_STATUS_DEAD = "dead"
 
 JOB_MIN_PRIORITY = 1
 JOB_DEFAULT_PRIORITY = 50
+
+# Blocking-query wait ceiling (rpc.go:283-291 maxQueryTime): the server
+# clamps client-supplied ?wait to this; transport hops (uplink provider,
+# SDK socket) allow MAX_QUERY_TIME + MAX_QUERY_TIME_PAD so a max-length
+# poll always outlives the server's clamp, never the other way around.
+MAX_QUERY_TIME = 300.0
+MAX_QUERY_TIME_PAD = 30.0
 JOB_MAX_PRIORITY = 100
 CORE_JOB_PRIORITY = JOB_MAX_PRIORITY * 2
 

@@ -67,6 +67,53 @@ def test_http_through_tunnel(broker, agent):
     assert resp["status"] == 404
 
 
+def test_blocking_query_through_tunnel(broker, agent):
+    """A ?index&wait long-poll parks on the provider side past the old
+    30s proxy deadline posture and completes when the watch fires."""
+    import threading
+
+    assert wait_for(lambda: "acme/prod" in broker.sessions())
+
+    # Settle: the dev agent's own client bumps the nodes table during
+    # startup; capture the index only once it has been stable for a bit
+    # so the blocking poll genuinely parks.
+    def nodes_index():
+        r = broker.http("acme/prod", "GET", "/v1/nodes")
+        return int(r["headers"]["X-Nomad-Index"])
+
+    index = nodes_index()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        time.sleep(0.6)
+        nxt = nodes_index()
+        if nxt == index:
+            break
+        index = nxt
+
+    out = {}
+
+    def poll():
+        try:
+            out["resp"] = broker.http(
+                "acme/prod", "GET", f"/v1/nodes?index={index}&wait=50s",
+                timeout=60,
+            )
+        except BaseException as e:  # surface the real failure, not KeyError
+            out["err"] = e
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert "resp" not in out and "err" not in out  # parked on the watch
+    from nomad_tpu import mock
+
+    agent.server.node_register(mock.node())  # fires the nodes watch
+    t.join(timeout=15)
+    assert "err" not in out, out.get("err")
+    assert out["resp"]["status"] == 200
+    assert int(out["resp"]["headers"]["X-Nomad-Index"]) > index
+
+
 def test_provider_reconnects_after_drop(broker, agent):
     assert wait_for(lambda: "acme/prod" in broker.sessions())
     first_sessions = agent.uplink.sessions
