@@ -23,6 +23,7 @@ import json
 import os
 import statistics
 import sys
+import threading
 import time
 import traceback
 
@@ -39,9 +40,52 @@ DEVICE_WAIT_S = float(os.environ.get("NOMAD_TPU_BENCH_DEVICE_WAIT", "600"))
 ALLOW_CPU = os.environ.get("NOMAD_TPU_BENCH_ALLOW_CPU", "") == "1"
 
 
+_EMITTED = threading.Event()
+
+# Mid-run device death (the relay tunnel has died DURING a bench run,
+# wedging the next device op forever) would otherwise produce NO output at
+# all — the except-path only covers failures that raise. The watchdog
+# guarantees the one-line contract regardless.
+WATCHDOG_S = float(os.environ.get("NOMAD_TPU_BENCH_WATCHDOG", "2400"))
+
+
 def emit(payload: dict) -> None:
-    """The one-line JSON contract: always printed, even on failure."""
+    """The one-line JSON contract: always printed, even on failure.
+    The flag is set BEFORE printing so a watchdog expiring mid-emit can
+    never add a second line."""
+    _EMITTED.set()
     print(json.dumps(payload), flush=True)
+
+
+def _start_watchdog() -> None:
+    def run():
+        if _EMITTED.wait(WATCHDOG_S):
+            return
+        status = {}
+        try:
+            from nomad_tpu.scheduler import device_probe_status
+
+            status = device_probe_status()
+        except Exception:
+            pass
+        emit({
+            "metric": "placements_per_sec@10k_nodes_x_100k_tasks",
+            "value": 0,
+            "unit": "placements/s",
+            "vs_baseline": 0,
+            "backend": "unknown",
+            "error": (
+                f"bench watchdog: no result after {WATCHDOG_S:.0f}s — a "
+                "device op is wedged mid-run (relay died during the "
+                "bench?); probe status attached"
+            ),
+            "probe": status,
+        })
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)
+
+    threading.Thread(target=run, daemon=True, name="bench-watchdog").start()
 
 
 def acquire_device():
@@ -575,6 +619,7 @@ def _measure_headline():
 
 def main():
     backend = "unknown"
+    _start_watchdog()
     try:
         backend = acquire_device()
 

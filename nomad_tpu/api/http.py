@@ -22,7 +22,7 @@ from nomad_tpu.jobspec import parse_duration
 from nomad_tpu.state.store import (
     item_table,
 )
-from nomad_tpu.structs import Job, ValidationError
+from nomad_tpu.structs import MAX_QUERY_TIME, Job, ValidationError
 
 
 class HTTPCodedError(Exception):
@@ -160,8 +160,6 @@ class HTTPServer:
             return
         # MaxQueryTime cap (rpc.go:283-291): client-supplied waits clamp
         # so a poll can never park unboundedly.
-        from nomad_tpu.structs import MAX_QUERY_TIME
-
         wait = min(parse_duration(query.get("wait", "5m")), MAX_QUERY_TIME)
         import time as _time
 

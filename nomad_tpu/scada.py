@@ -36,6 +36,7 @@ import threading
 from typing import Any, Dict, Optional
 
 from nomad_tpu import __version__
+from nomad_tpu.structs import MAX_QUERY_TIME, MAX_QUERY_TIME_PAD
 from nomad_tpu.rpc import (
     SEND_TIMEOUT,
     _hard_close,
@@ -234,8 +235,6 @@ class UplinkProvider:
         # sent by UplinkBroker.http) so an abandoned long-poll frees its
         # in-flight slot when the broker side gives up — capped just past
         # the server's MaxQueryTime clamp.
-        from nomad_tpu.structs import MAX_QUERY_TIME, MAX_QUERY_TIME_PAD
-
         raw = args.get("timeout_s")
         try:
             budget = 30.0 if raw is None else float(raw)
