@@ -68,6 +68,10 @@ def _start_watchdog() -> None:
             status = device_probe_status()
         except Exception:
             pass
+        if _EMITTED.is_set():
+            # The run finished while we were gathering the probe status:
+            # the real line is already out, never add a second.
+            return
         emit({
             "metric": "placements_per_sec@10k_nodes_x_100k_tasks",
             "value": 0,
@@ -588,6 +592,22 @@ def run_config5():
     }
 
 
+def _pallas_outcome() -> str:
+    """Whether the pallas water-fill kernel actually carried the solves:
+    'proven' (compiled + executed on this backend), 'fallback' (it faulted
+    and the jnp path took over), or 'off' (non-TPU backend / disabled)."""
+    try:
+        from nomad_tpu.ops.pallas_solve import _STATE, pallas_mode
+
+        if _STATE["failed"]:
+            return "fallback"
+        if _STATE["proven"]:
+            return "proven"
+        return "off" if pallas_mode() == "off" else "untried"
+    except Exception:
+        return "unknown"
+
+
 def _measure_headline():
     """The one headline measurement protocol (config 3): build, warm one
     pass, clear, RUNS timed passes, medians. Shared by main() and the
@@ -659,6 +679,7 @@ def main():
                 "coalesced_placed": coalesce_placed,
                 "coalesced_dispatches": coalesce_dispatches,
                 "backend": backend,
+                "pallas": _pallas_outcome(),
                 **aux,
             }
         )
