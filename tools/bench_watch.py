@@ -1,0 +1,229 @@
+"""Opportunistic TPU capture watcher.
+
+The axon device relay is flaky: it has come up for minutes-long windows and
+died mid-session on every prior round, so end-of-round benches keep missing
+it. This watcher inverts the timing problem: it scans the relay's loopback
+ports continuously, and the moment a subprocess probe child reports the
+device claim completing, it immediately runs the full ``bench.py`` suite at
+the CURRENT commit (plus the compiled-pallas proof, when present) and
+appends the capture to ``BENCH_SELF_r04.json``. Every scan is also logged
+to ``BENCH_WATCH_r04.jsonl`` so a relay that never comes up all round is
+provable from the log, not asserted.
+
+Runs as a detached background process for the whole session:
+
+    python tools/bench_watch.py >> bench_watch.log 2>&1 &
+
+Re-captures when HEAD moves (so the newest solver gets proven) or after a
+cooldown, whichever comes first; the first capture in a window is the
+urgent one — the relay has historically died within minutes of answering.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WATCH_LOG = os.path.join(REPO, "BENCH_WATCH_r04.jsonl")
+CAPTURE_FILE = os.path.join(REPO, "BENCH_SELF_r04.json")
+SCAN_INTERVAL_S = 45.0
+# Wider than device_probe's default candidate list: relay listeners have
+# been observed anywhere in 8080..8117.
+SCAN_PORTS = list(range(8080, 8121))
+BENCH_TIMEOUT_S = 2700.0  # > bench.py's own 2400s watchdog
+PROOF_TIMEOUT_S = 1500.0
+RECAPTURE_COOLDOWN_S = 30 * 60.0
+
+
+def now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def log(event: str, **kw) -> None:
+    rec = {"ts": now(), "event": event, **kw}
+    with open(WATCH_LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def scan_ports(host: str = "127.0.0.1") -> list:
+    open_ports = []
+    for p in SCAN_PORTS:
+        s = socket.socket()
+        s.settimeout(0.5)
+        try:
+            s.connect((host, p))
+            open_ports.append(p)
+        except OSError:
+            pass
+        finally:
+            s.close()
+    return open_ports
+
+
+def head_commit() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def append_capture(entry: dict) -> None:
+    doc = {
+        "note": (
+            "SELF-REPORTED opportunistic TPU captures from the round-4 "
+            "builder session (tools/bench_watch.py): the relay is scanned "
+            "continuously and bench.py runs the moment a probe child "
+            "reports ready. BENCH_WATCH_r04.jsonl holds the full scan log; "
+            "the driver-captured BENCH_r04.json is the source of truth."
+        ),
+        "runs": [],
+    }
+    if os.path.exists(CAPTURE_FILE):
+        try:
+            with open(CAPTURE_FILE) as f:
+                doc = json.load(f)
+        except Exception:
+            pass
+    doc.setdefault("runs", []).append(entry)
+    tmp = CAPTURE_FILE + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, CAPTURE_FILE)
+
+
+def last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def run_capture(kind: str, argv: list, timeout: float) -> dict:
+    commit = head_commit()
+    start = time.monotonic()
+    try:
+        proc = subprocess.run(
+            argv, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+            env={
+                **os.environ,
+                "NOMAD_TPU_BENCH_DEVICE_WAIT": "300",
+                # keep the probe child's reachability diagnostic scanning
+                # the same ports the watcher scans
+                "NOMAD_TPU_RELAY_PORTS": ",".join(map(str, SCAN_PORTS)),
+            },
+        )
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        # POSIX CPython raises TimeoutExpired with the raw captured BYTES
+        # even under text=True (Popen._communicate joins before decoding)
+        rc, out, err = -1, (e.stdout or ""), (e.stderr or "")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+    result = last_json_line(out)
+    # bench.py emits a JSON line even on failure — success means the run
+    # exited clean AND the payload is not an error payload
+    ok = rc == 0 and isinstance(result, dict) and "error" not in result
+    entry = {
+        "captured_at": now(),
+        "kind": kind,
+        "command": " ".join(argv),
+        "commit": commit,
+        "rc": rc,
+        "ok": ok,
+        "wall_s": round(time.monotonic() - start, 1),
+        "result": result,
+        "stderr_tail": "\n".join(err.strip().splitlines()[-12:]),
+    }
+    append_capture(entry)
+    log("capture", kind=kind, rc=rc, commit=commit, ok=ok)
+    return entry
+
+
+PIDFILE = os.path.join(REPO, ".bench_watch.pid")
+
+
+def main() -> None:
+    # Single-instance guard: two overlapping watchers would race the
+    # capture file's read-modify-write and double-claim the device window.
+    if os.path.exists(PIDFILE):
+        try:
+            old = int(open(PIDFILE).read().strip())
+            os.kill(old, 0)  # raises if the pid is gone
+            # Guard against OS pid recycling: only defer to a live pid
+            # that is actually a bench_watch process.
+            with open(f"/proc/{old}/cmdline", "rb") as f:
+                cmdline = f.read().decode(errors="replace")
+            if "bench_watch" in cmdline:
+                log("duplicate-exit", existing_pid=old, pid=os.getpid())
+                return
+        except (ValueError, OSError):
+            pass
+    with open(PIDFILE, "w") as f:
+        f.write(str(os.getpid()))
+    log("start", pid=os.getpid(), ports=f"{SCAN_PORTS[0]}-{SCAN_PORTS[-1]}")
+    last_capture_t = 0.0
+    last_capture_commit = ""
+    while True:
+        try:
+            open_ports = scan_ports()
+            log("scan", open_ports=open_ports)
+            if open_ports:
+                commit = head_commit()
+                fresh_window = (
+                    time.monotonic() - last_capture_t > RECAPTURE_COOLDOWN_S
+                )
+                if fresh_window or commit != last_capture_commit:
+                    from nomad_tpu.scheduler import device_probe
+
+                    report = device_probe.probe_once(
+                        timeout=150,
+                        env={"NOMAD_TPU_RELAY_PORTS":
+                             ",".join(map(str, SCAN_PORTS))},
+                    )
+                    log("probe", ok=report.ok, last_stage=report.last_stage,
+                        backend=report.backend, error=report.error)
+                    if report.ok and report.backend != "cpu":
+                        # Relay answered with a real device: capture NOW —
+                        # historically it dies within minutes.
+                        bench = run_capture(
+                            "bench", [sys.executable, "bench.py"],
+                            BENCH_TIMEOUT_S,
+                        )
+                        proof = os.path.join(REPO, "tools", "pallas_proof.py")
+                        # A failed bench means the window may be closing —
+                        # don't burn it on the proof; retry the bench next
+                        # cycle instead.
+                        if bench["ok"] and os.path.exists(proof):
+                            run_capture(
+                                "pallas_proof", [sys.executable, proof],
+                                PROOF_TIMEOUT_S,
+                            )
+                        # Only a SUCCESSFUL bench closes the window; a
+                        # failed one must keep retrying while the relay is
+                        # still up — that window is the whole point.
+                        if bench["ok"]:
+                            last_capture_t = time.monotonic()
+                            last_capture_commit = commit
+        except Exception as e:  # never let one bad cycle kill the watcher
+            log("error", error=f"{type(e).__name__}: {e}")
+        time.sleep(SCAN_INTERVAL_S)
+
+
+if __name__ == "__main__":
+    main()
