@@ -593,6 +593,113 @@ def run_config5():
     }
 
 
+BREAKDOWN = os.environ.get("NOMAD_TPU_BENCH_BREAKDOWN", "1") == "1"
+# Default sweep scales track the headline cluster size so smoke runs
+# (reduced NOMAD_TPU_BENCH_NODES) don't pay for a 32k-node mirror.
+_BREAKDOWN_SCALES_ENV = os.environ.get("NOMAD_TPU_BENCH_BREAKDOWN_SCALES", "")
+BREAKDOWN_SCALES = tuple(
+    int(s) for s in _BREAKDOWN_SCALES_ENV.split(",") if s
+) if _BREAKDOWN_SCALES_ENV else tuple(
+    s for s in (1024, 4096, 10000, 32768) if s <= 4 * N_NODES
+) or (N_NODES,)
+
+
+def run_breakdown(scales=BREAKDOWN_SCALES):
+    """Device-time accounting: where does a solve's wall time go?
+
+    Splits the production water-fill solve into host staging / H2D
+    transfer / device execute / D2H readback, with bytes moved, at several
+    node scales. On a tunneled remote device the transfer+readback rows
+    carry the round-trip cost that the aggregate solve_ms can't attribute —
+    this is the data that answers whether a slow solve is a slow device or
+    a slow wire, and at which scale the device overtakes the CPU backend
+    (compare captures of the two backends; SURVEY §7 latency budget).
+
+    Protocol per scale n (count = 10n tasks, the headline's ratio):
+    - staging:  NodeMirror construction — host tensorization; device puts
+                are dispatched async inside it, so this is host wall.
+    - transfer: block_until_ready on the mirror's node tensors + clean
+                usage — drains the H2D copies staged above; bytes counted.
+    - execute:  solve_waterfill dispatch + block_until_ready on the
+                device-resident counts (post-warmup, so no compile).
+    - readback: device_get of the counts — D2H wire time; bytes counted.
+    - warm_e2e: dispatch+block+readback in one timed pass, warm mirror —
+                the steady-state per-eval device cost.
+    """
+    import jax
+
+    from nomad_tpu.ops.binpack import device_const, solve_waterfill
+    from nomad_tpu.tpu.mirror import NodeMirror
+
+    ask = (100, 128, 0, 0)  # the headline task's resource vector
+    penalty_dev = device_const("f32", 0.0)
+    bw_ask_dev = device_const("i32", 0)
+    sweep = []
+    for n in scales:
+        count = 10 * n
+        nodes_list = _mk_nodes(n, with_net=False)
+
+        t0 = time.perf_counter()
+        mirror = NodeMirror(nodes_list)
+        usage = mirror.clean_usage()
+        eligible = mirror.device_mask(None, set(), None, None)[0]
+        t1 = time.perf_counter()
+        inputs = (mirror.total, mirror.sched_cap, mirror.bw_avail,
+                  eligible, *usage)
+        for arr in inputs:
+            arr.block_until_ready()
+        t2 = time.perf_counter()
+        transfer_bytes = int(sum(getattr(a, "nbytes", 0) for a in inputs))
+
+        ask_dev = device_const("ask", ask)
+        count_dev = device_const("i32", count)
+        used0, job_count0, tg_count0, bw_used0 = usage
+
+        def dispatch():
+            return solve_waterfill(
+                mirror.total, mirror.sched_cap, used0, job_count0,
+                tg_count0, mirror.bw_avail, bw_used0, eligible, ask_dev,
+                bw_ask_dev, count_dev, penalty_dev, False, False,
+            )
+
+        counts, unplaced = dispatch()  # warmup: compile for this bucket
+        counts.block_until_ready()
+
+        exec_times, read_times, e2e_times = [], [], []
+        for _ in range(RUNS):
+            t = time.perf_counter()
+            counts, unplaced = dispatch()
+            counts.block_until_ready()
+            unplaced.block_until_ready()
+            exec_times.append(time.perf_counter() - t)
+            t = time.perf_counter()
+            counts_host, _ = jax.device_get((counts, unplaced))
+            read_times.append(time.perf_counter() - t)
+            t = time.perf_counter()
+            c2, u2 = dispatch()
+            jax.device_get((c2, u2))
+            e2e_times.append(time.perf_counter() - t)
+
+        placed = int(counts_host.sum())
+        warm_e2e = statistics.median(e2e_times)
+        sweep.append({
+            "n_nodes": n,
+            "count": count,
+            "placed": placed,
+            "staging_ms": round((t1 - t0) * 1000, 2),
+            "transfer_ms": round((t2 - t1) * 1000, 2),
+            "transfer_bytes": transfer_bytes,
+            "execute_ms_p50": round(
+                statistics.median(exec_times) * 1000, 3),
+            "readback_ms_p50": round(
+                statistics.median(read_times) * 1000, 3),
+            "readback_bytes": int(counts_host.nbytes + 4),
+            "warm_e2e_ms_p50": round(warm_e2e * 1000, 3),
+            "placements_per_sec_warm": round(placed / warm_e2e, 1),
+        })
+    return sweep
+
+
 def _pallas_outcome() -> str:
     """Whether the pallas water-fill kernel actually carried the solves:
     'proven' (compiled + executed on this backend), 'fallback' (it faulted
@@ -661,6 +768,12 @@ def main():
                 aux[name] = fn()
             except Exception as e:
                 aux[name] = {"error": f"{type(e).__name__}: {e}"}
+
+        if BREAKDOWN:
+            try:
+                aux["breakdown"] = run_breakdown()
+            except Exception as e:
+                aux["breakdown"] = {"error": f"{type(e).__name__}: {e}"}
 
         emit(
             {
@@ -748,7 +861,20 @@ def _cpu_fallback_headline():
     # REAL device init during our wait — label whatever actually claimed.
     fb_backend = str(status.get("backend", "cpu"))
     solve_p50, e2e_p50, placed, _nodes = _measure_headline()
+    breakdown = None
+    if BREAKDOWN:
+        try:
+            # Failure path: keep the pre-emit window short — sweep only
+            # scales up to the headline size, skip the larger crossover
+            # points (a TPU capture through main() covers those).
+            breakdown = run_breakdown(
+                tuple(s for s in BREAKDOWN_SCALES if s <= N_NODES)
+                or (N_NODES,)
+            )
+        except Exception as e:
+            breakdown = {"error": f"{type(e).__name__}: {e}"}
     return {
+        **({"breakdown": breakdown} if breakdown is not None else {}),
         "backend": fb_backend,
         "note": (
             f"measured on the {fb_backend} backend after device "

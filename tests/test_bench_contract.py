@@ -30,6 +30,7 @@ def _run_bench(extra_env):
         "NOMAD_TPU_BENCH_TASKS": "512",
         "NOMAD_TPU_BENCH_RUNS": "1",
         "NOMAD_TPU_BENCH_DEVICE_WAIT": "30",
+        "NOMAD_TPU_BENCH_BREAKDOWN_SCALES": "256",
         **extra_env,
     }
     proc = subprocess.run(
@@ -57,6 +58,19 @@ def test_fallback_measurement_inside_parsed_json():
     assert "NOT a TPU number" in fb["note"]
     assert payload["pallas"] in {"off", "untried", "proven", "fallback",
                                  "unknown"}
+    _check_breakdown(fb["breakdown"])
+
+
+def _check_breakdown(sweep):
+    """The device-time split must attribute every phase with real numbers."""
+    assert isinstance(sweep, list) and sweep, sweep
+    for row in sweep:
+        assert row["placed"] > 0
+        assert row["transfer_bytes"] > 0
+        assert row["readback_bytes"] > 0
+        assert row["execute_ms_p50"] > 0
+        assert row["warm_e2e_ms_p50"] > 0
+        assert row["placements_per_sec_warm"] > 0
 
 
 def test_allow_cpu_smoke_run_succeeds():
@@ -65,3 +79,4 @@ def test_allow_cpu_smoke_run_succeeds():
     assert payload["value"] > 0
     assert payload["backend"] == "cpu"
     assert "error" not in payload
+    _check_breakdown(payload["breakdown"])
