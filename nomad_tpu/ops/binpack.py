@@ -299,21 +299,25 @@ def solve_many_async(
         k = bucket(count)
         active = jnp.arange(k) < count
         penalty_dev = device_const("f32", penalty)
+        from nomad_tpu.ops.coalesce import device_activity
         from nomad_tpu.parallel import mesh as mesh_lib
 
-        mesh = mesh_lib.mesh_for_nodes(total.shape[0])
-        if mesh is not None:
-            # Node tensors are born sharded by the mirror; the small
-            # per-eval args must be replicated onto the same mesh so the
-            # scan compiles as one SPMD program.
-            ask, bw_ask, active, penalty_dev = mesh_lib.replicate_on_mesh(
-                mesh, ask, bw_ask, active, penalty_dev
+        # The exact path dispatches (and may COMPILE) on the caller's own
+        # thread — mark it so quiesce_all can drain before teardown.
+        with device_activity():
+            mesh = mesh_lib.mesh_for_nodes(total.shape[0])
+            if mesh is not None:
+                # Node tensors are born sharded by the mirror; the small
+                # per-eval args must be replicated onto the same mesh so the
+                # scan compiles as one SPMD program.
+                ask, bw_ask, active, penalty_dev = mesh_lib.replicate_on_mesh(
+                    mesh, ask, bw_ask, active, penalty_dev
+                )
+            idxs, oks, _scores = solve_greedy(
+                total, sched_cap, used0, job_count0, tg_count0, bw_avail,
+                bw_used0, eligible, ask, bw_ask, active,
+                penalty_dev, k, job_distinct, tg_distinct,
             )
-        idxs, oks, _scores = solve_greedy(
-            total, sched_cap, used0, job_count0, tg_count0, bw_avail,
-            bw_used0, eligible, ask, bw_ask, active,
-            penalty_dev, k, job_distinct, tg_distinct,
-        )
 
         def fetch_exact():
             i, o = jax.device_get((idxs, oks))

@@ -17,6 +17,7 @@ dispatch pile up for the next one).
 from __future__ import annotations
 
 import threading
+import time
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -102,20 +103,33 @@ class _Entry:
 class _Group:
     """One dispatched batch: device arrays + lazily-fetched host results."""
 
-    __slots__ = ("counts_dev", "remaining_dev", "_fetch_lock", "_host")
+    __slots__ = ("counts_dev", "remaining_dev", "from_pallas", "_fetch_lock",
+                 "_host")
 
-    def __init__(self, counts_dev, remaining_dev):
+    def __init__(self, counts_dev, remaining_dev, from_pallas: bool = False):
         self.counts_dev = counts_dev
         self.remaining_dev = remaining_dev
+        self.from_pallas = from_pallas
         self._fetch_lock = threading.Lock()
         self._host = None
 
     def fetch(self, index: int) -> Tuple[np.ndarray, int]:
         with self._fetch_lock:
             if self._host is None:
-                counts, remaining = jax.device_get(
-                    (self.counts_dev, self.remaining_dev)
-                )
+                try:
+                    counts, remaining = jax.device_get(
+                        (self.counts_dev, self.remaining_dev)
+                    )
+                except Exception:
+                    # Post-proof dispatches skip the synchronous prove
+                    # (block_until_ready inside _pallas_dispatch's try),
+                    # so an async device fault surfaces HERE. A faulting
+                    # pallas kernel must still degrade the process to the
+                    # warm jnp fallback — otherwise a persistently bad
+                    # device fails every later eval (ADVICE r3).
+                    if self.from_pallas:
+                        _pallas_fallback()
+                    raise
                 self._host = (np.asarray(counts), np.asarray(remaining))
         counts, remaining = self._host
         return counts[index], int(remaining[index])
@@ -133,6 +147,7 @@ class CoalescingSolver:
         self._cond = threading.Condition(self._lock)
         self._pending: List[_Entry] = []
         self._thread: Optional[threading.Thread] = None
+        self._dispatching = False
         # Observability: how many dispatches carried how many evals.
         self.dispatches = 0
         self.coalesced = 0
@@ -165,11 +180,27 @@ class CoalescingSolver:
     def _run(self) -> None:
         while True:
             with self._cond:
+                self._dispatching = False
                 while not self._pending:
                     self._cond.wait()
                 batch = self._pending
                 self._pending = []
+                self._dispatching = True
             self._dispatch(batch)
+
+    def quiesce(self, timeout: float = 5.0) -> bool:
+        """Wait for the dispatcher to go idle (no queued or in-flight
+        solves). Process teardown while the daemon dispatcher thread sits
+        inside an XLA call aborts the interpreter (std::terminate) — clean
+        shutdowns and test harnesses drain first. Returns False on
+        timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending and not self._dispatching:
+                    return True
+            time.sleep(0.01)
+        return False
 
     def _dispatch(self, batch: List[_Entry]) -> None:
         # Group by (padded node count, static flags): only same-shaped,
@@ -195,9 +226,10 @@ class CoalescingSolver:
                     # fetch() caller.
                     for e in chunk:
                         try:
-                            counts_dev, remaining_dev = self._solve_one(e)
+                            counts_dev, remaining_dev, fp = self._solve_one(e)
                             e.group = _Group(
-                                counts_dev[None], remaining_dev[None]
+                                counts_dev[None], remaining_dev[None],
+                                from_pallas=fp,
                             )
                             e.index = 0
                         except Exception as exc:
@@ -211,7 +243,8 @@ class CoalescingSolver:
         configured mesh when one exists (parallel/mesh.py). On an
         unsharded TPU backend the whole solve runs as one VMEM-resident
         pallas kernel (ops/pallas_solve.py), falling back to the jnp
-        path if the kernel ever fails to lower/execute."""
+        path if the kernel ever fails to lower/execute. Returns
+        (counts_dev, remaining_dev, from_pallas)."""
         from nomad_tpu.parallel import mesh as mesh_lib
 
         args10 = e.args[:10]
@@ -224,11 +257,14 @@ class CoalescingSolver:
                 args10[0].shape,
             )
             if out is not None:
-                return out
+                return (*out, True)
         else:
             args10 = mesh_lib.shard_waterfill_args(mesh, args10)
             count, penalty = mesh_lib.replicate_on_mesh(mesh, count, penalty)
-        return solve_waterfill(*args10, count, penalty, e.args[12], e.args[13])
+        return (
+            *solve_waterfill(*args10, count, penalty, e.args[12], e.args[13]),
+            False,
+        )
 
     def _dispatch_group(self, entries: List[_Entry], jd: bool, td: bool) -> None:
         self.dispatches += 1
@@ -238,17 +274,18 @@ class CoalescingSolver:
         )
         if len(entries) == 1:
             e = entries[0]
-            counts_dev, remaining_dev = self._solve_one(e)
-            e.group = _Group(counts_dev[None], remaining_dev[None])
+            counts_dev, remaining_dev, fp = self._solve_one(e)
+            e.group = _Group(counts_dev[None], remaining_dev[None],
+                             from_pallas=fp)
             e.index = 0
             e.event.set()
             return
 
         self.coalesced += len(entries)
-        counts_dev, remaining_dev = _stack_and_solve(
+        counts_dev, remaining_dev, fp = _stack_and_solve(
             [e.args for e in entries], jd, td
         )
-        group = _Group(counts_dev, remaining_dev)
+        group = _Group(counts_dev, remaining_dev, from_pallas=fp)
         for i, e in enumerate(entries):
             e.group = group
             e.index = i
@@ -274,7 +311,7 @@ def _stack_and_solve(rows, jd: bool, td: bool):
     """Stack the eval axis (_stack_rows), shard on the mesh, dispatch the
     batched water-fill. The ONE stacking implementation — shared by the
     dispatcher and warm_batch_shapes so warmup provably compiles the exact
-    shapes real dispatches use."""
+    shapes real dispatches use. Returns (counts, remaining, from_pallas)."""
     from nomad_tpu.parallel import mesh as mesh_lib
 
     stacked, counts, penalties = _stack_rows(rows, jd, td)
@@ -284,16 +321,60 @@ def _stack_and_solve(rows, jd: bool, td: bool):
             True, (*stacked, counts, penalties), jd, td, stacked[0].shape
         )
         if out is not None:
-            return out
+            return (*out, True)
     else:
         stacked, counts, penalties = mesh_lib.shard_waterfill_batch_args(
             mesh, stacked, counts, penalties
         )
-    return solve_waterfill_batched(*stacked, counts, penalties, jd, td)
+    return (
+        *solve_waterfill_batched(*stacked, counts, penalties, jd, td),
+        False,
+    )
 
 
 # Process-wide engine shared by all workers (like GLOBAL_MIRROR_CACHE).
 GLOBAL_SOLVER = CoalescingSolver()
+
+# In-flight direct device work — warm compiles and exact-path solves run
+# jitted calls on their OWN threads (not via the queue), so the
+# dispatcher's idle flag can't see them.
+_warm_lock = threading.Lock()
+_active_warms = 0
+
+
+class device_activity:
+    """Context manager marking a thread as inside direct device work
+    (dispatch/compile outside the coalescer queue), so quiesce_all can
+    drain it before interpreter teardown."""
+
+    def __enter__(self):
+        global _active_warms
+        with _warm_lock:
+            _active_warms += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _active_warms
+        with _warm_lock:
+            _active_warms -= 1
+        return False
+
+
+def quiesce_all(timeout: float = 10.0) -> bool:
+    """Wait until no device work is in flight anywhere — queued/
+    dispatching coalescer solves AND direct jit dispatches (warm compiles,
+    exact-path solves). Process teardown while a daemon thread sits inside
+    an XLA call aborts the interpreter (std::terminate from the C++
+    runtime); callers drain first. Returns False on timeout."""
+    deadline = time.monotonic() + timeout
+    if not GLOBAL_SOLVER.quiesce(max(deadline - time.monotonic(), 0.01)):
+        return False
+    while time.monotonic() < deadline:
+        with _warm_lock:
+            if _active_warms == 0:
+                return True
+        time.sleep(0.02)
+    return False
 
 
 def warm_batch_shapes(n_padded: int, buckets=(1, 2, 4, 8), stop=None) -> int:
@@ -313,6 +394,12 @@ def warm_batch_shapes(n_padded: int, buckets=(1, 2, 4, 8), stop=None) -> int:
             0, 0.0, False, False)
     from nomad_tpu.parallel import mesh as mesh_lib
 
+    with device_activity():
+        return _warm_batch_shapes_inner(
+            n_padded, buckets, stop, args, mesh_lib)
+
+
+def _warm_batch_shapes_inner(n_padded, buckets, stop, args, mesh_lib) -> int:
     done = 0
     # The jnp fallback warm only matters where a pallas fault can route to
     # it: unsharded deployments (a mesh never reaches _pallas_dispatch).
@@ -322,9 +409,9 @@ def warm_batch_shapes(n_padded: int, buckets=(1, 2, 4, 8), stop=None) -> int:
         if stop is not None and stop():
             return done
         if b == 1:
-            counts_dev, _rem = CoalescingSolver._solve_one(_Entry(args))
+            counts_dev, _rem, _fp = CoalescingSolver._solve_one(_Entry(args))
         else:
-            counts_dev, _rem = _stack_and_solve([args] * b, False, False)
+            counts_dev, _rem, _fp = _stack_and_solve([args] * b, False, False)
         jax.block_until_ready(counts_dev)
         if warm_jnp:
             # The dispatches above warmed the pallas programs; compile the

@@ -190,6 +190,28 @@ class ClusterServer(Server):
         if is_leader:
             self.logger.info("cluster: %s gained leadership",
                              self.cluster.node_id)
+            # Leader barrier BEFORE enabling the broker (leader.go
+            # establishLeadership's raft.Barrier): the FSM must contain
+            # every entry committed by prior terms — in particular any
+            # plan a dying leader applied for a still-pending eval — so
+            # restore_eval_broker's wait_index covers it and no worker
+            # schedules that eval against a pre-plan snapshot.
+            try:
+                self.raft.barrier(timeout=10.0)
+            except Exception as e:
+                # Stalled quorum; proceed — a low wait_index degrades to
+                # the pre-barrier behavior rather than wedging leadership
+                # establishment.
+                self.logger.warning("cluster: leader barrier failed: %s", e)
+            # Leadership callbacks run on unordered daemon threads: the
+            # lose-handler may have fully run (disable+flush) DURING the
+            # barrier. Enabling now would leave broker/plan queue live on
+            # a follower — re-check before touching anything.
+            if not self.raft.is_leader:
+                self.logger.info(
+                    "cluster: %s lost leadership during establishment",
+                    self.cluster.node_id)
+                return
             self.plan_queue.set_enabled(True)
             self.eval_broker.set_enabled(True)
             self.restore_eval_broker()
@@ -250,21 +272,20 @@ class ClusterServer(Server):
 
     def eval_dequeue(self, schedulers: List[str], timeout: float):
         if self.raft.is_leader:
-            return self.eval_broker.dequeue(schedulers, timeout)
+            return super().eval_dequeue(schedulers, timeout)
         out = self._forward(
             "Eval.Dequeue", {"schedulers": schedulers, "timeout": timeout},
             timeout=timeout + 5.0,
         )
         if out.get("eval") is None:
-            return None, ""
-        return from_dict(Evaluation, out["eval"]), out["token"]
+            return None, "", 0
+        return (from_dict(Evaluation, out["eval"]), out["token"],
+                int(out.get("wait_index", 0)))
 
     def eval_dequeue_batch(self, schedulers: List[str], max_batch: int,
                            timeout: float):
         if self.raft.is_leader:
-            return self.eval_broker.dequeue_batch(
-                schedulers, max_batch, timeout
-            )
+            return super().eval_dequeue_batch(schedulers, max_batch, timeout)
         out = self._forward(
             "Eval.DequeueBatch",
             {"schedulers": schedulers, "max_batch": max_batch,
@@ -272,7 +293,8 @@ class ClusterServer(Server):
             timeout=timeout + 5.0,
         )
         return [
-            (from_dict(Evaluation, item["eval"]), item["token"])
+            (from_dict(Evaluation, item["eval"]), item["token"],
+             int(item.get("wait_index", 0)))
             for item in out["batch"]
         ]
 
@@ -391,12 +413,13 @@ class ClusterServer(Server):
         r("Serf.PeerUpdate", self._rpc_serf_peer_update)
 
     def _rpc_eval_dequeue(self, args: dict):
-        ev, token = self.eval_dequeue(
+        ev, token, wait_index = self.eval_dequeue(
             args["schedulers"], min(float(args.get("timeout", 0.5)), 10.0)
         )
         if ev is None:
             return {"eval": None, "token": ""}
-        return {"eval": to_dict(ev), "token": token}
+        return {"eval": to_dict(ev), "token": token,
+                "wait_index": wait_index}
 
     def _rpc_eval_dequeue_batch(self, args: dict):
         batch = self.eval_dequeue_batch(
@@ -404,7 +427,8 @@ class ClusterServer(Server):
             min(float(args.get("timeout", 0.5)), 10.0),
         )
         return {"batch": [
-            {"eval": to_dict(ev), "token": token} for ev, token in batch
+            {"eval": to_dict(ev), "token": token, "wait_index": wait_index}
+            for ev, token, wait_index in batch
         ]}
 
     def _rpc_plan_submit(self, args: dict):

@@ -106,6 +106,9 @@ class EvalBroker:
     def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3):
         if nack_timeout < 0:
             raise ValueError("timeout cannot be negative")
+        import logging as _logging
+
+        self.logger = _logging.getLogger("nomad_tpu.eval_broker")
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
         self._enabled = False
@@ -125,6 +128,20 @@ class EvalBroker:
         self._unack: Dict[str, _UnackEval] = {}
         # eval ID -> wait timer
         self._time_wait: Dict[str, threading.Timer] = {}
+        # eval ID -> count of token-verified plans currently in the
+        # applier (redelivery deferred while nonzero; see plan_inflight).
+        self._inflight_plans: Dict[str, int] = {}
+        # eval ID -> raft index the processing worker must observe in ITS
+        # local FSM before snapshotting. For a freshly-created eval this is
+        # the eval's own apply index (same as modify_index); for an eval
+        # re-enqueued after a leadership change it is the new leader's
+        # post-barrier applied index — which covers any plan an earlier
+        # delivery committed right before the old leader died. Without it
+        # a redelivered eval can be scheduled against a snapshot that
+        # predates its own first plan and be placed TWICE (the failover
+        # exactly-once hole; newer reference releases carry the same
+        # mechanism as Dequeue's WaitIndex).
+        self._wait_index: Dict[str, int] = {}
 
     # -- enable/disable ----------------------------------------------------
 
@@ -141,9 +158,13 @@ class EvalBroker:
 
     # -- enqueue -----------------------------------------------------------
 
-    def enqueue(self, ev: Evaluation) -> None:
+    def enqueue(self, ev: Evaluation, wait_index: int = 0) -> None:
         """eval_broker.go:131-155"""
         with self._lock:
+            if wait_index:
+                self._wait_index[ev.id] = max(
+                    wait_index, self._wait_index.get(ev.id, 0)
+                )
             if ev.id in self._evals:
                 return
             if self._enabled:
@@ -236,6 +257,12 @@ class EvalBroker:
                 batch.append(out)
         return batch
 
+    def wait_index(self, eval_id: str) -> int:
+        """The raft index a worker must observe locally before snapshotting
+        for this eval (0 when none was recorded)."""
+        with self._lock:
+            return self._wait_index.get(eval_id, 0)
+
     def _scan_for_schedulers(
         self, schedulers: List[str]
     ) -> Optional[Tuple[Evaluation, str]]:
@@ -274,6 +301,11 @@ class EvalBroker:
 
         self._unack[ev.id] = _UnackEval(ev, token, nack_timer)
         self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+        self.logger.debug(
+            "broker %x: DELIVER eval=%s token=%s attempt=%d wait_index=%d",
+            id(self), ev.id[:8], token[:8], self._evals[ev.id],
+            self._wait_index.get(ev.id, 0),
+        )
 
         self.stats.total_ready -= 1
         self.stats.total_unacked += 1
@@ -283,10 +315,52 @@ class EvalBroker:
         return ev, token
 
     def _nack_from_timer(self, eval_id: str, token: str) -> None:
+        # Defer redelivery while a plan for this delivery sits in the
+        # applier: nacking now would hand the eval to a second worker whose
+        # snapshot races the in-flight plan's commit — the duplicate-
+        # placement window the exactly-once chaos test caught. The applier
+        # bounds the deferral by clearing the inflight mark (and re-arming
+        # the timer via outstanding_reset) when the commit finishes.
         try:
+            # nack() itself defers (short re-check) while a plan from this
+            # delivery is mid-commit in the applier.
             self.nack(eval_id, token)
         except BrokerError:
             pass
+
+    def outstanding_reset_and_mark(self, eval_id: str, token: str) -> None:
+        """Atomic token verification + inflight mark for the plan applier
+        (one lock hold). Two separate calls leave a window where the nack
+        timer fires between the reset and the mark — redelivering the
+        eval while its plan is about to commit, which is exactly the
+        double-placement race the mark exists to close. Raises
+        BrokerError like outstanding_reset."""
+        with self._lock:
+            self._outstanding_reset_locked(eval_id, token)
+            self._inflight_plans[eval_id] = \
+                self._inflight_plans.get(eval_id, 0) + 1
+            self.logger.debug(
+                "broker %x: PLAN-MARK eval=%s token=%s",
+                id(self), eval_id[:8], token[:8])
+
+    def plan_done(self, eval_id: str, commit_index: int = 0) -> None:
+        """Clear the inflight mark; bump the eval's wait_index to the
+        plan's commit index FIRST (same lock), so any deferred redelivery
+        that proceeds next forces the worker's snapshot past the plan."""
+        with self._lock:
+            # Only bump while the eval is still tracked: ack may have won
+            # the race with this finally-block and already dropped the
+            # eval — re-inserting would leak an entry until flush.
+            if commit_index and (eval_id in self._unack
+                                 or eval_id in self._evals):
+                self._wait_index[eval_id] = max(
+                    commit_index, self._wait_index.get(eval_id, 0)
+                )
+            n = self._inflight_plans.get(eval_id, 0) - 1
+            if n <= 0:
+                self._inflight_plans.pop(eval_id, None)
+            else:
+                self._inflight_plans[eval_id] = n
 
     # -- outstanding/ack/nack ---------------------------------------------
 
@@ -302,18 +376,21 @@ class EvalBroker:
         """Reset the Nack timer if the token matches
         (eval_broker.go:396-412); raises BrokerError otherwise."""
         with self._lock:
-            unack = self._unack.get(eval_id)
-            if unack is None:
-                raise BrokerError(ERR_NOT_OUTSTANDING)
-            if unack.token != token:
-                raise BrokerError(ERR_TOKEN_MISMATCH)
-            unack.nack_timer.cancel()
-            new_timer = threading.Timer(
-                self.nack_timeout, self._nack_from_timer, args=(eval_id, token)
-            )
-            new_timer.daemon = True
-            new_timer.start()
-            unack.nack_timer = new_timer
+            self._outstanding_reset_locked(eval_id, token)
+
+    def _outstanding_reset_locked(self, eval_id: str, token: str) -> None:
+        unack = self._unack.get(eval_id)
+        if unack is None:
+            raise BrokerError(ERR_NOT_OUTSTANDING)
+        if unack.token != token:
+            raise BrokerError(ERR_TOKEN_MISMATCH)
+        unack.nack_timer.cancel()
+        new_timer = threading.Timer(
+            self.nack_timeout, self._nack_from_timer, args=(eval_id, token)
+        )
+        new_timer.daemon = True
+        new_timer.start()
+        unack.nack_timer = new_timer
 
     def ack(self, eval_id: str, token: str) -> None:
         """Positive acknowledgment; unblocks the next eval for the job
@@ -336,6 +413,9 @@ class EvalBroker:
             del self._unack[eval_id]
             self._evals.pop(eval_id, None)
             self._job_evals.pop(job_id, None)
+            self._wait_index.pop(eval_id, None)
+            self.logger.debug("broker %x: ACK eval=%s token=%s",
+                              id(self), eval_id[:8], token[:8])
 
             blocked = self._blocked.get(job_id)
             if blocked is not None and len(blocked) > 0:
@@ -354,8 +434,28 @@ class EvalBroker:
                 raise BrokerError("Evaluation ID not found")
             if unack.token != token:
                 raise BrokerError("Token does not match for Evaluation ID")
+            if eval_id in self._inflight_plans:
+                # A plan from THIS delivery is mid-commit in the applier
+                # (e.g. the worker lost the submit response and gave up):
+                # redelivering now hands the eval to a worker whose
+                # snapshot races the commit — double placement. Defer: a
+                # short re-check timer retries the nack after plan_done
+                # has bumped wait_index past the commit.
+                unack.nack_timer.cancel()
+                retry = threading.Timer(
+                    0.25, self._nack_from_timer, args=(eval_id, token)
+                )
+                retry.daemon = True
+                unack.nack_timer = retry
+                retry.start()
+                self.logger.debug(
+                    "broker %x: NACK-DEFER eval=%s token=%s (plan inflight)",
+                    id(self), eval_id[:8], token[:8])
+                return
             unack.nack_timer.cancel()
             del self._unack[eval_id]
+            self.logger.debug("broker %x: NACK eval=%s token=%s",
+                              id(self), eval_id[:8], token[:8])
 
             self.stats.total_unacked -= 1
             self.stats.sched(unack.eval.type).unacked -= 1
@@ -381,6 +481,9 @@ class EvalBroker:
             self._ready = {}
             self._unack = {}
             self._time_wait = {}
+            self._wait_index = {}
+            self._inflight_plans = {}
+            self.logger.debug("broker %x: FLUSH", id(self))
             self._work_available.notify_all()
 
     def snapshot_stats(self) -> BrokerStats:

@@ -83,11 +83,13 @@ class FSM:
     def _apply_eval_update(self, index: int, payload: dict) -> None:
         evals = payload["evals"]
         self.state.upsert_evals(index, evals)
-        # On the leader, hand pending evals to the broker (fsm.go:243-250)
+        # On the leader, hand pending evals to the broker (fsm.go:243-250).
+        # wait_index = the eval's own apply index: the worker's snapshot
+        # must contain at least the write that created the eval.
         if self.eval_broker is not None and self.enqueue_guard():
             for ev in evals:
                 if ev.should_enqueue():
-                    self.eval_broker.enqueue(ev)
+                    self.eval_broker.enqueue(ev, wait_index=index)
 
     def _apply_eval_delete(self, index: int, payload: dict) -> None:
         self.state.delete_eval(index, payload["evals"], payload["allocs"])

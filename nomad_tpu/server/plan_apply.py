@@ -714,9 +714,14 @@ class PlanApplier(threading.Thread):
                 continue
 
             # Token verification guards split-brain evals
-            # (plan_apply.go:52-58, structs.go:1466-1471).
+            # (plan_apply.go:52-58, structs.go:1466-1471). Verify + mark
+            # inflight ATOMICALLY: the inflight mark stops the nack timer
+            # from redelivering this eval while its plan is mid-commit (a
+            # second worker's snapshot would race the commit and double-
+            # place), and a non-atomic mark leaves a timer-sized hole
+            # between check and mark. Cleared in every respond path below.
             try:
-                self.eval_broker.outstanding_reset(
+                self.eval_broker.outstanding_reset_and_mark(
                     pending.plan.eval_id, pending.plan.eval_token
                 )
             except BrokerError as e:
@@ -739,6 +744,7 @@ class PlanApplier(threading.Thread):
             telemetry.measure_since(("plan", "evaluate"), t0)
 
             if result.is_noop():
+                self.eval_broker.plan_done(pending.plan.eval_id)
                 pending.respond(result, None)
                 continue
 
@@ -790,13 +796,20 @@ class PlanApplier(threading.Thread):
 
     def _async_plan_wait(self, wait_event, future, result, pending: PendingPlan):
         """plan_apply.go:146-162"""
+        index = 0
         try:
-            index = future.result()
-        except Exception as e:  # raft apply failed
-            self.logger.error("failed to apply plan: %s", e)
-            pending.respond(None, e)
+            try:
+                index = future.result()
+            except Exception as e:  # raft apply failed
+                self.logger.error("failed to apply plan: %s", e)
+                pending.respond(None, e)
+                wait_event.set()
+                return
+            result.alloc_index = index
+            pending.respond(result, None)
             wait_event.set()
-            return
-        result.alloc_index = index
-        pending.respond(result, None)
-        wait_event.set()
+        finally:
+            # The commit is durable (or failed): redelivery may proceed,
+            # and a redelivered worker's wait_index now covers this plan.
+            self.eval_broker.plan_done(pending.plan.eval_id,
+                                       commit_index=index)

@@ -244,10 +244,14 @@ class Server:
 
     def restore_eval_broker(self) -> None:
         """Re-enqueue non-terminal evals after (re)gaining leadership
-        (leader.go:142-168)."""
+        (leader.go:142-168). wait_index = the post-barrier applied index:
+        an earlier delivery of a restored eval may have committed a plan
+        right before the previous leader died, and the next worker's
+        snapshot must contain that plan or the eval gets placed twice."""
+        wait_index = self.raft.applied_index
         for ev in self.state_store.evals():
             if ev.should_enqueue():
-                self.eval_broker.enqueue(ev)
+                self.eval_broker.enqueue(ev, wait_index=wait_index)
 
     def _periodic_dispatcher(self) -> None:
         """Dispatch GC core evals periodically (leader.go:170-200)."""
@@ -515,15 +519,30 @@ class Server:
     # -- Eval endpoint (eval_endpoint.go) ------------------------------------
 
     def eval_dequeue(self, schedulers: List[str], timeout: float):
-        return self.eval_broker.dequeue(schedulers, timeout)
+        """Returns (eval, token, wait_index) — wait_index is the raft
+        index the worker must observe locally before snapshotting."""
+        ev, token = self.eval_broker.dequeue(schedulers, timeout)
+        if ev is None:
+            return None, "", 0
+        # Floor at the leader's applied index: whatever was committed
+        # before this delivery (earlier plans for this eval included) must
+        # be visible in the processing worker's snapshot.
+        return ev, token, max(self.eval_broker.wait_index(ev.id),
+                              self.raft.applied_index)
 
     def eval_dequeue_batch(self, schedulers: List[str], max_batch: int,
                            timeout: float):
         """Coalescing dequeue: block for one eval, drain up to max_batch-1
         more ready ones (distinct jobs). The broker half of SURVEY.md §7
         'Batched evals' — the worker runs the batch concurrently so the
-        device solves stack into one dispatch (ops/coalesce.py)."""
-        return self.eval_broker.dequeue_batch(schedulers, max_batch, timeout)
+        device solves stack into one dispatch (ops/coalesce.py).
+        Returns (eval, token, wait_index) triples."""
+        return [
+            (ev, token, max(self.eval_broker.wait_index(ev.id),
+                            self.raft.applied_index))
+            for ev, token in self.eval_broker.dequeue_batch(
+                schedulers, max_batch, timeout)
+        ]
 
     def eval_ack(self, eval_id: str, token: str) -> None:
         self.eval_broker.ack(eval_id, token)

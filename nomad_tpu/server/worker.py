@@ -76,10 +76,10 @@ class Worker(threading.Thread):
                 )
                 threads = [
                     threading.Thread(
-                        target=self._process, args=(ev, token), daemon=True,
-                        name=f"{self.name}-batch{i}",
+                        target=self._process, args=(ev, token, wait_index),
+                        daemon=True, name=f"{self.name}-batch{i}",
                     )
-                    for i, (ev, token) in enumerate(batch)
+                    for i, (ev, token, wait_index) in enumerate(batch)
                 ]
                 for t in threads:
                     t.start()
@@ -91,11 +91,17 @@ class Worker(threading.Thread):
                     continue
                 self._process(*dequeued)
 
-    def _process(self, ev: Evaluation, token: str) -> None:
-        # Wait for the state to reach the eval's modify index
-        # (worker.go:209-230).
+    def _process(self, ev: Evaluation, token: str,
+                 wait_index: int = 0) -> None:
+        # Wait for the local FSM to reach both the eval's modify index and
+        # the broker's wait_index (worker.go:209-230 + Dequeue WaitIndex):
+        # a redelivered eval's wait_index covers any plan an earlier
+        # delivery committed before a leader died — snapshotting short of
+        # it double-places the eval.
         try:
-            self._wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
+            self._wait_for_index(
+                max(ev.modify_index, wait_index), RAFT_SYNC_LIMIT
+            )
         except TimeoutError as e:
             self.logger.error("error waiting for state sync: %s", e)
             self._send_ack(ev.id, token, ack=False)
@@ -131,20 +137,27 @@ class Worker(threading.Thread):
             target=touch_loop, daemon=True, name=f"{self.name}-touch"
         )
         toucher.start()
+        # device_activity: scheduler invocation does device work on THIS
+        # thread (mirror device_puts, exact-path solves, result fetches);
+        # quiesce_all must be able to drain it before interpreter teardown
+        # — a daemon worker of a shut-down server can still be mid-solve.
+        from nomad_tpu.ops.coalesce import device_activity
+
         try:
-            ok = self._invoke_scheduler(
-                ev, token, planner=_EvalRun(self, token)
-            )
+            with device_activity():
+                ok = self._invoke_scheduler(
+                    ev, token, planner=_EvalRun(self, token)
+                )
         finally:
             stop_touch.set()
         self._send_ack(ev.id, token, ack=ok)
 
     # -- internals ---------------------------------------------------------
 
-    def _dequeue_evaluation(self) -> Optional[Tuple[Evaluation, str]]:
+    def _dequeue_evaluation(self) -> Optional[Tuple[Evaluation, str, int]]:
         start = time.perf_counter()
         try:
-            ev, token = self.server.eval_dequeue(
+            ev, token, wait_index = self.server.eval_dequeue(
                 self.server.config.enabled_schedulers, timeout=DEQUEUE_TIMEOUT
             )
         except BrokerError:
@@ -159,7 +172,7 @@ class Worker(threading.Thread):
             return None
         telemetry.measure_since(("worker", "dequeue_eval"), start)
         self.logger.debug("dequeued evaluation %s", ev.id)
-        return ev, token
+        return ev, token, wait_index
 
     def _dequeue_batch(self, max_batch: int):
         start = time.perf_counter()
@@ -179,7 +192,7 @@ class Worker(threading.Thread):
             telemetry.measure_since(("worker", "dequeue_eval"), start)
             self.logger.debug(
                 "dequeued %d evaluation(s): %s",
-                len(batch), [ev.id for ev, _ in batch],
+                len(batch), [ev.id for ev, _, _ in batch],
             )
         return batch
 
@@ -279,8 +292,17 @@ class _EvalRun:
         new_state = None
         if result.refresh_index != 0:
             # Stale data: wait for the log to catch up, then refresh
-            # (worker.go:304-322).
-            self.worker._wait_for_index(result.refresh_index, RAFT_SYNC_LIMIT)
+            # (worker.go:304-322). The wait MUST also cover this plan's
+            # own commit (alloc_index): refresh_index alone can be lower,
+            # and a worker on a lagging follower would re-snapshot WITHOUT
+            # the allocs it just placed — then re-place them. (The chaos
+            # test's dominant duplicate-placement mode: partial plan →
+            # stale refresh → the remainder solve re-places the whole
+            # group.)
+            self.worker._wait_for_index(
+                max(result.refresh_index, result.alloc_index),
+                RAFT_SYNC_LIMIT,
+            )
             new_state = self.worker.server.state_store.snapshot()
         return result, new_state
 

@@ -1191,7 +1191,7 @@ class TPUSystemScheduler(SystemScheduler):
         return TPUStack(ctx, system=True)
 
     def _place_system_batch(self, tg, tg_constr, missing_list, mirror,
-                            fit_np, metrics) -> bool:
+                            fit_np, metrics, elig_np=None) -> bool:
         """Columnar system placement: one AllocBatch of unit runs over the
         fitting pinned nodes. Applies only to large network-free groups
         with each node appearing once (the normal system diff shape —
@@ -1261,7 +1261,14 @@ class TPUSystemScheduler(SystemScheduler):
                     if failed == 0:
                         first_failed_idx = idx_val
                     failed += 1
-                    metrics.exhausted_node(mirror.nodes[row], "resources")
+                    # Constraint-filtered vs resource-exhausted, per the
+                    # reference's FilterNode/exhausted split.
+                    if elig_np is not None and not elig_np[row]:
+                        metrics.filter_node(mirror.nodes[row],
+                                            "constraint-mask")
+                    else:
+                        metrics.exhausted_node(mirror.nodes[row],
+                                               "resources")
 
         self._emit_system_batch(tg, tg_constr, metrics, node_ids, name_idx,
                                 failed, first_failed_idx)
@@ -1369,14 +1376,21 @@ class TPUSystemScheduler(SystemScheduler):
             res = self._system_fit(tg, tg_constr, mirror)
             if res is None:
                 continue  # same posture as compute_placements' prep bail
-            _prep, fit_np = res
+            prep, fit_np = res
             fits = fit_np[:n]
             placed_rows = np.nonzero(fits)[0]
             nodes = mirror.nodes
             node_ids = [nodes[i].id for i in placed_rows]
             failed_rows = np.nonzero(~fits)[0]
+            # Attribute like the reference's FilterNode/exhausted split
+            # (feasible.go vs rank.go): a node the eligibility mask
+            # rejected was constraint-filtered, not resource-exhausted.
+            elig_np = np.asarray(prep.mask)[:n]
             for i in failed_rows:
-                metrics.exhausted_node(nodes[i], "resources")
+                if elig_np[i]:
+                    metrics.exhausted_node(nodes[i], "resources")
+                else:
+                    metrics.filter_node(nodes[i], "constraint-mask")
             self._emit_system_batch(
                 tg, tg_constr, metrics, node_ids,
                 np.zeros(len(node_ids), dtype=np.int64),
@@ -1411,7 +1425,8 @@ class TPUSystemScheduler(SystemScheduler):
             prep, fit_np = res
 
             if self._place_system_batch(tg, tg_constr, missing_list,
-                                        mirror, fit_np, metrics):
+                                        mirror, fit_np, metrics,
+                                        elig_np=np.asarray(prep.mask)):
                 continue
 
             # Host-side in-group accounting: if a node receives more than one
@@ -1507,7 +1522,7 @@ def warm_shapes(snapshot, counts=(8, 16, 32, 64, 128, 129), logger=None,
     the first eval uses. Returns the number of solve dispatches issued.
     """
     from nomad_tpu import structs as _structs
-    from nomad_tpu.structs import Plan, Task
+    from nomad_tpu.ops.coalesce import device_activity
 
     log = logger or logging.getLogger("nomad_tpu.tpu.warm")
     nodes = [
@@ -1516,6 +1531,14 @@ def warm_shapes(snapshot, counts=(8, 16, 32, 64, 128, 129), logger=None,
     ]
     if not nodes:
         return 0
+    with device_activity():
+        return _warm_shapes_inner(snapshot, counts, log, stop, nodes)
+
+
+def _warm_shapes_inner(snapshot, counts, log, stop, nodes) -> int:
+    from nomad_tpu import structs as _structs
+    from nomad_tpu.structs import Plan, Task
+
     all_dcs = sorted({n.datacenter for n in nodes})
     # One warm per distinct node-axis bucket: the union of datacenters plus
     # each single datacenter (the common job targeting shapes).

@@ -9,11 +9,19 @@ before jax is imported anywhere.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# Child processes (device probes, forked servers) must claim the cpu backend
-# too — the image's sitecustomize pins the axon platform regardless of
-# JAX_PLATFORMS, so the probe child honors this explicit re-pin knob.
-os.environ["NOMAD_TPU_PROBE_FORCE_CPU"] = "1"
+# NOMAD_TPU_TEST_TPU=1 opts OUT of the cpu pin so the hardware-gated tests
+# (tests/test_pallas_compiled.py) can actually claim the real device —
+# only set it where a TPU backend is known-alive; a dead relay will wedge
+# backend init.
+_TPU_RUN = os.environ.get("NOMAD_TPU_TEST_TPU") == "1"
+
+if not _TPU_RUN:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # Child processes (device probes, forked servers) must claim the cpu
+    # backend too — the image's sitecustomize pins the axon platform
+    # regardless of JAX_PLATFORMS, so the probe child honors this explicit
+    # re-pin knob.
+    os.environ["NOMAD_TPU_PROBE_FORCE_CPU"] = "1"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -25,6 +33,21 @@ if "xla_force_host_platform_device_count" not in flags:
 # the backend initializes.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_RUN:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _drain_device_threads():
+    """Interpreter teardown while a daemon thread (coalescer dispatcher,
+    a shut-down server's shape prewarm) sits inside an XLA call aborts the
+    process with std::terminate AFTER all tests passed — drain device work
+    before pytest exits."""
+    yield
+    from nomad_tpu.ops.coalesce import quiesce_all
+
+    quiesce_all(timeout=20.0)

@@ -246,3 +246,156 @@ def test_raft_log_persistence(tmp_path):
         assert len(srv2.state_store.allocs_by_job(job.id)) == 1
     finally:
         srv2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: leader death under coalesced load
+# ---------------------------------------------------------------------------
+
+
+def test_leader_death_mid_coalesced_burst():
+    """Kill the leader while a burst of coalesced evals is mid-flight —
+    solves running, plans queued, broker evals outstanding. The highest-
+    risk interleaving of the batched-solve design: in-flight work dies
+    with the leader's broker/plan queue, but every eval is raft-committed
+    at registration, so the new leader's restored broker must finish all
+    of them exactly once — full placement per job, no node overcommitted,
+    and the survivor pipeline healthy for new work.
+
+    Seeded via NOMAD_TPU_CHAOS_SEED (kill-delay replayable). Reference
+    posture: nomad/leader_test.go (failover re-enables broker/plan queue)
+    + nomad/plan_apply.go:39-117 (plan apply is the serialization point).
+    """
+    import os
+
+    import numpy as np
+
+    seed = int(os.environ.get("NOMAD_TPU_CHAOS_SEED", "0"))
+    rng = np.random.default_rng(seed)
+
+    servers = form_cluster(3, ServerConfig(
+        scheduler_backend="tpu", num_schedulers=2, eval_batch_size=4,
+        # Mock nodes never heartbeat; the TTL must outlive the whole
+        # recovery window or expiry marks every node down mid-assert and
+        # the test measures TTL behavior instead of failover semantics.
+        min_heartbeat_ttl=300.0,
+    ), base_cluster=relaxed_cluster_cfg())
+    try:
+        leader = wait_for_leader(servers)
+        nodes = [mock.node() for _ in range(20)]
+        for node in nodes:
+            retry_write(lambda n=node: leader.node_register(n))
+
+        # Burst: 8 service jobs x 10 allocs, registered back-to-back so
+        # the broker coalesces them across both schedulers.
+        jobs = []
+        eval_ids = []
+        for _ in range(8):
+            job = mock.job()
+            ev_id, _ = retry_write(lambda j=job: leader.job_register(j))
+            jobs.append(job)
+            eval_ids.append(ev_id)
+
+        # Kill the leader at a seeded point inside the burst's flight
+        # window — solves dispatched, plans queued, evals unacked.
+        time.sleep(float(rng.uniform(0.05, 0.6)))
+        leader.shutdown()
+
+        survivors = [s for s in servers if s is not leader]
+        # Generous: under GIL contention (2 servers' workers + solves in
+        # one process) election churn can stretch well past the relaxed
+        # 0.4-0.8s timeouts.
+        new_leader = wait_for_leader(survivors, timeout=30.0)
+
+        # Every eval reaches a terminal status on the new leader. An eval
+        # that died unacked with the old broker is re-enqueued from
+        # replicated state (restore_eval_broker); blocked children count
+        # as progress, so wait on JOB completion below, not eval count.
+        deadline = time.monotonic() + 60.0
+        def _all_terminal():
+            for ev_id in eval_ids:
+                ev = new_leader.state_store.eval_by_id(ev_id)
+                if ev is None or not ev.terminal_status():
+                    return False
+            return True
+        while time.monotonic() < deadline and not _all_terminal():
+            time.sleep(0.1)
+        assert _all_terminal(), [
+            (i, getattr(new_leader.state_store.eval_by_id(i), "status", None))
+            for i in eval_ids
+        ]
+
+        # Exactly-once placement: every job fully placed, never over-placed
+        # (a replayed plan would show up as > count live allocs).
+        deadline = time.monotonic() + 60.0
+        def _fully_placed():
+            for job in jobs:
+                live = structs.filter_terminal_allocs(
+                    new_leader.state_store.allocs_by_job(job.id))
+                if len(live) != job.task_groups[0].count:
+                    return False
+            return True
+        while time.monotonic() < deadline and not _fully_placed():
+            time.sleep(0.1)
+        state = [
+            {
+                "job": job.id,
+                "live": len(structs.filter_terminal_allocs(
+                    new_leader.state_store.allocs_by_job(job.id))),
+                "want": job.task_groups[0].count,
+                "evals": [
+                    (e.id[:8], e.status, e.triggered_by,
+                     e.status_description)
+                    for e in new_leader.state_store.evals_by_job(job.id)
+                ],
+                "allocs": [
+                    (a.id[:8], a.eval_id[:8], a.node_id[:8],
+                     a.desired_status, a.client_status, a.create_index)
+                    for a in new_leader.state_store.allocs_by_job(job.id)
+                ],
+            }
+            for job in jobs
+        ]
+        bad = [r for r in state if r["live"] != r["want"]]
+        if bad:
+            import json as _json
+            with open("/tmp/chaos_dump.json", "w") as f:
+                _json.dump(state, f, indent=1)
+            raise AssertionError(
+                f"exactly-once violated (full dump /tmp/chaos_dump.json): "
+                f"{_json.dumps(bad)[:3000]}"
+            )
+
+        # No node overcommitted: sum of live asks fits its resources.
+        node_by_id = {n.id: n for n in nodes}
+        used = {}
+        for job in jobs:
+            for a in structs.filter_terminal_allocs(
+                    new_leader.state_store.allocs_by_job(job.id)):
+                cpu, mem = used.get(a.node_id, (0, 0))
+                res = a.resources
+                used[a.node_id] = (cpu + res.cpu, mem + res.memory_mb)
+        for nid, (cpu, mem) in used.items():
+            node = node_by_id[nid]
+            res = node.resources
+            reserved = node.reserved
+            cap_cpu = res.cpu - (reserved.cpu if reserved else 0)
+            cap_mem = res.memory_mb - (reserved.memory_mb if reserved else 0)
+            assert cpu <= cap_cpu, (nid, cpu, cap_cpu)
+            assert mem <= cap_mem, (nid, mem, cap_mem)
+
+        # Survivor pipeline serves NEW work end-to-end.
+        job2 = mock.job()
+        job2.task_groups[0].count = 2
+        ev2_id, _ = retry_write(lambda: new_leader.job_register(job2))
+        ev2 = new_leader.wait_for_eval(ev2_id, timeout=30.0)
+        assert ev2.status == structs.EVAL_STATUS_COMPLETE
+    finally:
+        for srv in servers:
+            srv.shutdown()
+        # Interpreter teardown while a daemon thread (coalescer dispatch,
+        # a dead server's shape prewarm) sits inside an XLA call aborts
+        # the process (std::terminate) — drain before returning.
+        from nomad_tpu.ops.coalesce import quiesce_all
+
+        quiesce_all(timeout=15.0)

@@ -188,3 +188,33 @@ def test_total_failure_raises_instead_of_hanging(monkeypatch):
         with pytest.raises(RuntimeError, match="coalesced solve failed") as ei:
             fetch()
         assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_post_proof_fault_disables_pallas(monkeypatch):
+    """Once a shape is proven, dispatches skip the synchronous prove, so an
+    async device fault surfaces at the result fetch. A pallas-provenance
+    group must route that fault through the fallback (disabling the kernel
+    for the process); a jnp-provenance group must not."""
+    from nomad_tpu.ops import coalesce, pallas_solve
+
+    pallas_solve.reset_pallas_failed()
+
+    def boom(_):
+        raise RuntimeError("async mosaic fault")
+
+    monkeypatch.setattr(coalesce.jax, "device_get", boom)
+
+    g = coalesce._Group("counts", "remaining", from_pallas=True)
+    with pytest.raises(RuntimeError):
+        g.fetch(0)
+    assert pallas_solve._STATE["failed"], (
+        "post-proof pallas fault did not disable the kernel"
+    )
+
+    pallas_solve.reset_pallas_failed()
+    g2 = coalesce._Group("counts", "remaining", from_pallas=False)
+    with pytest.raises(RuntimeError):
+        g2.fetch(0)
+    assert not pallas_solve._STATE["failed"], (
+        "jnp-path fault wrongly disabled the pallas kernel"
+    )

@@ -436,3 +436,38 @@ def test_failed_eval_reaped_and_job_unwedged():
         assert good.status == structs.EVAL_STATUS_COMPLETE
     finally:
         srv.shutdown()
+
+
+def test_broker_nack_deferred_while_plan_inflight():
+    """A nack (explicit or timer) must not redeliver an eval whose
+    token-verified plan is mid-commit in the applier: the second worker's
+    snapshot would race the commit and double-place. plan_done lifts the
+    deferral and bumps the eval's wait_index past the commit."""
+    from nomad_tpu.server.eval_broker import EvalBroker
+
+    b = EvalBroker(nack_timeout=60.0)
+    b.set_enabled(True)
+    ev = mock.evaluation()
+    b.enqueue(ev, wait_index=7)
+    out, token = b.dequeue(["service"], timeout=1.0)
+    assert out.id == ev.id
+
+    # Applier verifies + marks atomically
+    b.outstanding_reset_and_mark(ev.id, token)
+
+    # Worker gives up mid-commit: nack is DEFERRED, not redelivered
+    b.nack(ev.id, token)
+    assert b.dequeue(["service"], timeout=0.1) == (None, "")
+    _tok, outstanding = b.outstanding(ev.id)
+    assert outstanding  # still held by the original delivery
+
+    # Commit lands: wait_index bumped, deferral lifted on the re-check
+    b.plan_done(ev.id, commit_index=42)
+    assert b.wait_index(ev.id) == 42
+    deadline = time.time() + 5
+    redelivered = (None, "")
+    while time.time() < deadline and redelivered[0] is None:
+        redelivered = b.dequeue(["service"], timeout=0.2)
+    assert redelivered[0] is not None and redelivered[0].id == ev.id
+    # The redelivery's wait_index still carries the commit bump
+    assert b.wait_index(ev.id) == 42

@@ -51,7 +51,7 @@ def test_worker_dequeue_invoke_ack(srv):
 
     got = w._dequeue_evaluation()
     assert got is not None
-    dq, token = got
+    dq, token, wait_index = got
     assert dq.id == ev.id
 
     w._wait_for_index(dq.modify_index, 2.0)
@@ -70,10 +70,10 @@ def test_worker_nack_redelivers(srv):
     _node, _job, ev = _seed_job_eval(srv)
     w = Worker(srv, worker_id=98)
 
-    dq, token = w._dequeue_evaluation()
+    dq, token, _wi = w._dequeue_evaluation()
     w._send_ack(dq.id, token, ack=False)
 
-    dq2, token2 = w._dequeue_evaluation()
+    dq2, token2, _wi2 = w._dequeue_evaluation()
     assert dq2.id == ev.id
     assert token2 != token or token2 == token  # redelivered with a token
     w._send_ack(dq2.id, token2, ack=True)
@@ -93,7 +93,7 @@ def test_submit_plan_stamps_token_and_refreshes(srv):
     (worker.go:265-328)."""
     node, job, ev = _seed_job_eval(srv)
     w = Worker(srv, worker_id=96)
-    dq, token = w._dequeue_evaluation()
+    dq, token, _wi = w._dequeue_evaluation()
     w.eval_token = token
 
     alloc = mock.alloc()
@@ -117,7 +117,7 @@ def test_submit_plan_rejects_wrong_token(srv):
     the split-brain guard (plan_apply.go:52-58)."""
     _node, _job, ev = _seed_job_eval(srv)
     w = Worker(srv, worker_id=95)
-    dq, token = w._dequeue_evaluation()
+    dq, token, _wi = w._dequeue_evaluation()
     w.eval_token = "bogus-token"
 
     plan = Plan(eval_id=dq.id, priority=50)
@@ -176,10 +176,10 @@ def test_worker_batch_dequeue_drains_ready_evals(srv):
     w = Worker(srv, worker_id=92)
     batch = w._dequeue_batch(4)
     assert len(batch) == 4
-    assert {ev.id for ev, _ in batch} == {ev.id for ev in evals}
+    assert {ev.id for ev, _, _ in batch} == {ev.id for ev in evals}
     # Each eval carries its own outstanding token
-    assert len({token for _, token in batch}) == 4
-    for ev, token in batch:
+    assert len({token for _, token, _ in batch}) == 4
+    for ev, token, _wi in batch:
         w._send_ack(ev.id, token, ack=True)
 
 
@@ -197,6 +197,13 @@ def test_batched_worker_processes_all_with_coalesced_dispatches():
     s.plan_queue.set_enabled(True)
     s.eval_broker.set_enabled(True)
     s.plan_applier.start()
+    # The assertion below counts coalescer dispatches, so the device
+    # solver must be READY before any eval processes — otherwise the
+    # factory legitimately falls back to the host scheduler (order-
+    # dependent flake when an earlier test started the ready race).
+    from nomad_tpu.scheduler import wait_for_device
+
+    assert wait_for_device(timeout=120) is not None
     try:
         # count > exact threshold so the water-fill/coalescer path runs
         jobs, evals = _seed_n_jobs(s, 4, count=200)
@@ -249,3 +256,44 @@ def test_worker_pause_blocks_processing(srv):
         assert srv.state_store.eval_by_id(ev.id).status == structs.EVAL_STATUS_COMPLETE
     finally:
         w.stop()
+
+
+def test_submit_plan_refresh_covers_own_commit(srv):
+    """The post-plan refresh wait must cover max(refresh_index,
+    alloc_index): waiting on refresh_index alone lets a worker on a
+    lagging follower re-snapshot WITHOUT the allocs its own plan just
+    committed — and re-place them (the chaos test's dominant
+    duplicate-placement mode)."""
+    _node, _job, ev = _seed_job_eval(srv)
+    w = Worker(srv, worker_id=94)
+    dq, token, _wi = w._dequeue_evaluation()
+
+    waited = []
+    w._wait_for_index = lambda idx, t: waited.append(idx)
+
+    from nomad_tpu.server.worker import _EvalRun
+    from nomad_tpu.structs import PlanResult
+
+    run = _EvalRun(w, token)
+
+    class FakeServer:
+        @staticmethod
+        def plan_submit(plan):
+            # Partial plan: rejection forced a refresh at index 3, but
+            # the accepted slice committed later, at index 9.
+            return PlanResult(refresh_index=3, alloc_index=9)
+
+        state_store = srv.state_store
+
+    class FakeWorker:
+        server = FakeServer
+        _wait_for_index = staticmethod(w._wait_for_index)
+
+    run.worker = FakeWorker()
+    result, new_state = run.submit_plan(
+        __import__("nomad_tpu.structs", fromlist=["Plan"]).Plan(
+            eval_id=dq.id, priority=50)
+    )
+    assert waited == [9], waited  # max(3, 9), not 3
+    assert new_state is not None
+    w._send_ack(dq.id, token, ack=True)
