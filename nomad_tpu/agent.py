@@ -56,6 +56,10 @@ class AgentConfig:
     atlas_infrastructure: str = ""
     atlas_token: str = ""
     atlas_endpoint: str = ""
+    # TLS for the server RPC tier (+ optionally the uplink tunnel):
+    # a nomad_tpu.tlsutil.TLSConfig, or None for plaintext.
+    tls: object = None
+    tls_uplink: bool = False
 
     @classmethod
     def dev(cls) -> "AgentConfig":
@@ -109,7 +113,31 @@ class AgentConfig:
             atlas_infrastructure=fc.atlas.infrastructure,
             atlas_token=fc.atlas.token,
             atlas_endpoint=fc.atlas.endpoint,
+            tls=(_tls_from_block(fc.tls) if fc.tls.enabled else None),
+            tls_uplink=_check_uplink_tls(fc.tls),
         )
+
+
+def _check_uplink_tls(block) -> bool:
+    if block.uplink and not block.enabled:
+        # Silent plaintext downgrade is worse than failing fast.
+        raise ValueError(
+            "tls.uplink requires tls.enabled (the tunnel would silently "
+            "run plaintext otherwise)")
+    return block.uplink
+
+
+def _tls_from_block(block) -> "object":
+    from nomad_tpu.tlsutil import TLSConfig
+
+    return TLSConfig(
+        enabled=True,
+        ca_file=block.ca_file,
+        cert_file=block.cert_file,
+        key_file=block.key_file,
+        verify_incoming=block.verify_incoming,
+        verify_hostname=block.verify_hostname,
+    )
 
 
 class Agent:
@@ -144,6 +172,7 @@ class Agent:
             datacenter=self.config.datacenter,
             node_name=self.config.node_name or "server",
             scheduler_backend=self.config.scheduler_backend,
+            tls=self.config.tls,
         )
         if self.config.num_schedulers:
             server_config.num_schedulers = self.config.num_schedulers
@@ -199,6 +228,7 @@ class Agent:
             options=dict(self.config.client_options),
             rpc_handler=self.server,
             servers=list(self.config.client_servers),
+            tls=self.config.tls,
         )
 
     def setup_telemetry(self) -> None:
@@ -256,6 +286,9 @@ class Agent:
             # An endpoint alone is enough (the Atlas docstring promises
             # "endpoint set -> agent dials"); infrastructure falls back to
             # the node name so the broker still gets a session key.
+            uplink_tls = None
+            if self.config.tls_uplink and self.config.tls is not None:
+                uplink_tls = self.config.tls.outgoing_context()
             self.uplink = UplinkProvider(
                 endpoint=self.config.atlas_endpoint,
                 infrastructure=self.config.atlas_infrastructure
@@ -265,6 +298,7 @@ class Agent:
                 meta={"region": self.config.region,
                       "datacenter": self.config.datacenter},
                 logger=self.logger.getChild("scada"),
+                tls_context=uplink_tls,
             )
             self.uplink.start()
 

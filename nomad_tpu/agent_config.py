@@ -99,6 +99,21 @@ class Atlas:
 
 
 @dataclass
+class TLSBlock:
+    """TLS for the server RPC tier and the uplink tunnel (reference:
+    nomad/tlsutil feeding the rpcTLS listener arm, nomad/rpc.go:104-110).
+    ``uplink`` additionally wraps the dialed atlas tunnel."""
+
+    enabled: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    verify_incoming: bool = True
+    verify_hostname: bool = False
+    uplink: bool = False
+
+
+@dataclass
 class FileConfig:
     """Full agent config-file surface (config.go Config struct)."""
 
@@ -116,6 +131,7 @@ class FileConfig:
     server: ServerBlock = field(default_factory=ServerBlock)
     telemetry: Telemetry = field(default_factory=Telemetry)
     atlas: Atlas = field(default_factory=Atlas)
+    tls: TLSBlock = field(default_factory=TLSBlock)
     leave_on_interrupt: bool = False
     leave_on_terminate: bool = False
     enable_syslog: bool = False
@@ -205,6 +221,19 @@ class FileConfig:
             join=other.atlas.join or self.atlas.join,
             endpoint=other.atlas.endpoint or self.atlas.endpoint,
         )
+        out.tls = TLSBlock(
+            enabled=other.tls.enabled or self.tls.enabled,
+            ca_file=other.tls.ca_file or self.tls.ca_file,
+            cert_file=other.tls.cert_file or self.tls.cert_file,
+            key_file=other.tls.key_file or self.tls.key_file,
+            # verify_incoming defaults True; an explicit False in either
+            # layer wins (relaxation must be expressible).
+            verify_incoming=(self.tls.verify_incoming
+                             and other.tls.verify_incoming),
+            verify_hostname=(other.tls.verify_hostname
+                             or self.tls.verify_hostname),
+            uplink=other.tls.uplink or self.tls.uplink,
+        )
         return out
 
 
@@ -284,6 +313,11 @@ def _from_mapping(data: dict) -> FileConfig:
         elif key == "atlas":
             for k, v in value.items():
                 setattr(cfg.atlas, k, v)
+        elif key == "tls":
+            for k, v in value.items():
+                if not hasattr(cfg.tls, k):
+                    raise ValueError(f"unknown tls config key {k!r}")
+                setattr(cfg.tls, k, v)
         else:
             raise ValueError(f"unknown agent config key {key!r}")
     return cfg

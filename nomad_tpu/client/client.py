@@ -65,7 +65,12 @@ class Client:
             # In-process short-circuit (config.go:44-46 RPCHandler)
             self.endpoint = InProcessEndpoint(config.rpc_handler)
         elif config.servers:
-            self.endpoint = RemoteEndpoint(config.servers)
+            tls = getattr(config, "tls", None)
+            self.endpoint = RemoteEndpoint(
+                config.servers,
+                ssl_context=(tls.outgoing_context()
+                             if tls is not None else None),
+            )
         else:
             raise ValueError(
                 "client requires an rpc_handler (in-process server) or a "

@@ -28,6 +28,10 @@ class ClientConfig:
     # a Server instance in single-process mode.
     rpc_handler: object = None
     heartbeat_grace: float = 0.5
+    # TLS for the client->server RPC path (nomad_tpu.tlsutil.TLSConfig or
+    # None): must match the servers' tls block or every RPC handshake
+    # fails against their TLS listeners.
+    tls: object = None
 
     def read(self, key: str) -> Optional[str]:
         return self.options.get(key)

@@ -86,14 +86,15 @@ class RemoteEndpoint:
     """Network RPC to a server list with rotation on failure
     (client.go:226-253; pool: nomad/pool.go)."""
 
-    def __init__(self, servers: List[str], timeout: float = 5.0):
+    def __init__(self, servers: List[str], timeout: float = 5.0,
+                 ssl_context=None):
         if not servers:
             raise ValueError("RemoteEndpoint requires at least one server addr")
         self.servers = list(servers)
         random.shuffle(self.servers)
         # One stream-multiplexed connection per server: blocking queries
         # interleave with control traffic on the same conn (nomad_tpu/rpc.py).
-        self.pool = ConnPool(timeout=timeout)
+        self.pool = ConnPool(timeout=timeout, ssl_context=ssl_context)
 
     def shutdown(self) -> None:
         self.pool.shutdown()

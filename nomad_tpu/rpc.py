@@ -173,9 +173,13 @@ class RPCServer:
     methods instead of a separate stream)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 ssl_context=None):
         self.logger = logger or logging.getLogger("nomad_tpu.rpc")
         self._handlers: Dict[str, Callable[[dict], Any]] = {}
+        # Optional TLS arm (reference nomad/rpc.go:104-110 rpcTLS): the
+        # context wraps each accepted conn; the mux above is unchanged.
+        self._ssl_context = ssl_context
         self._listener = socket.create_server((host, port))
         self.addr = "{}:{}".format(*self._listener.getsockname())
         self._shutdown = threading.Event()
@@ -217,11 +221,22 @@ class RPCServer:
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        with self._conns_lock:
-            self._conns.add(conn)
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             _set_send_timeout(conn, SEND_TIMEOUT)
+            if self._ssl_context is not None:
+                # Bound the handshake: a half-open probe must not pin
+                # this thread forever.
+                conn.settimeout(SEND_TIMEOUT)
+                conn = self._ssl_context.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
+        except (ConnectionError, OSError, ValueError) as e:
+            self.logger.debug("rpc: TLS handshake failed: %s", e)
+            _hard_close(conn)
+            return
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
             serve_frames(conn, self._dispatch, self._shutdown, self.logger)
         except (ConnectionError, OSError, ValueError):
             pass
@@ -312,8 +327,11 @@ class ConnPool:
     nomad/pool.go:138-371 + yamux). One connection per address carries all
     concurrent requests — long-polls and control traffic interleave."""
 
-    def __init__(self, timeout: float = 10.0):
+    def __init__(self, timeout: float = 10.0, ssl_context=None):
         self.timeout = timeout
+        # Optional TLS: wraps each pooled conn at dial; with
+        # check_hostname the context verifies the host part of the addr.
+        self._ssl_context = ssl_context
         self._lock = threading.Lock()
         self._conns: Dict[str, _MuxConn] = {}
         self._seq = 0
@@ -358,7 +376,12 @@ class ConnPool:
         host, port = addr.rsplit(":", 1)
         try:
             sock = socket.create_connection((host, int(port)), timeout=self.timeout)
-        except OSError as e:
+            if self._ssl_context is not None:
+                sock = self._ssl_context.wrap_socket(
+                    sock, server_hostname=host
+                )
+        except (OSError, ValueError) as e:
+            # A failed TLS handshake never dispatched anything either.
             raise RPCUndeliveredError(
                 f"failed to connect to {addr}: {e}"
             ) from e

@@ -47,6 +47,18 @@ from nomad_tpu.rpc import (
 )
 
 
+def _auth_proof(token: str, nonce: str, infrastructure: str) -> str:
+    """HMAC-SHA256 over the broker's fresh nonce + the infrastructure
+    name, keyed by the shared token. Binding the infrastructure stops a
+    proof observed for one infra being spliced onto a handshake for
+    another; the fresh nonce stops replay outright."""
+    import hashlib
+
+    return hmac.new(
+        token.encode(), f"{nonce}:{infrastructure}".encode(), hashlib.sha256
+    ).hexdigest()
+
+
 def _split_endpoint(endpoint: str) -> tuple:
     """host:port split tolerating bracketed IPv6 ([::1]:7545).
     Raises ValueError on portless, non-numeric-port, or bare-IPv6
@@ -97,11 +109,17 @@ class UplinkProvider:
 
     def __init__(self, endpoint: str, infrastructure: str, token: str,
                  http_addr: str, meta: Optional[Dict[str, str]] = None,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 tls_context=None):
         self.endpoint = endpoint
         _split_endpoint(endpoint)  # fail fast on a malformed endpoint
         self.infrastructure = infrastructure
         self.token = token
+        # Optional ssl.SSLContext for the dialed tunnel (the reference
+        # SCADA client dialed its broker over TLS). Auth never depends on
+        # it: the token itself stays off the wire either way (see
+        # _session's challenge-response).
+        self.tls_context = tls_context
         # http_addr is "host:port" of the agent's own HTTP listener.
         self.http_addr = http_addr
         self.meta = dict(meta or {})
@@ -158,6 +176,10 @@ class UplinkProvider:
     def _session(self) -> None:
         host, port = _split_endpoint(self.endpoint)
         sock = socket.create_connection((host, port), timeout=HANDSHAKE_TIMEOUT)
+        if self.tls_context is not None:
+            sock = self.tls_context.wrap_socket(
+                sock, server_hostname=host.strip("[]")
+            )
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Kernel send timeout: a broker that stops reading must not wedge
         # handler threads in sendall under the write lock (same discipline
@@ -170,14 +192,30 @@ class UplinkProvider:
                 return
             self._sock = sock
         try:
+            # Challenge-response handshake: the shared token NEVER crosses
+            # the wire (an on-path observer of a plaintext tunnel learns
+            # nothing replayable — the proof binds a fresh broker nonce +
+            # the infrastructure name).
             _send_frame(sock, {
                 "seq": 0, "method": "handshake", "args": {
                     "service": "nomad-tpu",
                     "version": __version__,
                     "infrastructure": self.infrastructure,
-                    "token": self.token,
+                    "auth": "hmac-v1",
                     "capabilities": {"http": 1},
                     "meta": self.meta,
+                },
+            })
+            resp = _recv_frame(sock)
+            if resp.get("error"):
+                raise _AuthError(resp["error"])
+            nonce = str((resp.get("result") or {}).get("nonce", ""))
+            if not nonce:
+                raise _AuthError("broker sent no auth challenge")
+            _send_frame(sock, {
+                "seq": 1, "method": "auth", "args": {
+                    "proof": _auth_proof(self.token, nonce,
+                                         self.infrastructure),
                 },
             })
             resp = _recv_frame(sock)
@@ -298,9 +336,11 @@ class UplinkBroker:
     requests through any connected session."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 token: str = "", logger: Optional[logging.Logger] = None):
+                 token: str = "", logger: Optional[logging.Logger] = None,
+                 ssl_context=None):
         self.token = token
         self.logger = logger or logging.getLogger("nomad_tpu.scada.broker")
+        self._ssl_context = ssl_context
         self._listener = socket.create_server((host, port))
         self.addr = "{}:{}".format(*self._listener.getsockname())
         self._shutdown = threading.Event()
@@ -348,6 +388,8 @@ class UplinkBroker:
         accepted = False
         try:
             conn.settimeout(HANDSHAKE_TIMEOUT)
+            if self._ssl_context is not None:
+                conn = self._ssl_context.wrap_socket(conn, server_side=True)
             _set_send_timeout(conn, SEND_TIMEOUT)
             _enable_keepalive(conn)
             hello = _recv_frame(conn)
@@ -361,14 +403,35 @@ class UplinkBroker:
                                    "error": "handshake required",
                                    "result": None})
                 return
-            if self.token and not hmac.compare_digest(
-                str(args.get("token", "")), self.token
-            ):
+            # Challenge-response: a fresh nonce per session; the provider
+            # proves token possession without ever sending it. Legacy
+            # raw-token handshakes are refused — the secret must not be
+            # coaxed onto the wire by a spoofed broker.
+            if "token" in args:
                 _send_frame(conn, {"seq": hello.get("seq"),
+                                   "error": "raw-token handshake refused; "
+                                            "use hmac-v1 challenge auth",
+                                   "result": None})
+                return
+            import secrets
+
+            nonce = secrets.token_hex(16)
+            _send_frame(conn, {"seq": hello.get("seq"), "error": None,
+                               "result": {"nonce": nonce}})
+            auth = _recv_frame(conn)
+            if not isinstance(auth, dict):
+                auth = {}
+            proof = str((auth.get("args") or {}).get("proof", ""))
+            want = _auth_proof(self.token,
+                               nonce, str(args.get("infrastructure", "")))
+            if auth.get("method") != "auth" or not hmac.compare_digest(
+                proof, want
+            ):
+                _send_frame(conn, {"seq": auth.get("seq"),
                                    "error": "invalid token",
                                    "result": None})
                 return
-            _send_frame(conn, {"seq": hello.get("seq"), "error": None,
+            _send_frame(conn, {"seq": auth.get("seq"), "error": None,
                                "result": {"ok": True}})
             conn.settimeout(None)
             accepted = True
@@ -382,8 +445,8 @@ class UplinkBroker:
         finally:
             if not accepted:
                 conn.close()
-        # Never retain the shared secret: sessions() is dashboard-facing.
-        args = {k: v for k, v in args.items() if k != "token"}
+        # args can't carry the secret: raw-token hellos were refused above
+        # and the hmac proof lived in the separate auth frame.
         sess = _BrokerSession(conn, args)
         with self._lock:
             old = self._sessions.pop(sess.infrastructure, None)

@@ -78,6 +78,9 @@ class ServerConfig:
     # pay a cold XLA compile against the nack timeout (tpu/solver.py
     # warm_shapes; the worker's nack-touch loop covers the gap meanwhile).
     prewarm_shapes: bool = True
+    # Optional TLS on the RPC tier (reference nomad/rpc.go:104-110 rpcTLS
+    # + tlsutil): a nomad_tpu.tlsutil.TLSConfig; None runs plaintext.
+    tls: object = None
 
     def scheduler_factory(self, eval_type: str) -> str:
         if self.scheduler_backend == "tpu" and eval_type in (
