@@ -314,6 +314,89 @@ class Agent:
 
     # -- info for the agent HTTP endpoints -----------------------------------
 
+    def debug_enabled(self) -> bool:
+        return self.config.enable_debug
+
+    def debug_info(self, query: Optional[Dict] = None) -> Dict:
+        """Runtime introspection payload for /v1/agent/debug (the
+        pprof-analog; reference command/agent/http.go:115-119). Sections:
+        thread stacks, gc stats, tracemalloc top allocations (only when
+        tracing was started), device probe state, pallas kernel state,
+        coalescer and mirror-cache stats."""
+        import gc
+        import sys
+        import traceback
+
+        query = query or {}
+        out: Dict = {}
+
+        # Thread stacks — the goroutine-dump analog.
+        frames = sys._current_frames()
+        threads = {}
+        import threading as _threading
+
+        names = {t.ident: t.name for t in _threading.enumerate()}
+        for ident, frame in frames.items():
+            threads[names.get(ident, str(ident))] = traceback.format_stack(
+                frame
+            )[-8:]
+        out["threads"] = threads
+
+        counts = gc.get_count()
+        # The full-heap walk is expensive (multi-second on a big agent):
+        # only on an explicit truthy flag, never '?objects=false'.
+        want_objects = str(query.get("objects", "")).lower() in ("1", "true")
+        out["gc"] = {
+            "counts": list(counts),
+            "thresholds": list(gc.get_threshold()),
+            "objects": len(gc.get_objects()) if want_objects else None,
+        }
+
+        import tracemalloc
+
+        if tracemalloc.is_tracing():
+            snap = tracemalloc.take_snapshot()
+            out["tracemalloc_top"] = [
+                str(stat) for stat in snap.statistics("lineno")[:15]
+            ]
+        else:
+            out["tracemalloc_top"] = None  # start tracing to populate
+
+        try:
+            from nomad_tpu.scheduler import device_probe_status
+
+            out["device_probe"] = device_probe_status()
+        except Exception as e:
+            out["device_probe"] = {"error": str(e)}
+        try:
+            from nomad_tpu.ops.pallas_solve import _STATE, pallas_mode
+
+            # tuple() snapshots the set before iterating: scheduler
+            # threads mutate it via mark_proven with no lock.
+            out["pallas"] = {
+                "mode": pallas_mode(),
+                "failed": _STATE["failed"],
+                "proven_shapes": sorted(map(str, tuple(_STATE["proven"]))),
+            }
+        except Exception as e:
+            out["pallas"] = {"error": str(e)}
+        try:
+            from nomad_tpu.ops.coalesce import GLOBAL_SOLVER
+
+            out["coalescer"] = {
+                "dispatches": GLOBAL_SOLVER.dispatches,
+                "coalesced": GLOBAL_SOLVER.coalesced,
+            }
+        except Exception as e:
+            out["coalescer"] = {"error": str(e)}
+        try:
+            from nomad_tpu.tpu.mirror import GLOBAL_MIRROR_CACHE
+
+            out["mirror_cache"] = GLOBAL_MIRROR_CACHE.stats()
+        except Exception as e:
+            out["mirror_cache"] = {"error": str(e)}
+        return out
+
     def self_info(self) -> Dict:
         info: Dict = {
             "config": {

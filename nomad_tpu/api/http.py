@@ -77,6 +77,7 @@ class HTTPServer:
             (r"^/v1/evaluation/(?P<eval_id>[^/]+)/allocations$",
              self.eval_allocations),
             (r"^/v1/agent/self$", self.agent_self),
+            (r"^/v1/agent/debug$", self.agent_debug),
             (r"^/v1/agent/logs$", self.agent_logs),
             (r"^/v1/agent/members$", self.agent_members),
             (r"^/v1/agent/servers$", self.agent_servers),
@@ -328,6 +329,17 @@ class HTTPServer:
 
     def agent_self(self, req, query) -> Tuple[Any, Optional[int]]:
         return self.agent.self_info(), None
+
+    def agent_debug(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Runtime introspection, gated by enable_debug — the pprof-analog
+        surface (reference gates pprof handlers the same way,
+        command/agent/http.go:115-119). Thread stacks, gc and allocation
+        stats, device probe/pallas/coalescer/mirror state: the first
+        things needed when a bench or an agent wedges."""
+        if not getattr(self.agent, "debug_enabled", lambda: False)():
+            raise HTTPCodedError(404, "debug endpoints disabled "
+                                      "(set enable_debug)")
+        return self.agent.debug_info(query), None
 
     def agent_logs(self, req, query) -> Tuple[Any, Optional[int]]:
         """Tail of the agent's circular log buffer (the reference streams

@@ -342,6 +342,19 @@ class MirrorCache:
                     self._entries.popitem(last=False)
         return nodes, mirror
 
+    def stats(self) -> dict:
+        """Debug-surface snapshot: residency + hit ratio."""
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "node_buckets": sorted({
+                    m.padded for _n, m in self._entries.values()
+                }),
+            }
+
 
 # Process-wide cache shared by every TPU scheduler instance (the workers
 # all schedule against snapshots of the same FSM store).

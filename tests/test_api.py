@@ -186,3 +186,49 @@ def test_blocking_query(client, agent):
     assert not t.is_alive(), "blocking query never returned"
     assert result["index"] > start_index
     assert result["done_at"] - t0 >= 0.25  # actually blocked
+
+
+def test_agent_debug_gated_and_populated(tmp_path_factory):
+    """/v1/agent/debug: 404 without enable_debug; with it, the pprof-
+    analog payload carries thread stacks, gc stats, and the device/pallas/
+    coalescer/mirror state (ref command/agent/http.go:115-119)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    # Gated off by default
+    cfg = AgentConfig.dev()
+    cfg.data_dir = str(tmp_path_factory.mktemp("dbg-off"))
+    cfg.http_port = 0
+    cfg.scheduler_backend = "host"
+    a = Agent(cfg)
+    a.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(a.http.addr + "/v1/agent/debug",
+                                   timeout=10)
+        assert exc.value.code == 404
+    finally:
+        a.shutdown()
+
+    cfg2 = AgentConfig.dev()
+    cfg2.data_dir = str(tmp_path_factory.mktemp("dbg-on"))
+    cfg2.http_port = 0
+    cfg2.scheduler_backend = "host"
+    cfg2.enable_debug = True
+    a2 = Agent(cfg2)
+    a2.start()
+    try:
+        with urllib.request.urlopen(a2.http.addr + "/v1/agent/debug",
+                                    timeout=10) as resp:
+            out = json.loads(resp.read())
+        assert "MainThread" in out["threads"]
+        assert out["gc"]["counts"]
+        assert "mode" in out["pallas"]
+        assert "dispatches" in out["coalescer"]
+        assert out["mirror_cache"]["capacity"] > 0
+        assert "status" in out["device_probe"]
+    finally:
+        a2.shutdown()
