@@ -83,6 +83,23 @@ def _uuid_pool():
     return _UUID_POOL
 
 
+# Bulk alloc-id entropy: a per-process PCG64 seeded from the OS entropy
+# pool. os.urandom held the GIL for ~4ms per 100k ids, which STARVED the
+# coalescer dispatcher thread the id generation was supposed to overlap
+# with — the "overlap" serialized and the whole 5ms landed on the solve's
+# critical path. 128 random bits per id from an os-seeded PRNG keeps the
+# same collision math as random UUIDs (alloc ids need uniqueness, not
+# cryptographic unpredictability).
+_ID_RNG = None
+
+
+def _bulk_ids_hex(count: int) -> str:
+    global _ID_RNG
+    if _ID_RNG is None:
+        _ID_RNG = np.random.default_rng()  # seeded from os.urandom
+    return _ID_RNG.bytes(16 * count).hex()
+
+
 class _SolveInputs:
     """Device inputs for one task-group solve, assembled by TPUStack.prepare."""
 
@@ -1006,9 +1023,7 @@ class TPUGenericScheduler(GenericScheduler):
         ids_box = {}
 
         def gen_ids():
-            import os as _os
-
-            ids_box["hex"] = _os.urandom(16 * count).hex()
+            ids_box["hex"] = _bulk_ids_hex(count)
 
         counts, unplaced, size = self.stack.solve_group_counts(
             tg, count, overlap=gen_ids
