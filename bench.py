@@ -29,7 +29,7 @@ import traceback
 
 N_NODES = int(os.environ.get("NOMAD_TPU_BENCH_NODES", 10_000))
 N_TASKS = int(os.environ.get("NOMAD_TPU_BENCH_TASKS", 100_000))
-RUNS = int(os.environ.get("NOMAD_TPU_BENCH_RUNS", 5))
+RUNS = int(os.environ.get("NOMAD_TPU_BENCH_RUNS", 9))
 TARGET_PLACEMENTS_PER_SEC = N_TASKS / 0.2  # the north star: tasks in 200ms p50
 
 # A cold tunneled TPU can take minutes to answer jax.devices(); the bench
