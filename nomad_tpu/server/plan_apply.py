@@ -77,7 +77,8 @@ class _NodeTable:
     (store_uid, nodes index) — node rows are immutable between node-table
     writes, while usage is re-read from the snapshot every call."""
 
-    __slots__ = ("rows", "totals", "reserved", "dead", "scalar_only", "n")
+    __slots__ = ("rows", "totals", "reserved", "dead", "scalar_only", "n",
+                 "block_rows_cache")
 
     def __init__(self, snap):
         import numpy as np
@@ -85,6 +86,9 @@ class _NodeTable:
         nodes = snap.nodes()
         self.n = len(nodes)
         self.rows = {}
+        # id(block) -> (block, rows, counts): per-block node-run row
+        # resolution, valid for this table's lifetime (blocks are COW).
+        self.block_rows_cache = {}
         self.totals = np.zeros((self.n, 4), dtype=np.int32)
         self.reserved = np.zeros((self.n, 4), dtype=np.int64)
         self.dead = np.zeros(self.n, dtype=bool)
@@ -287,38 +291,58 @@ def _existing_block_usage(snap):
     return usage, net_nodes, blocks
 
 
+def _block_rows_cached(table, blk):
+    """(rows int64[k], counts int64[k]) for a block's live node runs,
+    resolved against ``table`` once per (table, block) pair. Blocks are
+    copy-on-write (any exclusion/update commits a NEW object,
+    state/blocks.py), so the identity key can never serve stale runs;
+    holding the block in the cache entry pins its id. Without this, every
+    plan verify re-resolved every existing block's ~10k node ids through
+    the row dict — the dominant cost of the coalesced pipeline's later
+    verifies."""
+    import numpy as np
+
+    cache = table.block_rows_cache
+    entry = cache.get(id(blk))
+    if entry is not None and entry[0] is blk:
+        return entry[1], entry[2]
+    get = table.rows.get
+    if blk.excluded:
+        pairs = list(blk.live_node_counts())
+        nids = [p[0] for p in pairs]
+        counts = np.asarray([p[1] for p in pairs], dtype=np.int64)
+    else:
+        nids = blk.node_ids
+        counts = np.asarray(blk.node_counts, dtype=np.int64)
+    rows = np.fromiter(
+        (get(nid, -1) for nid in nids), dtype=np.int64, count=len(nids)
+    )
+    cache[id(blk)] = (blk, rows, counts)
+    if len(cache) > 256:
+        cache.clear()
+    return rows, counts
+
+
 def _existing_block_usage_rows(snap, table):
     """Vectorized block usage over node-table rows: (usage[N,4] int64 or
-    None, net_rows bool[N] or None, blocks). One np.add.at per block."""
+    None, net_rows bool[N] or None, blocks). One np.add.at per block;
+    per-block row resolution cached on the table."""
     import numpy as np
 
     blocks = snap.alloc_blocks()
     usage = None
     net_rows = None
-    get = table.rows.get
     for blk in blocks:
+        rows, counts = _block_rows_cached(table, blk)
+        valid = rows >= 0
         if _block_has_net(blk):
             if net_rows is None:
                 net_rows = np.zeros(table.n, dtype=bool)
-            for nid, _cnt in blk.live_node_counts():
-                row = get(nid)
-                if row is not None:
-                    net_rows[row] = True
+            net_rows[rows[valid]] = True
             continue
         vec = np.asarray(blk.resource_vector(), dtype=np.int64)
         if usage is None:
             usage = np.zeros((table.n, 4), dtype=np.int64)
-        if blk.excluded:
-            pairs = list(blk.live_node_counts())
-            nids = [p[0] for p in pairs]
-            counts = np.asarray([p[1] for p in pairs], dtype=np.int64)
-        else:
-            nids = blk.node_ids
-            counts = np.asarray(blk.node_counts, dtype=np.int64)
-        rows = np.fromiter(
-            (get(nid, -1) for nid in nids), dtype=np.int64, count=len(nids)
-        )
-        valid = rows >= 0
         np.add.at(usage, rows[valid], vec[None, :] * counts[valid, None])
     return usage, net_rows, blocks
 
