@@ -80,6 +80,7 @@ class NodeMirror:
         self.base_mask = np.zeros(self.padded, dtype=bool)
         self.base_mask[: self.n] = True
 
+        self._id_array: Optional[np.ndarray] = None
         self._driver_mask_cache: Dict[frozenset, np.ndarray] = {}
         self._constraint_mask_cache: Dict[Tuple, np.ndarray] = {}
         # Device-resident combined eligibility masks and clean-state usage
@@ -88,6 +89,13 @@ class NodeMirror:
         # generation stays on device.
         self._device_mask_cache: Dict[Tuple, "jnp.ndarray"] = {}
         self._clean_usage_dev = None
+
+    def id_array(self) -> np.ndarray:
+        """Node ids as a numpy string array (lazy, cached): fancy-indexed
+        id extraction for placements beats a python attribute walk."""
+        if self._id_array is None:
+            self._id_array = np.array([n.id for n in self.nodes])
+        return self._id_array
 
     # -- eligibility masks -------------------------------------------------
 

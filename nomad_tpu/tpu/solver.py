@@ -1033,7 +1033,6 @@ class TPUGenericScheduler(GenericScheduler):
         placed = count - unplaced if counts is not None else 0
         if placed > 0:
             nz = np.flatnonzero(counts[: mirror.n])
-            nodes_list = mirror.nodes
             batch = AllocBatch(
                 eval_id=self.eval.id,
                 job=self.job,
@@ -1041,7 +1040,7 @@ class TPUGenericScheduler(GenericScheduler):
                 resources=size,
                 task_resources={t.name: t.resources for t in tg.tasks},
                 metrics=metrics,
-                node_ids=[nodes_list[i].id for i in nz],
+                node_ids=mirror.id_array()[nz].tolist(),
                 node_counts=counts[nz].tolist(),
                 name_idx=np.asarray(name_indices[:placed]),
                 ids_hex=ids_box["hex"][: 32 * placed],
