@@ -147,7 +147,9 @@ class CoalescingSolver:
         self._cond = threading.Condition(self._lock)
         self._pending: List[_Entry] = []
         self._thread: Optional[threading.Thread] = None
-        self._dispatching = False
+        # Count of in-flight dispatches (the daemon thread's current batch
+        # plus any inline fast-path dispatches).
+        self._active = 0
         # Observability: how many dispatches carried how many evals.
         self.dispatches = 0
         self.coalesced = 0
@@ -169,6 +171,10 @@ class CoalescingSolver:
             bw_used0, eligible, ask, bw_ask, count, penalty,
             bool(job_distinct), bool(tg_distinct),
         ))
+        # Always hand off to the dispatcher thread — an inline fast path
+        # was A/B-measured ~2ms SLOWER per eval: the handoff is what lets
+        # the caller's overlapped host work (bulk id generation) run while
+        # the dispatcher drives the device.
         with self._cond:
             self._ensure_thread()
             self._pending.append(entry)
@@ -180,24 +186,27 @@ class CoalescingSolver:
     def _run(self) -> None:
         while True:
             with self._cond:
-                self._dispatching = False
                 while not self._pending:
                     self._cond.wait()
                 batch = self._pending
                 self._pending = []
-                self._dispatching = True
-            self._dispatch(batch)
+                self._active += 1
+            try:
+                self._dispatch(batch)
+            finally:
+                with self._cond:
+                    self._active -= 1
 
     def quiesce(self, timeout: float = 5.0) -> bool:
         """Wait for the dispatcher to go idle (no queued or in-flight
-        solves). Process teardown while the daemon dispatcher thread sits
+        solves, queued or inline). Process teardown while a thread sits
         inside an XLA call aborts the interpreter (std::terminate) — clean
         shutdowns and test harnesses drain first. Returns False on
         timeout."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                if not self._pending and not self._dispatching:
+                if not self._pending and self._active == 0:
                     return True
             time.sleep(0.01)
         return False
