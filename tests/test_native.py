@@ -149,3 +149,41 @@ def test_evaluate_plan_large_uses_bulk_and_matches():
     for nid in fast.node_allocation:
         assert len(fast.node_allocation[nid]) == len(slow.node_allocation[nid])
     assert fast.refresh_index == slow.refresh_index
+
+
+def test_bulk_verify_columnar_against_empty_node_table():
+    """A large columnar plan verified against a snapshot whose nodes have
+    ALL deregistered must answer fit=False for every node (stale data ->
+    refresh), not crash indexing the empty table (the pure-columnar fast
+    path's zero-row guard)."""
+    from nomad_tpu.structs import AllocBatch
+
+    state = StateStore()
+    nodes = []
+    for i in range(4):
+        node = mock.node()
+        node.id = f"gone-{i}"
+        state.upsert_node(i + 1, node)
+        nodes.append(node)
+    job = mock.job()
+    state.upsert_job(10, job)
+
+    plan = Plan(eval_id=generate_uuid())
+    batch = AllocBatch(
+        eval_id=plan.eval_id, job=job, tg_name="web",
+        resources=Resources(cpu=10, memory_mb=16),
+        task_resources={},
+        node_ids=[n.id for n in nodes],
+        node_counts=[32, 32, 32, 32],  # past FAST_VERIFY_THRESHOLD
+        name_idx=np.arange(128),
+        ids_hex="ab" * (16 * 128),
+    )
+    plan.append_batch(batch)
+
+    # Every node deregisters AFTER the plan was built.
+    for i, n in enumerate(nodes):
+        state.delete_node(20 + i, n.id)
+
+    result = evaluate_plan(state.snapshot(), plan)
+    assert not result.alloc_batches        # nothing committable
+    assert result.refresh_index > 0        # stale-data refresh forced
