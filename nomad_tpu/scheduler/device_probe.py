@@ -37,6 +37,16 @@ from typing import Callable, Dict, List, Optional
 # returns — the kill/retry loop is what distinguishes them.
 CHILD_TIMEOUT = float(os.environ.get("NOMAD_TPU_PROBE_CHILD_TIMEOUT", "120"))
 
+# Extended leash for a child whose relay scan came back REACHABLE. An open
+# relay with a pending claim usually means the grant is queued behind
+# another tenant of the single tunneled chip — killing the child then is
+# counterproductive twice over: the claim would likely have completed, and
+# the kill can orphan a server-side grant that blocks the next child too
+# (observed 2026-07-31: relay ports open, every 120s child died at stage
+# 'claim'). A dead relay still gets the short CHILD_TIMEOUT: no stages past
+# 'relay' reachable=false means nothing is listening and waiting is wasted.
+CLAIM_TIMEOUT = float(os.environ.get("NOMAD_TPU_PROBE_CLAIM_TIMEOUT", "420"))
+
 # Candidate relay ports scanned for the reachability diagnostic when
 # PALLAS_AXON_POOL_IPS entries carry no explicit port.
 RELAY_PORTS = os.environ.get("NOMAD_TPU_RELAY_PORTS", "8080,8081,8082,8083,8087,8092")
@@ -162,9 +172,21 @@ class ProbeReport:
 
 
 def probe_once(
-    timeout: float = CHILD_TIMEOUT, env: Optional[Dict[str, str]] = None
+    timeout: float = CHILD_TIMEOUT,
+    env: Optional[Dict[str, str]] = None,
+    claim_timeout: Optional[float] = None,
 ) -> ProbeReport:
-    """Run one killable child probe and collect its staged reports."""
+    """Run one killable child probe and collect its staged reports.
+
+    ``timeout`` is the base leash. Once the child's relay scan reports
+    ``reachable=true`` the deadline extends to ``claim_timeout`` (default
+    ``CLAIM_TIMEOUT``, floored at ``timeout``): an answering relay means a
+    pending claim is plausibly queued, not wedged, and killing it may
+    orphan a server-side grant. An unreachable relay keeps the short
+    leash."""
+    if claim_timeout is None:
+        claim_timeout = CLAIM_TIMEOUT
+    claim_timeout = max(claim_timeout, timeout)
     report = ProbeReport()
     start = time.monotonic()
     try:
@@ -199,15 +221,31 @@ def probe_once(
     t_err = threading.Thread(target=read_stderr, daemon=True)
     t_out.start()
     t_err.start()
-    try:
-        report.rc = proc.wait(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        report.killed = True
-        proc.kill()
+    # Poll-wait so the deadline can move when the relay stage lands: the
+    # reader thread appends stages as the child emits them (list append is
+    # atomic under the GIL), and a reachable relay upgrades the leash from
+    # ``timeout`` to ``claim_timeout`` mid-wait.
+    effective = timeout
+    while True:
+        if any(
+            st.get("stage") == "relay" and st.get("reachable")
+            for st in list(report.stages)
+        ):
+            effective = claim_timeout
+        remaining = (start + effective) - time.monotonic()
+        if remaining <= 0:
+            report.killed = True
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+            break
         try:
-            proc.wait(timeout=5)
+            report.rc = proc.wait(timeout=min(1.0, remaining))
+            break
         except subprocess.TimeoutExpired:
-            pass
+            continue
     t_out.join(timeout=2)
     t_err.join(timeout=2)
     report.elapsed_s = time.monotonic() - start
@@ -216,7 +254,7 @@ def probe_once(
                  and report.last_stage == "ready")
     if report.killed:
         report.error = (
-            f"child killed after {timeout:.0f}s; acquisition stopped at "
+            f"child killed after {effective:.0f}s; acquisition stopped at "
             f"stage '{report.last_stage}'"
         )
     elif not report.ok:
@@ -245,7 +283,17 @@ def acquire(
     while time.monotonic() < deadline:
         attempt += 1
         remaining = deadline - time.monotonic()
-        report = probe_once(timeout=min(child_timeout, max(remaining, 5.0)))
+        # The reachable-relay leash may exceed the per-child base, but a
+        # half-up tunnel (TCP answers, grant never comes) is
+        # indistinguishable from a queued claim — cap the extension at
+        # half the remaining budget so at least two fresh children get a
+        # claim attempt before the budget dies with a single wedged one.
+        report = probe_once(
+            timeout=min(child_timeout, max(remaining, 5.0)),
+            claim_timeout=min(
+                CLAIM_TIMEOUT, max(child_timeout, remaining / 2.0, 5.0)
+            ),
+        )
         if on_attempt is not None:
             on_attempt(attempt, report)
         if report.ok:

@@ -22,6 +22,13 @@ if not _TPU_RUN:
     # regardless of JAX_PLATFORMS, so the probe child honors this explicit
     # re-pin knob.
     os.environ["NOMAD_TPU_PROBE_FORCE_CPU"] = "1"
+    # Hermetic relay target: probe children scan a known-closed port (1,
+    # tcpmux) instead of whatever live relay happens to be listening on
+    # loopback. Without this, a relay window opening mid-suite flips the
+    # reachable-relay leash extension (device_probe.CLAIM_TIMEOUT) on and
+    # changes kill-timing the wedge tests assert on. Tests that need a
+    # reachable relay open their own listener and monkeypatch this.
+    os.environ["PALLAS_AXON_POOL_IPS"] = "127.0.0.1:1"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

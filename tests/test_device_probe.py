@@ -37,6 +37,40 @@ def test_wedged_child_is_killed_and_stage_recorded():
     assert "stage 'relay'" in r.error
 
 
+def test_reachable_relay_extends_child_leash(monkeypatch):
+    # An answering relay means a pending claim is plausibly queued behind
+    # another tenant, not wedged — the child gets CLAIM_TIMEOUT, not the
+    # base leash, so a slow-but-live grant isn't killed (and the kill
+    # can't orphan a server-side grant that would block the next child).
+    srv = socket.socket()
+    try:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", f"127.0.0.1:{port}")
+        monkeypatch.setenv("NOMAD_TPU_PROBE_TEST_WEDGE", "relay:10")
+        # Base leash 5s gives child startup (spawn + env + relay scan)
+        # headroom on a loaded machine; the wedge still outlives it.
+        r = device_probe.probe_once(timeout=5, claim_timeout=60)
+        # Wedge (10s) outlives the base leash (5s); the reachable relay
+        # extends the deadline and the child runs to ready on the cpu pin.
+        assert r.ok and not r.killed and r.last_stage == "ready"
+        assert r.elapsed_s > 5
+    finally:
+        srv.close()
+
+
+def test_unreachable_relay_keeps_short_leash(monkeypatch):
+    # The extension is gated on reachability: against a closed port the
+    # same wedge dies at the base leash — a dead relay is never worth a
+    # CLAIM_TIMEOUT wait.
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1:1")
+    monkeypatch.setenv("NOMAD_TPU_PROBE_TEST_WEDGE", "relay:30")
+    r = device_probe.probe_once(timeout=2, claim_timeout=60)
+    assert not r.ok and r.killed and r.last_stage == "relay"
+    assert r.elapsed_s < 15
+
+
 def test_acquire_replaces_killed_children(monkeypatch):
     monkeypatch.setenv("NOMAD_TPU_PROBE_TEST_WEDGE", "env:60")
     attempts = []

@@ -191,8 +191,15 @@ def main() -> None:
                 if fresh_window or commit != last_capture_commit:
                     from nomad_tpu.scheduler import device_probe
 
+                    # claim_timeout chosen deliberately: we only probe
+                    # after the port scan saw listeners, so the relay
+                    # stage will report reachable and the leash extends.
+                    # Killing a queued claim at 150s is how the 07-31
+                    # window was missed — a long single claimer beats
+                    # fast kill/retry here (kills can orphan grants).
                     report = device_probe.probe_once(
                         timeout=150,
+                        claim_timeout=420,
                         env={"NOMAD_TPU_RELAY_PORTS":
                              ",".join(map(str, SCAN_PORTS))},
                     )
