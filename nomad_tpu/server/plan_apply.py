@@ -135,6 +135,20 @@ def _node_table(snap):
     return table
 
 
+class _FitMap(dict):
+    """{node_id: fit} answer map of the bulk verifier. ``all_fit=True``
+    is the whole-commit hint: every node the plan's ask touches is live,
+    port-free, and fits, so a caller whose plan has no other node sources
+    can commit whole without unioning id sets or scanning values.
+    Entries are populated either way."""
+
+    __slots__ = ("all_fit",)
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.all_fit = False
+
+
 class _AskAccum:
     """Per-node resource ask of a plan's columnar batches and update
     deltas. Holds batch references; materializes either a dense row array
@@ -381,36 +395,64 @@ def _prevaluate_nodes_bulk_rows(snap, plan: Plan, ask: _AskAccum, table):
 
     from nomad_tpu import native
 
-    out = {}
-    ids = [nid for nid, placed in plan.node_allocation.items() if placed]
-    in_alloc = plan.node_allocation
-    ids.extend(nid for nid in ask.node_ids if nid not in in_alloc)
+    out = _FitMap()
 
     block_usage, net_rows, blocks = _existing_block_usage_rows(snap, table)
     obj_nodes = snap.nodes_with_object_allocs()
-    ask_arr = ask.to_rows(table)
 
     if not plan.node_allocation and not plan.node_update and not obj_nodes:
         # Pure-columnar fast path (the fresh-registration headline): no
         # per-node object rows anywhere, so the entire verify is array
         # indexing — the python walk below costs ~0.5us/node x 10k nodes
-        # per eval, all of it avoidable here.
+        # per eval, all of it avoidable here. Row resolution happens ONCE
+        # per ask batch and serves both the ask accumulation and the fit
+        # answer (ask.to_rows would re-resolve the same ids a second
+        # time — the duplicate was ~2.5ms/eval at headline scale).
         if table.n == 0:
             # Every node deregistered since the solve: nothing fits.
-            for nid in ids:
+            for nid in ask.node_ids:
                 out[nid] = False
             return out
-        rows = np.fromiter(
-            (table.rows.get(nid, -1) for nid in ids),
-            dtype=np.int64, count=len(ids),
+        get = table.rows.get
+        ask_arr = None
+        flat_ids = []
+        row_parts = []
+        for node_ids, node_counts, vec in ask.batches:
+            b_rows = np.fromiter(
+                (get(nid, -1) for nid in node_ids),
+                dtype=np.int64, count=len(node_ids),
+            )
+            b_valid = b_rows >= 0
+            if ask_arr is None:
+                ask_arr = np.zeros((table.n, 4), dtype=np.int64)
+            counts = np.asarray(node_counts, dtype=np.int64)
+            np.add.at(
+                ask_arr, b_rows[b_valid],
+                vec[None, :] * counts[b_valid, None],
+            )
+            flat_ids.extend(node_ids)
+            row_parts.append(b_rows)
+        for nid, delta in ask.deltas.items():
+            row = get(nid, -1)
+            if row >= 0:
+                if ask_arr is None:
+                    ask_arr = np.zeros((table.n, 4), dtype=np.int64)
+                ask_arr[row] += delta
+            flat_ids.append(nid)
+            row_parts.append(np.asarray([row], dtype=np.int64))
+        rows = (
+            np.concatenate(row_parts) if row_parts
+            else np.empty(0, dtype=np.int64)
         )
+        # Duplicate ids across batches resolve to the same row and get
+        # the same (idempotent) answer — no dedup pass needed.
         valid = rows >= 0
         keep = valid.copy()
         safe_rows = np.where(valid, rows, 0)
         keep &= ~table.dead[safe_rows]
         # Unknown or dead nodes fail their fit outright.
         for i in np.flatnonzero(~keep):
-            out[ids[i]] = False
+            out[flat_ids[i]] = False
         # Nodes with port semantics take the sequential path: drop them
         # from the answer map (the caller falls through per node).
         sc = table.scalar_only[safe_rows]
@@ -428,10 +470,22 @@ def _prevaluate_nodes_bulk_rows(snap, plan: Plan, ask: _AskAccum, table):
                 np.minimum(used, 2**31 - 1).astype(np.int32),
                 table.totals[rows_arr],
             )
+            if bool(keep.all()) and bool(fit.all()):
+                # Every asked node is live, port-free, and fits. The
+                # caller can commit the plan whole without the id-set
+                # union or the all() scan; entries are still populated
+                # (cheap) so plans that ALSO carry delta-free update
+                # nodes keep riding the per-node merge with bulk answers.
+                out.all_fit = True
             kept_idx = np.flatnonzero(keep)
             for i, ok in zip(kept_idx.tolist(), fit.tolist()):
-                out[ids[i]] = ok
+                out[flat_ids[i]] = ok
         return out
+
+    ids = [nid for nid, placed in plan.node_allocation.items() if placed]
+    in_alloc = plan.node_allocation
+    ids.extend(nid for nid in ask.node_ids if nid not in in_alloc)
+    ask_arr = ask.to_rows(table)
 
     # Per-node python only where object rows force it (placement lists or
     # existing object allocs); pure columnar nodes ride the arrays.
@@ -685,6 +739,15 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
         )
 
     fits = {}
+    if (getattr(bulk_fit, "all_fit", False) and not upd_nodes
+            and not plan.node_update and not plan.node_allocation):
+        # The verifier already proved every asked node live and fitting
+        # (and the plan has no delta-free update nodes needing their own
+        # liveness check): commit whole without materializing the
+        # per-node answer map or the id-set union at all.
+        result.alloc_batches = [b for b in plan.alloc_batches if b.n]
+        result.update_batches = [b for b in plan.update_batches if b.n]
+        return result
     node_ids = (set(plan.node_update) | set(plan.node_allocation)
                 | batch_ask.node_ids | upd_nodes)
     if (bulk_fit and len(bulk_fit) == len(node_ids)

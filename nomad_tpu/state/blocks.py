@@ -58,10 +58,12 @@ class StoredAllocBlock(AllocBatch):
             resources=batch.resources, task_resources=batch.task_resources,
             metrics=batch.metrics, node_ids=batch.node_ids,
             node_counts=batch.node_counts, name_idx=batch.name_idx,
-            ids_hex=batch.ids_hex,
+            ids_hex=batch._ids_hex or "", ids_seed=batch.ids_seed,
         )
         # Deterministic across replicas: every FSM applying this log entry
-        # derives the same block id (the first member's alloc id).
+        # derives the same block id (the first member's alloc id —
+        # alloc_id(0) expands only the seed's 16-byte prefix, so a
+        # seed-form batch stays lazy through commit).
         blk.block_id = batch.alloc_id(0) if batch.n else generate_uuid()
         blk.create_index = index
         blk.modify_index = index
@@ -198,7 +200,7 @@ class StoredAllocBlock(AllocBatch):
             task_resources=task_resources or self.task_resources,
             metrics=metrics, node_ids=self.node_ids,
             node_counts=self.node_counts, name_idx=self.name_idx,
-            ids_hex=self.ids_hex,
+            ids_hex=self._ids_hex or "", ids_seed=self.ids_seed,
         )
         blk.block_id = self.block_id
         blk.job_id = job.id if job is not None else self.job_id
@@ -219,7 +221,7 @@ class StoredAllocBlock(AllocBatch):
             resources=self.resources, task_resources=self.task_resources,
             metrics=self.metrics, node_ids=self.node_ids,
             node_counts=self.node_counts, name_idx=self.name_idx,
-            ids_hex=self.ids_hex,
+            ids_hex=self._ids_hex or "", ids_seed=self.ids_seed,
         )
         blk.block_id = self.block_id
         blk.job_id = self.job_id
@@ -234,19 +236,29 @@ class StoredAllocBlock(AllocBatch):
 
     _PICKLE_SLOTS = (
         "eval_id", "job", "tg_name", "resources", "task_resources",
-        "metrics", "node_ids", "node_counts", "name_idx", "ids_hex",
+        "metrics", "node_ids", "node_counts", "name_idx", "ids_seed",
         "block_id", "job_id", "create_index", "modify_index", "excluded",
     )
 
     def __getstate__(self):
         """Pickle the columns only: a block that has served one
         materialize() read carries an O(placements) object cache that must
-        never re-inflate a raft snapshot."""
-        return {k: getattr(self, k) for k in self._PICKLE_SLOTS}
+        never re-inflate a raft snapshot. The id column follows the same
+        rule — a seed-form block pickles its 16-byte seed and the restore
+        re-derives; only a block built from explicit hex (wire compat)
+        carries the expansion."""
+        state = {k: getattr(self, k) for k in self._PICKLE_SLOTS}
+        state["_ids_hex"] = None if self.ids_seed is not None \
+            else self._ids_hex
+        return state
 
     def __setstate__(self, state):
         for k in self._PICKLE_SLOTS:
-            setattr(self, k, state[k])
+            setattr(self, k, state.get(k))
+        # Legacy pickles carried the expanded column under "ids_hex".
+        self._ids_hex = state.get("_ids_hex", state.get("ids_hex"))
+        if self._ids_hex is None and self.ids_seed is None:
+            self._ids_hex = ""
         self._id_pos = None
         self._node_run = None
         self._materialized = None
@@ -267,7 +279,7 @@ class StoredAllocBlock(AllocBatch):
             resources=base.resources, task_resources=base.task_resources,
             metrics=base.metrics, node_ids=base.node_ids,
             node_counts=base.node_counts, name_idx=base.name_idx,
-            ids_hex=base.ids_hex,
+            ids_hex=base._ids_hex or "", ids_seed=base.ids_seed,
         )
         blk.block_id = d.get("block_id") or generate_uuid()
         blk.create_index = int(d.get("create_index", 0))

@@ -713,17 +713,26 @@ class AllocBatch:
       expansion (util.go:19-34 names ``job.tg[i]``), aligned with the
       run expansion order.
     - ``ids_hex``: 32 hex chars per placement; alloc ids are formatted
-      lazily from slices.
+      lazily from slices. The hex itself is DERIVED, not stored: a batch
+      built with ``ids_seed`` (a 128-bit int) expands the seed through a
+      deterministic SHAKE-256 stream on first read — id i is always bytes
+      [16i, 16i+16) of the stream, so every replica's FSM derives
+      identical ids from the 16-byte seed that rode the wire/log instead
+      of a multi-MB hex column. The scheduler's hot path never reads ids
+      (plan verify is columnar), so at headline scale the entropy+hex
+      cost (~4ms/100k ids) simply never happens until a client syncs.
     """
 
     __slots__ = (
         "eval_id", "job", "tg_name", "resources", "task_resources",
-        "metrics", "node_ids", "node_counts", "name_idx", "ids_hex",
+        "metrics", "node_ids", "node_counts", "name_idx", "_ids_hex",
+        "ids_seed",
     )
 
     def __init__(self, eval_id="", job=None, tg_name="", resources=None,
                  task_resources=None, metrics=None, node_ids=None,
-                 node_counts=None, name_idx=None, ids_hex=""):
+                 node_counts=None, name_idx=None, ids_hex="",
+                 ids_seed=None):
         self.eval_id = eval_id
         self.job = job
         self.tg_name = tg_name
@@ -741,14 +750,49 @@ class AllocBatch:
             None if name_idx is None
             else _np.asarray(name_idx, dtype=_np.int64)
         )
-        self.ids_hex = ids_hex
+        self.ids_seed = ids_seed
+        # Explicit hex wins (wire compat, partial-keep slices); a seed
+        # without hex stays lazy until something actually reads ids.
+        self._ids_hex = ids_hex if ids_hex or ids_seed is None else None
 
     @property
     def n(self) -> int:
         return len(self.name_idx) if self.name_idx is not None else 0
 
+    @property
+    def ids_hex(self) -> str:
+        h = self._ids_hex
+        if h is None:
+            h = self._derive_ids_hex(self.n)
+            self._ids_hex = h
+        return h
+
+    def _derive_ids_hex(self, count: int) -> str:
+        """Expand the seed into ``count`` 32-hex-char ids via SHAKE-256.
+        An XOF's output is a stream — shorter digests are prefixes of
+        longer ones — and FIPS-202 pins the stream bit-for-bit forever,
+        so replicas (and future interpreter/library versions) derive
+        identical ids from a logged seed. A PRNG would be faster but
+        numpy guarantees no cross-version stream stability, which a
+        durable id column cannot tolerate."""
+        import hashlib
+
+        seed = int(self.ids_seed).to_bytes(16, "little", signed=False)
+        return hashlib.shake_256(seed).hexdigest(16 * count)
+
+    @property
+    def ids_lazy(self) -> bool:
+        """True while the id column is still an unexpanded seed."""
+        return self._ids_hex is None
+
     def alloc_id(self, i: int) -> str:
-        h = self.ids_hex[32 * i: 32 * i + 32]
+        if self._ids_hex is None and i == 0:
+            # First-member id (the deterministic block id) without
+            # expanding the whole column: an XOF's 16-byte digest is a
+            # prefix of any longer digest from the same input.
+            h = self._derive_ids_hex(1)
+        else:
+            h = self.ids_hex[32 * i: 32 * i + 32]
         return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
 
     def resource_vector(self) -> List[int]:
@@ -839,7 +883,7 @@ class AllocBatch:
     def to_wire(self) -> dict:
         from nomad_tpu.api.codec import to_dict
 
-        return {
+        d = {
             "eval_id": self.eval_id,
             "job": to_dict(self.job),
             "tg_name": self.tg_name,
@@ -849,13 +893,20 @@ class AllocBatch:
             "node_ids": list(self.node_ids),
             "node_counts": [int(c) for c in self.node_counts],
             "name_idx": [int(i) for i in self.name_idx],
-            "ids_hex": self.ids_hex,
         }
+        if self._ids_hex is None:
+            # Still seed-form: 32 hex chars ride the wire instead of the
+            # 32·n-char expanded column; the receiver derives identically.
+            d["ids_seed"] = "{:032x}".format(self.ids_seed)
+        else:
+            d["ids_hex"] = self._ids_hex
+        return d
 
     @staticmethod
     def from_wire(d: dict) -> "AllocBatch":
         from nomad_tpu.api.codec import from_dict
 
+        seed = d.get("ids_seed")
         return AllocBatch(
             eval_id=d.get("eval_id", ""),
             job=from_dict(Job, d.get("job")),
@@ -870,6 +921,7 @@ class AllocBatch:
             node_counts=d.get("node_counts") or [],
             name_idx=d.get("name_idx") or [],
             ids_hex=d.get("ids_hex", ""),
+            ids_seed=int(seed, 16) if seed is not None else None,
         )
 
 

@@ -83,21 +83,22 @@ def _uuid_pool():
     return _UUID_POOL
 
 
-# Bulk alloc-id entropy: a per-process PCG64 seeded from the OS entropy
-# pool. os.urandom held the GIL for ~4ms per 100k ids, which STARVED the
-# coalescer dispatcher thread the id generation was supposed to overlap
-# with — the "overlap" serialized and the whole 5ms landed on the solve's
-# critical path. 128 random bits per id from an os-seeded PRNG keeps the
-# same collision math as random UUIDs (alloc ids need uniqueness, not
-# cryptographic unpredictability).
-_ID_RNG = None
+# Bulk alloc-id entropy: batches don't carry materialized ids at all —
+# AllocBatch holds a 128-bit ids_seed and derives "32 hex chars per
+# placement" through a deterministic SHAKE-256 stream only when something
+# actually READS ids (a client sync, an individual lookup). The history
+# here is instructive: os.urandom for 100k ids held the GIL ~4ms and
+# starved the coalescer dispatcher; a process PRNG moved the cost but
+# kept it (~4ms of bytes+hex per eval) somewhere on the eval's critical
+# path, overlap games notwithstanding. Seed-form deletes the cost: the
+# scheduler's columnar pipeline (solve → verify → commit) never reads an
+# id, so at headline scale the expansion simply never happens — and the
+# seed is what rides the wire and the raft log (16 bytes vs 3.2MB per
+# 100k-alloc batch), with every replica deriving identical ids.
+def _new_ids_seed() -> int:
+    import os as _os
 
-
-def _bulk_ids_hex(count: int) -> str:
-    global _ID_RNG
-    if _ID_RNG is None:
-        _ID_RNG = np.random.default_rng()  # seeded from os.urandom
-    return _ID_RNG.bytes(16 * count).hex()
+    return int.from_bytes(_os.urandom(16), "little")
 
 
 class _SolveInputs:
@@ -1011,8 +1012,9 @@ class TPUGenericScheduler(GenericScheduler):
 
     def _place_batch(self, tg: TaskGroup, name_indices: "np.ndarray") -> None:
         """Place ``len(name_indices)`` copies of a task group as one
-        AllocBatch: a single counts-solve dispatch, id hex generated during
-        the device round-trip, zero per-placement Python objects."""
+        AllocBatch: a single counts-solve dispatch, ids carried as a
+        16-byte seed (expanded only if read), zero per-placement Python
+        objects."""
         from nomad_tpu.structs import AllocBatch
 
         self.ctx.reset()
@@ -1020,14 +1022,7 @@ class TPUGenericScheduler(GenericScheduler):
         _nodes, mirror = GLOBAL_MIRROR_CACHE.get(self.state, self.job.datacenters)
         self.stack.set_mirror(mirror)
 
-        ids_box = {}
-
-        def gen_ids():
-            ids_box["hex"] = _bulk_ids_hex(count)
-
-        counts, unplaced, size = self.stack.solve_group_counts(
-            tg, count, overlap=gen_ids
-        )
+        counts, unplaced, size = self.stack.solve_group_counts(tg, count)
         metrics = self.ctx.metrics()
 
         placed = count - unplaced if counts is not None else 0
@@ -1043,7 +1038,7 @@ class TPUGenericScheduler(GenericScheduler):
                 node_ids=mirror.id_array()[nz].tolist(),
                 node_counts=counts[nz].tolist(),
                 name_idx=np.asarray(name_indices[:placed]),
-                ids_hex=ids_box["hex"][: 32 * placed],
+                ids_seed=_new_ids_seed(),
             )
             self.plan.append_batch(batch)
 
@@ -1296,8 +1291,6 @@ class TPUSystemScheduler(SystemScheduler):
 
         placed = len(node_ids)
         if placed:
-            import os as _os
-
             batch = AllocBatch(
                 eval_id=self.eval.id,
                 job=self.job,
@@ -1308,7 +1301,7 @@ class TPUSystemScheduler(SystemScheduler):
                 node_ids=node_ids,
                 node_counts=[1] * placed,
                 name_idx=np.asarray(name_idx, dtype=np.int64),
-                ids_hex=_os.urandom(16 * placed).hex(),
+                ids_seed=_new_ids_seed(),
             )
             self.plan.append_batch(batch)
         if failed:

@@ -466,3 +466,65 @@ def test_constraint_change_defeats_inplace_batch():
            if a.desired_status == "run"]
     good = {n.id for n in nodes[:5]}
     assert all(a.node_id in good for a in run), "constraint must be re-applied"
+
+
+def test_lazy_ids_seed_contract():
+    """Seed-form id column: ids derive deterministically from the 128-bit
+    seed on first read, alloc_id(0) expands only a 16-byte prefix, and
+    the seed (not the multi-MB expansion) rides the wire and pickle."""
+    from nomad_tpu.structs import AllocBatch, Resources
+
+    batch = AllocBatch(
+        eval_id="ev-lazy", tg_name="web", resources=Resources(cpu=100),
+        node_ids=["n1", "n2"], node_counts=[3, 2], name_idx=range(5),
+        ids_seed=0x0123456789ABCDEF0123456789ABCDEF,
+    )
+    assert batch.ids_lazy
+    first = batch.alloc_id(0)
+    assert batch.ids_lazy, "alloc_id(0) must not expand the column"
+
+    # Wire round-trip carries the seed; the receiver derives identically.
+    wire = batch.to_wire()
+    assert "ids_hex" not in wire and len(wire["ids_seed"]) == 32
+    back = AllocBatch.from_wire(wire)
+    assert back.ids_lazy
+
+    ids = [batch.alloc_id(i) for i in range(5)]
+    assert not batch.ids_lazy  # bulk addressing expanded + cached
+    assert ids[0] == first  # prefix property of the SHAKE-256 XOF
+    assert [back.alloc_id(i) for i in range(5)] == ids
+    assert len(set(ids)) == 5
+
+    # An expanded batch falls back to shipping hex on the wire.
+    wire2 = batch.to_wire()
+    assert wire2["ids_hex"] == batch.ids_hex
+
+
+def test_lazy_ids_survive_store_commit_and_snapshot():
+    """A seed-form batch stays lazy through commit into the block store
+    (the FSM's upsert_alloc_blocks path), pickles as its seed
+    (raft-snapshot size posture), and restores to the same ids."""
+    import pickle
+
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import AllocBatch, Resources
+
+    store = StateStore()
+    batch = AllocBatch(
+        eval_id="ev-lazy2", tg_name="web", resources=Resources(cpu=10),
+        node_ids=[f"n{i}" for i in range(50)], node_counts=[6] * 50,
+        name_idx=range(300), ids_seed=0xFEEDFACE,
+    )
+    store.upsert_alloc_blocks(10, [batch])
+    blocks = store.alloc_blocks()
+    assert blocks, "batch placement must commit columnar"
+    blk = blocks[0]
+    assert blk.ids_lazy, "commit must not expand the id column"
+    data = pickle.dumps(blk)
+    # The pickled form is seed-sized, not expansion-sized.
+    assert len(data) < 32 * blk.n
+    ids = [blk.alloc_id(i) for i in range(3)]
+    blk2 = pickle.loads(data)
+    assert blk2.ids_lazy
+    assert [blk2.alloc_id(i) for i in range(3)] == ids
+    assert blk2.block_id == blk.block_id == ids[0]
