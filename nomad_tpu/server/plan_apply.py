@@ -197,12 +197,24 @@ class _AskAccum:
         """Dense [N, 4] int64 ask over node-table rows (or None if no
         contributions); unknown node ids drop out — the bulk verifier
         already answers False for them."""
+        return self.accumulate_rows(table)[0]
+
+    def accumulate_rows(self, table):
+        """(ask_arr, flat_ids, rows): the dense [N, 4] ask PLUS the
+        per-contribution row resolution it computed on the way — node ids
+        in contribution order and their table rows (-1 for unknown),
+        aligned. The single id→row resolve serves both the accumulation
+        and any caller that needs per-node answers (the pure-columnar
+        fast path); keeping them in one method keeps the ask rules from
+        forking."""
         import numpy as np
 
         if not self.batches and not self.deltas:
-            return None
+            return None, [], np.empty(0, dtype=np.int64)
         arr = np.zeros((table.n, 4), dtype=np.int64)
         get = table.rows.get
+        flat_ids = []
+        row_parts = []
         for node_ids, node_counts, vec in self.batches:
             rows = np.fromiter(
                 (get(nid, -1) for nid in node_ids), dtype=np.int64,
@@ -211,11 +223,19 @@ class _AskAccum:
             counts = np.asarray(node_counts, dtype=np.int64)
             valid = rows >= 0
             np.add.at(arr, rows[valid], vec[None, :] * counts[valid, None])
+            flat_ids.extend(node_ids)
+            row_parts.append(rows)
         for nid, delta in self.deltas.items():
-            row = get(nid)
-            if row is not None:
+            row = get(nid, -1)
+            if row >= 0:
                 arr[row] += delta
-        return arr
+            flat_ids.append(nid)
+            row_parts.append(np.asarray([row], dtype=np.int64))
+        rows = (
+            np.concatenate(row_parts) if len(row_parts) > 1
+            else row_parts[0]
+        )
+        return arr, flat_ids, rows
 
 
 class _AllocVecCache:
@@ -413,37 +433,7 @@ def _prevaluate_nodes_bulk_rows(snap, plan: Plan, ask: _AskAccum, table):
             for nid in ask.node_ids:
                 out[nid] = False
             return out
-        get = table.rows.get
-        ask_arr = None
-        flat_ids = []
-        row_parts = []
-        for node_ids, node_counts, vec in ask.batches:
-            b_rows = np.fromiter(
-                (get(nid, -1) for nid in node_ids),
-                dtype=np.int64, count=len(node_ids),
-            )
-            b_valid = b_rows >= 0
-            if ask_arr is None:
-                ask_arr = np.zeros((table.n, 4), dtype=np.int64)
-            counts = np.asarray(node_counts, dtype=np.int64)
-            np.add.at(
-                ask_arr, b_rows[b_valid],
-                vec[None, :] * counts[b_valid, None],
-            )
-            flat_ids.extend(node_ids)
-            row_parts.append(b_rows)
-        for nid, delta in ask.deltas.items():
-            row = get(nid, -1)
-            if row >= 0:
-                if ask_arr is None:
-                    ask_arr = np.zeros((table.n, 4), dtype=np.int64)
-                ask_arr[row] += delta
-            flat_ids.append(nid)
-            row_parts.append(np.asarray([row], dtype=np.int64))
-        rows = (
-            np.concatenate(row_parts) if row_parts
-            else np.empty(0, dtype=np.int64)
-        )
+        ask_arr, flat_ids, rows = ask.accumulate_rows(table)
         # Duplicate ids across batches resolve to the same row and get
         # the same (idempotent) answer — no dedup pass needed.
         valid = rows >= 0

@@ -28,6 +28,10 @@ from nomad_tpu.scheduler.feasible import (
 )
 from nomad_tpu.structs import Constraint, Node, Resources
 
+# Sentinel distinguishing "target didn't resolve" (fails the node, any
+# operand) from a present-but-None value (a real value; '!=' may pass).
+_MISSING = object()
+
 
 def _res_vec(r: Optional[Resources]) -> np.ndarray:
     if r is None:
@@ -98,6 +102,10 @@ class NodeMirror:
         self._id_array: Optional[np.ndarray] = None
         self._driver_mask_cache: Dict[frozenset, np.ndarray] = {}
         self._constraint_mask_cache: Dict[Tuple, np.ndarray] = {}
+        # target string -> (values, ok) columns for constraint targets,
+        # resolved over all nodes once and shared by every constraint
+        # (and eval) touching that target.
+        self._target_col_cache: Dict[str, Tuple] = {}
         # Device-resident combined eligibility masks and clean-state usage
         # tensors: per-eval uploads are pure tunnel latency on remote
         # devices, so anything reusable across evals of one state
@@ -131,26 +139,92 @@ class NodeMirror:
         self._driver_mask_cache[key] = mask
         return mask
 
+    def _target_column(self, target: str) -> Tuple:
+        """Resolve one constraint target over ALL nodes, once.
+
+        Returns ``(values, ok)``: for a literal, ``(str, None)``; for a
+        node-derived target, a python list of per-node values (None where
+        the target doesn't resolve — the reference's "missing attribute
+        fails the node", feasible.go:320-351). Parsing the target string
+        happens once here instead of once per node per constraint; the
+        column is cached for the mirror's lifetime so repeat constraints
+        and repeat evals share it."""
+        col = self._target_col_cache.get(target)
+        if col is not None:
+            return col
+        nodes = self.nodes
+        if not target.startswith("$"):
+            col = (target, None)
+        elif target == "$node.id":
+            col = ([n.id for n in nodes], None)
+        elif target == "$node.datacenter":
+            col = ([n.datacenter for n in nodes], None)
+        elif target == "$node.name":
+            col = ([n.name for n in nodes], None)
+        elif target.startswith("$attr."):
+            attr = target[len("$attr."):]
+            # _MISSING (not None) marks an absent key: a present-but-None
+            # value resolves ok and flows to check_constraint, exactly
+            # like resolve_constraint_target's (value, True) — negative
+            # operands ('!=') must accept such nodes.
+            col = ([n.attributes.get(attr, _MISSING) for n in nodes], None)
+        elif target.startswith("$meta."):
+            meta = target[len("$meta."):]
+            col = ([n.meta.get(meta, _MISSING) for n in nodes], None)
+        else:
+            # Unknown target form: defer to the scalar resolver per node
+            # so this column can never silently diverge from the grammar
+            # in feasible.resolve_constraint_target — a form added there
+            # stays correct here (just unvectorized).
+            col = (
+                [
+                    v if ok else _MISSING
+                    for v, ok in (
+                        resolve_constraint_target(target, n) for n in nodes
+                    )
+                ],
+                None,
+            )
+        self._target_col_cache[target] = col
+        return col
+
     def constraint_mask(self, ctx, constraints: List[Constraint]) -> np.ndarray:
         """Vectorized ConstraintIterator (reference: feasible.go:295-317).
 
         Evaluated host-side over the node table; results are cached per
-        constraint tuple for the lifetime of the mirror.
-        """
+        constraint tuple for the lifetime of the mirror. Each side of a
+        constraint resolves to a cached per-target column, and the
+        operand is evaluated once per distinct (l, r) value pair — at
+        cluster scale an attribute has a handful of distinct values, so
+        the per-node work is a memo-dict hit, not a parse+compare."""
         key = tuple((c.l_target, c.operand, c.r_target) for c in constraints)
         cached = self._constraint_mask_cache.get(key)
         if cached is not None:
             return cached
         mask = self.base_mask.copy()
+        n = self.n
         for c in constraints:
-            for i, node in enumerate(self.nodes):
+            l_vals, _ = self._target_column(c.l_target)
+            r_vals, _ = self._target_column(c.r_target)
+            l_scalar = isinstance(l_vals, str)
+            r_scalar = isinstance(r_vals, str)
+            if l_scalar and r_scalar:
+                if not check_constraint(ctx, c.operand, l_vals, r_vals):
+                    mask[:n] = False
+                continue
+            memo: Dict[Tuple, bool] = {}
+            op = c.operand
+            for i in range(n):
                 if not mask[i]:
                     continue
-                l_val, l_ok = resolve_constraint_target(c.l_target, node)
-                r_val, r_ok = resolve_constraint_target(c.r_target, node)
-                if not l_ok or not r_ok or not check_constraint(
-                    ctx, c.operand, l_val, r_val
-                ):
+                l = l_vals if l_scalar else l_vals[i]
+                r = r_vals if r_scalar else r_vals[i]
+                ok = memo.get((l, r))
+                if ok is None:
+                    ok = (l is not _MISSING and r is not _MISSING
+                          and check_constraint(ctx, op, l, r))
+                    memo[(l, r)] = ok
+                if not ok:
                     mask[i] = False
         self._constraint_mask_cache[key] = mask
         return mask

@@ -446,3 +446,38 @@ def test_eval_context_proposed_allocs():
     proposed = ctx.proposed_allocs(node.id)
     ids = {a.id for a in proposed}
     assert ids == {running.id, placed.id}
+
+
+def test_mirror_constraint_mask_matches_scalar_semantics():
+    """The mirror's vectorized constraint mask must agree with the
+    per-node ConstraintIterator on every resolution edge: matching and
+    non-matching values, a missing attribute (fails any operand), a
+    present-but-None meta value (a real value — '!=' accepts it), an
+    unknown target form (defers to resolve_constraint_target), and a
+    scalar-vs-scalar literal constraint."""
+    from nomad_tpu.tpu.mirror import NodeMirror
+
+    _, ctx = make_context()
+    nodes = [mock.node() for _ in range(4)]
+    nodes[0].meta["rack"] = "r1"
+    nodes[1].meta["rack"] = None    # present but null (wire JSON form)
+    nodes[2].meta.pop("rack", None)  # absent
+    nodes[3].meta["rack"] = "r9"
+
+    cases = [
+        [Constraint(l_target="$meta.rack", r_target="r1", operand="=")],
+        [Constraint(l_target="$meta.rack", r_target="r1", operand="!=")],
+        [Constraint(l_target="$attr.kernel.name", r_target="linux",
+                    operand="=")],
+        [Constraint(l_target="$bogus.form", r_target="x", operand="!=")],
+        [Constraint(l_target="lit", r_target="lit", operand="=")],
+        [Constraint(l_target="lit", r_target="other", operand="=")],
+    ]
+    for constraints in cases:
+        mirror = NodeMirror(list(nodes))
+        mask = mirror.constraint_mask(ctx, constraints)
+        static = StaticIterator(ctx, nodes)
+        it = ConstraintIterator(ctx, static, constraints)
+        expect = {n.id for n in collect_feasible(it)}
+        got = {nodes[i].id for i in range(len(nodes)) if mask[i]}
+        assert got == expect, (constraints[0], got, expect)
