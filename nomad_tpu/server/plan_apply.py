@@ -85,25 +85,33 @@ class _NodeTable:
 
         nodes = snap.nodes()
         self.n = len(nodes)
-        self.rows = {}
         # id(block) -> (block, rows, counts): per-block node-run row
         # resolution, valid for this table's lifetime (blocks are COW).
         self.block_rows_cache = {}
-        self.totals = np.zeros((self.n, 4), dtype=np.int32)
-        self.reserved = np.zeros((self.n, 4), dtype=np.int64)
-        self.dead = np.zeros(self.n, dtype=bool)
-        # reserved networks need the sequential port index: scalar path.
-        self.scalar_only = np.zeros(self.n, dtype=bool)
-        for i, node in enumerate(nodes):
-            self.rows[node.id] = i
-            if node.resources is not None:
-                self.totals[i] = node.resources.as_vector()
-            if node.status != "ready" or node.drain:
-                self.dead[i] = True
-            if node.reserved is not None:
-                self.reserved[i] = node.reserved.as_vector()
-                if node.reserved.networks:
-                    self.scalar_only[i] = True
+        self.rows = {node.id: i for i, node in enumerate(nodes)}
+        # Bulk conversions, not 50k scalar-row assignments: one
+        # list-comprehension pass per column feeds a single np.array
+        # (the same posture as NodeMirror row building).
+        zero4 = (0, 0, 0, 0)
+        if nodes:
+            self.totals = np.array(
+                [zero4 if n.resources is None else n.resources.as_vector()
+                 for n in nodes], dtype=np.int32)
+            self.reserved = np.array(
+                [zero4 if n.reserved is None else n.reserved.as_vector()
+                 for n in nodes], dtype=np.int64)
+            self.dead = np.fromiter(
+                (n.status != "ready" or bool(n.drain) for n in nodes),
+                dtype=bool, count=self.n)
+            # reserved networks need the sequential port index: scalar path.
+            self.scalar_only = np.fromiter(
+                (n.reserved is not None and bool(n.reserved.networks)
+                 for n in nodes), dtype=bool, count=self.n)
+        else:
+            self.totals = np.zeros((0, 4), dtype=np.int32)
+            self.reserved = np.zeros((0, 4), dtype=np.int64)
+            self.dead = np.zeros(0, dtype=bool)
+            self.scalar_only = np.zeros(0, dtype=bool)
 
 
 _NODE_TABLE_LOCK = threading.Lock()

@@ -123,19 +123,31 @@ class NodeMirror:
     # -- eligibility masks -------------------------------------------------
 
     def driver_mask(self, drivers: Set[str]) -> np.ndarray:
-        """Vectorized DriverIterator (reference: feasible.go:127-151)."""
+        """Vectorized DriverIterator (reference: feasible.go:127-151).
+
+        One attribute-column pass per driver (shared with constraint
+        targets via the per-target column cache), bool-parsed once per
+        distinct attribute value — not a parse per node per driver."""
         key = frozenset(drivers)
         cached = self._driver_mask_cache.get(key)
         if cached is not None:
             return cached
         mask = self.base_mask.copy()
-        for i, node in enumerate(self.nodes):
-            for driver in drivers:
-                value = node.attributes.get(f"driver.{driver}")
-                enabled = _parse_bool(value) if value is not None else None
-                if not enabled:
+        n = self.n
+        for driver in drivers:
+            vals, _ = self._target_column(f"$attr.driver.{driver}")
+            memo: Dict = {}
+            for i in range(n):
+                if not mask[i]:
+                    continue
+                v = vals[i]
+                ok = memo.get(v)
+                if ok is None:
+                    ok = v is not _MISSING and v is not None \
+                        and bool(_parse_bool(v))
+                    memo[v] = ok
+                if not ok:
                     mask[i] = False
-                    break
         self._driver_mask_cache[key] = mask
         return mask
 
