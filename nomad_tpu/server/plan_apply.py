@@ -78,7 +78,7 @@ class _NodeTable:
     writes, while usage is re-read from the snapshot every call."""
 
     __slots__ = ("rows", "totals", "reserved", "dead", "scalar_only", "n",
-                 "block_rows_cache")
+                 "block_rows_cache", "_mirror_maps")
 
     def __init__(self, snap):
         import numpy as np
@@ -88,6 +88,15 @@ class _NodeTable:
         # id(block) -> (block, rows, counts): per-block node-run row
         # resolution, valid for this table's lifetime (blocks are COW).
         self.block_rows_cache = {}
+        # id(mirror id array) -> (array, table rows aligned with it):
+        # one string resolve per (table, mirror) pair; every plan built
+        # from that mirror then resolves node runs by pure gathers.
+        # Capped: mirrors churn with datacenter-set keys while a table
+        # generation can live long, and the strong ref here is what keeps
+        # each id() key valid — unbounded it would pin every mirror ever
+        # seen (an id array is ~7MB at 50k nodes).
+        import collections
+        self._mirror_maps = collections.OrderedDict()
         self.rows = {node.id: i for i, node in enumerate(nodes)}
         # Bulk conversions, not 50k scalar-row assignments: one
         # list-comprehension pass per column feeds a single np.array
@@ -112,6 +121,28 @@ class _NodeTable:
             self.reserved = np.zeros((0, 4), dtype=np.int64)
             self.dead = np.zeros(0, dtype=bool)
             self.scalar_only = np.zeros(0, dtype=bool)
+
+    def mirror_rows(self, ids_ref) -> "np.ndarray":
+        """Table rows aligned with a solver mirror's id array (-1 for ids
+        this table doesn't know). The id array is identity-stable across
+        evals of one state generation (MirrorCache), so the per-id dict
+        walk happens once per (table, mirror) pair and every subsequent
+        plan resolves its node runs with a single fancy-index."""
+        import numpy as np
+
+        cached = self._mirror_maps.get(id(ids_ref))
+        if cached is not None and cached[0] is ids_ref:
+            self._mirror_maps.move_to_end(id(ids_ref))
+            return cached[1]
+        get = self.rows.get
+        mapped = np.fromiter(
+            (get(nid, -1) for nid in ids_ref), dtype=np.int64,
+            count=len(ids_ref),
+        )
+        self._mirror_maps[id(ids_ref)] = (ids_ref, mapped)
+        while len(self._mirror_maps) > 8:
+            self._mirror_maps.popitem(last=False)
+        return mapped
 
 
 _NODE_TABLE_LOCK = threading.Lock()
@@ -167,14 +198,18 @@ class _AskAccum:
     still fails its fit check instead of riding the evict-only shortcut."""
 
     def __init__(self):
-        self.batches = []  # (node_ids, node_counts, vec)
+        self.batches = []  # (node_ids, node_counts, vec, src)
         self.deltas = {}   # nid -> int64[4]
         self.node_ids = set()
         self._dict = None
 
-    def add_batch(self, node_ids, node_counts, vec) -> None:
+    def add_batch(self, node_ids, node_counts, vec, src=None) -> None:
+        """``src`` is the optional solver-mirror row hint carried by a
+        columnar batch: (mirror id array, row indices into it) — lets the
+        bulk verifier resolve table rows by gather instead of per-id dict
+        walks."""
         self.node_ids.update(node_ids)
-        self.batches.append((node_ids, node_counts, vec))
+        self.batches.append((node_ids, node_counts, vec, src))
         self._dict = None
 
     def add_delta(self, nid: str, delta) -> None:
@@ -189,7 +224,7 @@ class _AskAccum:
             return None
         if self._dict is None:
             acc = {}
-            for node_ids, node_counts, vec in self.batches:
+            for node_ids, node_counts, vec, _src in self.batches:
                 for run_nid, cnt in zip(node_ids, node_counts):
                     prev = acc.get(run_nid)
                     acc[run_nid] = (
@@ -223,11 +258,17 @@ class _AskAccum:
         get = table.rows.get
         flat_ids = []
         row_parts = []
-        for node_ids, node_counts, vec in self.batches:
-            rows = np.fromiter(
-                (get(nid, -1) for nid in node_ids), dtype=np.int64,
-                count=len(node_ids),
-            )
+        for node_ids, node_counts, vec, src in self.batches:
+            if src is not None:
+                # Solver-mirror hint: resolve by gather through the
+                # cached (table, mirror) row map — no per-id dict walk.
+                ids_ref, src_rows = src
+                rows = table.mirror_rows(ids_ref)[src_rows]
+            else:
+                rows = np.fromiter(
+                    (get(nid, -1) for nid in node_ids), dtype=np.int64,
+                    count=len(node_ids),
+                )
             counts = np.asarray(node_counts, dtype=np.int64)
             valid = rows >= 0
             np.add.at(arr, rows[valid], vec[None, :] * counts[valid, None])
@@ -407,6 +448,7 @@ def _prevaluate_nodes_bulk(snap, plan: Plan, ask: _AskAccum = None,
             ask.add_batch(
                 b.node_ids, b.node_counts,
                 np.asarray(b.resource_vector(), dtype=np.int64),
+                src=b.src_hint,
             )
     if table is None:
         batch_dict = {}
@@ -663,7 +705,8 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
     batch_ask = _AskAccum()
     for b in plan.alloc_batches:
         vec = np.asarray(b.resource_vector(), dtype=np.int64)
-        batch_ask.add_batch(b.node_ids, b.node_counts, vec)
+        batch_ask.add_batch(b.node_ids, b.node_counts, vec,
+                            src=b.src_hint)
 
     # In-place update batches contribute their per-node (new - old)
     # resource delta; delta-free nodes only need a liveness check. Wire-

@@ -726,7 +726,7 @@ class AllocBatch:
     __slots__ = (
         "eval_id", "job", "tg_name", "resources", "task_resources",
         "metrics", "node_ids", "node_counts", "name_idx", "_ids_hex",
-        "ids_seed",
+        "ids_seed", "src_ids_ref", "src_rows",
     )
 
     def __init__(self, eval_id="", job=None, tg_name="", resources=None,
@@ -754,10 +754,25 @@ class AllocBatch:
         # Explicit hex wins (wire compat, partial-keep slices); a seed
         # without hex stays lazy until something actually reads ids.
         self._ids_hex = ids_hex if ids_hex or ids_seed is None else None
+        # Optional solver-mirror row hint (NOT serialized): the mirror's
+        # id array plus row indices into it, aligned with node_ids. Lets
+        # the plan verifier resolve node runs as array gathers; any path
+        # that can't keep the alignment (wire, partial keep) leaves it
+        # None and the verifier falls back to id lookups.
+        self.src_ids_ref = None
+        self.src_rows = None
 
     @property
     def n(self) -> int:
         return len(self.name_idx) if self.name_idx is not None else 0
+
+    @property
+    def src_hint(self):
+        """(mirror id array, row indices) when the solver recorded where
+        this batch's node runs live in its mirror, else None."""
+        if self.src_rows is None or self.src_ids_ref is None:
+            return None
+        return (self.src_ids_ref, self.src_rows)
 
     @property
     def ids_hex(self) -> str:

@@ -1028,6 +1028,7 @@ class TPUGenericScheduler(GenericScheduler):
         placed = count - unplaced if counts is not None else 0
         if placed > 0:
             nz = np.flatnonzero(counts[: mirror.n])
+            ids_arr = mirror.id_array()
             batch = AllocBatch(
                 eval_id=self.eval.id,
                 job=self.job,
@@ -1035,11 +1036,15 @@ class TPUGenericScheduler(GenericScheduler):
                 resources=size,
                 task_resources={t.name: t.resources for t in tg.tasks},
                 metrics=metrics,
-                node_ids=mirror.id_array()[nz].tolist(),
+                node_ids=ids_arr[nz].tolist(),
                 node_counts=counts[nz].tolist(),
                 name_idx=np.asarray(name_indices[:placed]),
                 ids_seed=_new_ids_seed(),
             )
+            # Mirror-row hint: the verifier resolves these runs by gather
+            # through a cached (node table, mirror) row map.
+            batch.src_ids_ref = ids_arr
+            batch.src_rows = nz
             self.plan.append_batch(batch)
 
         if unplaced > 0 or counts is None:
@@ -1284,7 +1289,8 @@ class TPUSystemScheduler(SystemScheduler):
         return True
 
     def _emit_system_batch(self, tg, tg_constr, metrics, node_ids, name_idx,
-                           failed: int, first_failed_idx: int) -> None:
+                           failed: int, first_failed_idx: int,
+                           src_hint=None) -> None:
         """Append the columnar placement batch (+ one coalesced failed
         alloc) for a system task group."""
         from nomad_tpu.structs import AllocBatch
@@ -1303,6 +1309,8 @@ class TPUSystemScheduler(SystemScheduler):
                 name_idx=np.asarray(name_idx, dtype=np.int64),
                 ids_seed=_new_ids_seed(),
             )
+            if src_hint is not None:
+                batch.src_ids_ref, batch.src_rows = src_hint
             self.plan.append_batch(batch)
         if failed:
             failed_alloc = Allocation(
@@ -1387,7 +1395,8 @@ class TPUSystemScheduler(SystemScheduler):
             fits = fit_np[:n]
             placed_rows = np.nonzero(fits)[0]
             nodes = mirror.nodes
-            node_ids = [nodes[i].id for i in placed_rows]
+            ids_arr = mirror.id_array()
+            node_ids = ids_arr[placed_rows].tolist()
             failed_rows = np.nonzero(~fits)[0]
             # Attribute like the reference's FilterNode/exhausted split
             # (feasible.go vs rank.go): a node the eligibility mask
@@ -1402,6 +1411,7 @@ class TPUSystemScheduler(SystemScheduler):
                 tg, tg_constr, metrics, node_ids,
                 np.zeros(len(node_ids), dtype=np.int64),
                 len(failed_rows), 0,
+                src_hint=(ids_arr, placed_rows),
             )
         return True
 

@@ -528,3 +528,62 @@ def test_lazy_ids_survive_store_commit_and_snapshot():
     assert blk2.ids_lazy
     assert [blk2.alloc_id(i) for i in range(3)] == ids
     assert blk2.block_id == blk.block_id == ids[0]
+
+
+def test_src_hint_matches_id_resolution():
+    """The solver-mirror row hint (src_rows/src_ids_ref) is a pure
+    resolution shortcut: evaluate_plan must commit the identical subset
+    with the hint present and with it stripped — including when nodes
+    were deregistered or saturated between the solve and the verify, so
+    the hint's mirror rows no longer align with the node table."""
+    h = Harness()
+    nodes = _seed(h, n_nodes=6)
+    job = _big_job(count=BATCH)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("tpu-batch", _eval_for(job))
+
+    plan = h.plans[0]
+    assert plan.alloc_batches
+    batch = plan.alloc_batches[0]
+    assert batch.src_hint is not None, "solver should record mirror rows"
+
+    def strip(p):
+        import copy
+
+        p2 = copy.copy(p)
+        p2.alloc_batches = []
+        for b in p.alloc_batches:
+            b2 = copy.copy(b)
+            b2.src_ids_ref = None
+            b2.src_rows = None
+            p2.alloc_batches.append(b2)
+        return p2
+
+    def commit_shape(result):
+        return [
+            (list(b.node_ids), [int(c) for c in b.node_counts])
+            for b in result.alloc_batches
+        ]
+
+    for mutate in (
+        lambda: None,
+        # Deregister a placed-on node: its run must drop out of the
+        # committable subset identically on both paths.
+        lambda: h.state.delete_node(h.next_index(), batch.node_ids[0]),
+        # Saturate another placed-on node with a competing alloc.
+        lambda: (
+            setattr(fat := mock.alloc(), "node_id", batch.node_ids[1]),
+            setattr(fat, "resources", Resources(cpu=3950, memory_mb=100)),
+            h.state.upsert_allocs(h.next_index(), [fat]),
+        ),
+    ):
+        mutate()
+        snap = h.state.snapshot()
+        hinted = evaluate_plan(snap, plan)
+        plain = evaluate_plan(snap, strip(plan))
+        assert commit_shape(hinted) == commit_shape(plain)
+
+    # After both mutations the dropped runs are really gone.
+    final = evaluate_plan(h.state.snapshot(), plan)
+    surviving = {nid for b in final.alloc_batches for nid in b.node_ids}
+    assert batch.node_ids[0] not in surviving
