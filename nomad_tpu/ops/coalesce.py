@@ -8,14 +8,21 @@ vmapped water-fill, so K in-flight evaluations cost one device round trip
 instead of K. This is the dispatch half of the broker's coalescing dequeue
 (eval_broker.py dequeue_batch; SURVEY.md §7 "Batched evals").
 
-No artificial batching window: the dispatcher drains whatever is pending
-the moment it wakes, so an idle system pays ~zero added latency while a
-busy one coalesces naturally (submissions arriving during an in-flight
-dispatch pile up for the next one).
+No unconditional batching window: the dispatcher drains whatever is
+pending the moment it wakes, so an idle system pays ~zero added latency
+while a busy one coalesces naturally (submissions arriving during an
+in-flight dispatch pile up for the next one). The one exception is an
+ANNOUNCED burst: a batch worker that just dequeued K compatible evals
+calls hint_burst(K), and the dispatcher holds its next dispatch until
+those K solves have all arrived or a short deadline passes — without
+this, the K eval threads' staggered host prep (snapshot, masks) lands
+their submits a few ms apart and the burst fragments into several
+small dispatches instead of one stacked one.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from functools import partial
@@ -33,6 +40,22 @@ from nomad_tpu.ops.binpack import solve_waterfill
 # many entries so the power-of-two bucket set {1, 2, 4, 8} is the ENTIRE
 # steady-state compile surface (warm_batch_shapes compiles exactly these).
 MAX_BATCH_BUCKET = 8
+
+# Burst-hold tuning. The dispatcher keeps holding while announced solves
+# keep ARRIVING (progress-based): burst fill time scales with batch size
+# and node count (K eval threads' host prep contends on the GIL), so a
+# fixed window either fragments big bursts or stalls small ones. GAP is
+# the give-up threshold between consecutive arrivals; WINDOW is the hard
+# cap on total hold — the worst added latency when announced evals never
+# submit (e.g. scale-downs that need no solve).
+BURST_GAP_S = float(os.environ.get("NOMAD_TPU_COALESCE_GAP", "0.02"))
+BURST_WINDOW_S = float(os.environ.get("NOMAD_TPU_COALESCE_WINDOW", "0.25"))
+
+# Per-thread burst membership: False = this thread is an announced burst
+# member that hasn't yet accounted against the expectation (its first
+# submit or its burst_done will). Threads outside any burst never have
+# the attribute and never touch the expectation.
+_BURST_TLS = threading.local()
 
 
 def _pallas_fallback() -> None:
@@ -150,9 +173,84 @@ class CoalescingSolver:
         # Count of in-flight dispatches (the daemon thread's current batch
         # plus any inline fast-path dispatches).
         self._active = 0
+        # Announced burst: how many announced evals are still unresolved
+        # (no submit seen AND not yet reported done), the hard deadline,
+        # and the last-progress timestamp (give-up gap). Zero = never
+        # wait. Resolution is precise, not queue-depth guessing: each
+        # announced eval thread accounts exactly once — its first submit
+        # (burst-aware via _BURST_TLS) or its completion (burst_done) —
+        # so evals that never reach the coalescer (exact-path small
+        # counts, scale-downs) release the hold the moment they finish
+        # instead of taxing unrelated solves until the window expires.
+        self._burst_outstanding = 0
+        self._burst_deadline = 0.0
+        self._burst_last = 0.0
+        self._burst_gap = BURST_GAP_S
+        # Monotonic burst generation: members account only against their
+        # own burst, so stragglers from a given-up or over-announced
+        # burst can't decrement a successor's expectation and release
+        # its hold early.
+        self._burst_gen = 0
         # Observability: how many dispatches carried how many evals.
         self.dispatches = 0
         self.coalesced = 0
+
+    def hint_burst(self, n: int, window_s: float = BURST_WINDOW_S,
+                   gap_s: float = BURST_GAP_S) -> None:
+        """Announce ``n`` concurrent evals about to be processed (a batch
+        worker's dequeue_batch drain): the dispatcher holds its next
+        dispatch until every announced eval resolves (first submit or
+        burst_done), progress stalls for ``gap_s``, or ``window_s``
+        passes. Worst case for an expectation that never resolves (a
+        crashed eval thread) is the window, then it resets.
+
+        Returns a generation token to pass to burst_begin, scoping each
+        member thread's accounting to ITS burst — without it a straggler
+        from a given-up or over-announced burst would decrement a
+        successor's expectation and release that hold early."""
+        if n <= 1:
+            with self._lock:
+                return self._burst_gen
+        with self._cond:
+            now = time.monotonic()
+            if now >= self._burst_deadline:
+                # A prior burst that never resolved leaves its residue
+                # here (the dispatcher only clears it when a submit wakes
+                # it); don't stack a dead expectation onto this burst's.
+                self._burst_outstanding = 0
+            self._burst_gen += 1
+            self._burst_outstanding += n
+            self._burst_deadline = now + window_s
+            self._burst_last = now
+            self._burst_gap = gap_s
+            self._cond.notify()
+            return self._burst_gen
+
+    def burst_begin(self, token: Optional[int] = None) -> None:
+        """Mark the calling thread as an announced burst member that has
+        not yet accounted against the expectation. Call once per eval
+        thread before scheduler invocation, with the token its worker's
+        hint_burst returned (None = the current generation)."""
+        if token is None:
+            with self._lock:
+                token = self._burst_gen
+        _BURST_TLS.gen = token
+        _BURST_TLS.counted = False
+
+    def burst_done(self) -> None:
+        """The calling eval thread finished processing. If none of its
+        submits accounted it (it never reached the coalescer — exact-path
+        small count, scale-down, failed prep), resolve its slot now so
+        the hold doesn't wait for a solve that will never come."""
+        if getattr(_BURST_TLS, "counted", True):
+            return
+        _BURST_TLS.counted = True
+        with self._cond:
+            if (self._burst_outstanding > 0
+                    and getattr(_BURST_TLS, "gen", -1) == self._burst_gen):
+                self._burst_outstanding -= 1
+                self._burst_last = time.monotonic()
+                self._cond.notify()
 
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -178,6 +276,17 @@ class CoalescingSolver:
         with self._cond:
             self._ensure_thread()
             self._pending.append(entry)
+            if (self._burst_outstanding > 0
+                    and getattr(_BURST_TLS, "counted", True) is False
+                    and getattr(_BURST_TLS, "gen", -1) == self._burst_gen):
+                # First submit from a member of the CURRENT burst: its
+                # slot in the expectation is resolved, and the arrival is
+                # progress for the give-up gap. Unrelated threads and
+                # stale-generation stragglers touch neither — they can't
+                # extend the hold or release someone else's.
+                _BURST_TLS.counted = True
+                self._burst_outstanding -= 1
+                self._burst_last = time.monotonic()
             self._cond.notify()
         return entry.result
 
@@ -188,11 +297,38 @@ class CoalescingSolver:
             with self._cond:
                 while not self._pending:
                     self._cond.wait()
+                # Announced-burst hold: while announced evals are still
+                # unresolved, keep waiting as long as progress (submits,
+                # burst_done reports) keeps landing within the gap,
+                # hard-capped at the window deadline. A full dispatch
+                # chunk never waits — more pending can't improve its
+                # coalescing. Give-up clears the residual expectation so
+                # later lone evals never inherit the wait.
+                now = time.monotonic()
+                while (self._burst_outstanding > 0
+                       and len(self._pending) < MAX_BATCH_BUCKET):
+                    deadline = min(self._burst_last + self._burst_gap,
+                                   self._burst_deadline)
+                    if now >= deadline:
+                        self._burst_outstanding = 0
+                        break
+                    self._cond.wait(deadline - now)
+                    now = time.monotonic()
                 batch = self._pending
                 self._pending = []
                 self._active += 1
             try:
                 self._dispatch(batch)
+            except BaseException as exc:  # noqa: BLE001 — last-resort net
+                # _dispatch fails open per entry, so anything landing
+                # here is unexpected (a bug, MemoryError, interpreter
+                # teardown). A dead dispatcher would park every current
+                # AND future waiter forever — fail this batch's waiters
+                # and keep the loop alive instead.
+                for e in batch:
+                    if e.group is None and e.error is None:
+                        e.error = exc
+                        e.event.set()
             finally:
                 with self._cond:
                     self._active -= 1
@@ -384,6 +520,19 @@ def quiesce_all(timeout: float = 10.0) -> bool:
                 return True
         time.sleep(0.02)
     return False
+
+
+# Best-effort drain of device work before interpreter teardown for EVERY
+# embedder, not just the test conftest and bench (which call quiesce_all
+# themselves): a daemon worker still inside an XLA dispatch when CPython
+# finalizes aborts the process ("FATAL: exception not rethrown"). This
+# covers the common case — a script whose solves have completed by exit —
+# with a bounded 2s wait; an embedder exiting UNDER LOAD must stop its
+# Server first (Server.shutdown), since producers still submitting can
+# outrun any drain.
+import atexit  # noqa: E402  (intentionally after module definitions)
+
+atexit.register(quiesce_all, 2.0)
 
 
 def warm_batch_shapes(n_padded: int, buckets=(1, 2, 4, 8), stop=None) -> int:

@@ -161,24 +161,37 @@ class EvalBroker:
     def enqueue(self, ev: Evaluation, wait_index: int = 0) -> None:
         """eval_broker.go:131-155"""
         with self._lock:
-            if wait_index:
-                self._wait_index[ev.id] = max(
-                    wait_index, self._wait_index.get(ev.id, 0)
-                )
-            if ev.id in self._evals:
-                return
-            if self._enabled:
-                self._evals[ev.id] = 0
+            self._enqueue_one_locked(ev, wait_index)
 
-            if ev.wait > 0:
-                timer = threading.Timer(ev.wait, self._enqueue_waiting, args=(ev,))
-                timer.daemon = True
-                timer.start()
-                self._time_wait[ev.id] = timer
-                self.stats.total_waiting += 1
-                return
+    def enqueue_many(self, evals, wait_index: int = 0) -> None:
+        """Atomic multi-enqueue: every eval of one raft entry becomes
+        ready under a single lock hold. Without this, the first eval's
+        notify races the rest into the queue and a coalescing batch
+        dequeuer (dequeue_batch) wakes to a fragment — the burst then
+        solves as several small dispatches instead of one stacked one."""
+        with self._lock:
+            for ev in evals:
+                self._enqueue_one_locked(ev, wait_index)
 
-            self._enqueue_locked(ev, ev.type)
+    def _enqueue_one_locked(self, ev: Evaluation, wait_index: int) -> None:
+        if wait_index:
+            self._wait_index[ev.id] = max(
+                wait_index, self._wait_index.get(ev.id, 0)
+            )
+        if ev.id in self._evals:
+            return
+        if self._enabled:
+            self._evals[ev.id] = 0
+
+        if ev.wait > 0:
+            timer = threading.Timer(ev.wait, self._enqueue_waiting, args=(ev,))
+            timer.daemon = True
+            timer.start()
+            self._time_wait[ev.id] = timer
+            self.stats.total_waiting += 1
+            return
+
+        self._enqueue_locked(ev, ev.type)
 
     def _enqueue_waiting(self, ev: Evaluation) -> None:
         with self._lock:

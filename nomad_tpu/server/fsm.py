@@ -87,9 +87,12 @@ class FSM:
         # wait_index = the eval's own apply index: the worker's snapshot
         # must contain at least the write that created the eval.
         if self.eval_broker is not None and self.enqueue_guard():
-            for ev in evals:
-                if ev.should_enqueue():
-                    self.eval_broker.enqueue(ev, wait_index=index)
+            # One lock hold for the whole entry: a coalescing batch
+            # dequeuer parked on the broker wakes to the full burst, not
+            # to whichever prefix the per-eval notify race exposed.
+            pending = [ev for ev in evals if ev.should_enqueue()]
+            if pending:
+                self.eval_broker.enqueue_many(pending, wait_index=index)
 
     def _apply_eval_delete(self, index: int, payload: dict) -> None:
         self.state.delete_eval(index, payload["evals"], payload["allocs"])

@@ -74,9 +74,37 @@ class Worker(threading.Thread):
                 telemetry.add_sample(
                     ("worker", "eval_batch_size"), float(len(batch))
                 )
+                # Announce the burst so the coalescer holds its dispatch
+                # until all of these evals' solves have stacked (or a
+                # short window passes) instead of fragmenting on their
+                # staggered host prep.
+                from nomad_tpu.ops.coalesce import (
+                    MAX_BATCH_BUCKET, GLOBAL_SOLVER,
+                )
+
+                # Clamped at the dispatch chunk size: holding for more
+                # arrivals than one chunk can carry buys no coalescing.
+                burst_token = GLOBAL_SOLVER.hint_burst(
+                    min(len(batch), MAX_BATCH_BUCKET)
+                )
+
+                def process_burst_member(ev, token, wait_index):
+                    # Account this eval against ITS announced burst
+                    # exactly once: its first solve submit, or — for
+                    # evals that never reach the coalescer (exact-path
+                    # small counts, scale-downs, failed prep) — its
+                    # completion, so the hold never waits on a solve
+                    # that will never come.
+                    GLOBAL_SOLVER.burst_begin(burst_token)
+                    try:
+                        self._process(ev, token, wait_index)
+                    finally:
+                        GLOBAL_SOLVER.burst_done()
+
                 threads = [
                     threading.Thread(
-                        target=self._process, args=(ev, token, wait_index),
+                        target=process_burst_member,
+                        args=(ev, token, wait_index),
                         daemon=True, name=f"{self.name}-batch{i}",
                     )
                     for i, (ev, token, wait_index) in enumerate(batch)

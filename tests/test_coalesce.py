@@ -218,3 +218,155 @@ def test_post_proof_fault_disables_pallas(monkeypatch):
     assert not pallas_solve._STATE["failed"], (
         "jnp-path fault wrongly disabled the pallas kernel"
     )
+
+
+def test_hint_burst_holds_dispatch_for_full_burst():
+    """An announced burst stacks into ONE dispatch even when the submits
+    arrive staggered (the batch-worker posture: K eval threads' host prep
+    lands their solves a few ms apart)."""
+    import time
+
+    engine = CoalescingSolver()
+    # Warm the dispatcher thread + compile both shapes outside the burst.
+    _submit(engine, _inputs(50, 100))()
+    engine.hint_burst(4, window_s=2.0, gap_s=1.0)
+    d0 = engine.dispatches
+    inputs = [_inputs(50 + 10 * i, 100 + 17 * i) for i in range(4)]
+    fetches = []
+    for i, inp in enumerate(inputs):
+        # Each submit plays one announced eval thread (burst_begin re-arms
+        # the thread-local membership between sequential submits).
+        engine.burst_begin()
+        fetches.append(_submit(engine, inp))
+        time.sleep(0.01)  # staggered, but within the inter-arrival gap
+    results = [f() for f in fetches]
+    assert engine.dispatches == d0 + 1, "burst must land as one dispatch"
+    for inp, (counts, unplaced) in zip(inputs, results):
+        d_counts, d_unplaced = _direct(inp)
+        assert unplaced == d_unplaced
+        np.testing.assert_array_equal(counts, d_counts)
+
+
+def test_hint_burst_expires_without_full_burst():
+    """An expectation that never fills (announced evals that submit no
+    solve) costs at most the window: the partial burst dispatches at the
+    deadline and later lone submits don't inherit any wait."""
+    import time
+
+    engine = CoalescingSolver()
+    _submit(engine, _inputs(50, 100))()
+    engine.hint_burst(8, window_s=0.1)
+    t0 = time.monotonic()
+    counts, unplaced = _submit(engine, _inputs(60, 120))()
+    waited = time.monotonic() - t0
+    # At most the hard window plus solve time + margin — the documented
+    # cost ceiling of an expectation that never fills.
+    assert waited < 0.5
+    d_counts, d_unplaced = _direct(_inputs(60, 120))
+    assert unplaced == d_unplaced
+    np.testing.assert_array_equal(counts, d_counts)
+    # Residual expectation cleared: a lone submit returns promptly.
+    t0 = time.monotonic()
+    _submit(engine, _inputs(70, 130))()
+    assert time.monotonic() - t0 < 0.09
+
+
+def test_hint_burst_dead_residue_does_not_stack():
+    """A burst whose evals never submit ANY solve leaves its expectation
+    behind (the dispatcher is parked on an empty queue and can't clear
+    it); the next hint must replace the dead residue, not stack on it."""
+    import time
+
+    engine = CoalescingSolver()
+    engine.hint_burst(8, window_s=0.01)
+    time.sleep(0.03)  # deadline passes with zero submits
+    engine.hint_burst(2, window_s=1.0, gap_s=1.0)
+    with engine._lock:
+        assert engine._burst_outstanding == 2
+    d0 = engine.dispatches
+    engine.burst_begin()
+    f1 = _submit(engine, _inputs(50, 100))
+    engine.burst_begin()
+    f2 = _submit(engine, _inputs(60, 110))
+    f1(), f2()
+    assert engine.dispatches == d0 + 1
+
+
+def test_burst_done_releases_hold_without_submits():
+    """Announced evals that finish WITHOUT ever reaching the coalescer
+    (exact-path small counts, scale-downs) resolve their slots via
+    burst_done: the hold releases the moment the last one reports, not
+    at the give-up gap or window."""
+    import time
+
+    engine = CoalescingSolver()
+    _submit(engine, _inputs(50, 100))()
+    # Gap and window far beyond the assertion bound: only precise
+    # accounting can release the hold this fast.
+    engine.hint_burst(3, window_s=30.0, gap_s=30.0)
+    d0 = engine.dispatches
+    engine.burst_begin()
+    fetch = _submit(engine, _inputs(60, 120))  # member 1: real solve
+    for _ in range(2):  # members 2, 3: no solve, completion resolves
+        engine.burst_begin()
+        engine.burst_done()
+    t0 = time.monotonic()
+    counts, unplaced = fetch()
+    assert time.monotonic() - t0 < 5.0
+    assert engine.dispatches == d0 + 1
+    d_counts, d_unplaced = _direct(_inputs(60, 120))
+    assert unplaced == d_unplaced
+    np.testing.assert_array_equal(counts, d_counts)
+
+
+def test_dispatcher_survives_unexpected_batch_error(monkeypatch):
+    """A failure OUTSIDE the per-chunk fail-open (a bug in grouping, an
+    allocation failure) must fail that batch's waiters and leave the
+    dispatcher loop alive for subsequent submits — a dead dispatcher
+    parks every future eval forever."""
+    engine = CoalescingSolver()
+
+    orig = engine._dispatch
+    calls = {"n": 0}
+
+    def boom_once(batch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise MemoryError("unexpected batch-level failure")
+        return orig(batch)
+
+    monkeypatch.setattr(engine, "_dispatch", boom_once)
+    with pytest.raises(RuntimeError) as ei:
+        _submit(engine, _inputs(100, 200))()
+    assert isinstance(ei.value.__cause__, MemoryError)
+    # Loop survived: the next submit dispatches normally.
+    counts, unplaced = _submit(engine, _inputs(110, 210))()
+    d_counts, d_unplaced = _direct(_inputs(110, 210))
+    np.testing.assert_array_equal(counts, d_counts)
+    assert unplaced == d_unplaced
+
+
+def test_burst_generation_scopes_accounting():
+    """A straggler from an earlier (given-up or over-announced) burst
+    must not decrement a successor burst's expectation — member
+    accounting is scoped by the generation token hint_burst returns."""
+    import time
+
+    engine = CoalescingSolver()
+    tok_a = engine.hint_burst(2, window_s=0.01)
+    time.sleep(0.03)  # burst A's window passes unresolved
+    tok_b = engine.hint_burst(2, window_s=5.0, gap_s=5.0)
+    assert tok_b != tok_a
+    # Straggler member of burst A reports done AFTER B was announced:
+    engine.burst_begin(tok_a)
+    engine.burst_done()
+    with engine._lock:
+        assert engine._burst_outstanding == 2, (
+            "stale-generation burst_done must not release B's hold"
+        )
+    # B's own members resolve it normally.
+    for _ in range(2):
+        engine.burst_begin(tok_b)
+        engine.burst_done()
+    with engine._lock:
+        assert engine._burst_outstanding == 0

@@ -67,7 +67,11 @@ def test_unreachable_relay_keeps_short_leash(monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1:1")
     monkeypatch.setenv("NOMAD_TPU_PROBE_TEST_WEDGE", "relay:30")
     r = device_probe.probe_once(timeout=2, claim_timeout=60)
-    assert not r.ok and r.killed and r.last_stage == "relay"
+    # On a loaded machine the child may not even reach the relay scan
+    # before the leash fires; any pre-claim stage proves the point —
+    # the kill came at the short leash, not the extended one.
+    assert not r.ok and r.killed
+    assert r.last_stage in ("spawn", "env", "relay")
     assert r.elapsed_s < 15
 
 

@@ -471,3 +471,36 @@ def test_broker_nack_deferred_while_plan_inflight():
     assert redelivered[0] is not None and redelivered[0].id == ev.id
     # The redelivery's wait_index still carries the commit bump
     assert b.wait_index(ev.id) == 42
+
+
+def test_broker_enqueue_many_wakes_batch_dequeuer_to_full_burst():
+    """enqueue_many lands a whole burst under one lock hold: a parked
+    dequeue_batch caller must see every eval of the burst in ONE batch,
+    never a fragment (per-eval enqueue notifies racing the dequeuer can
+    split an 8-eval burst into several small coalesced dispatches)."""
+    import threading
+
+    b = EvalBroker(5.0, 3)
+    b.set_enabled(True)
+    got = []
+    ready = threading.Event()
+
+    def park():
+        ready.set()
+        got.append(b.dequeue_batch(["service"], max_batch=8, timeout=5.0))
+
+    t = threading.Thread(target=park)
+    t.start()
+    ready.wait(2.0)
+    import time as _t
+    _t.sleep(0.05)  # let the dequeuer actually park on the condition
+    evs = [_eval() for _ in range(8)]
+    b.enqueue_many(evs, wait_index=7)
+    t.join(5.0)
+    assert not t.is_alive()
+    assert len(got) == 1 and len(got[0]) == 8
+    assert {ev.id for ev, _ in got[0]} == {ev.id for ev in evs}
+    # wait_index recorded for every member of the burst
+    assert all(b.wait_index(ev.id) == 7 for ev in evs)
+    for ev, token in got[0]:
+        b.ack(ev.id, token)
