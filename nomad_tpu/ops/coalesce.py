@@ -48,7 +48,10 @@ MAX_BATCH_BUCKET = 8
 # the give-up threshold between consecutive arrivals; WINDOW is the hard
 # cap on total hold — the worst added latency when announced evals never
 # submit (e.g. scale-downs that need no solve).
-BURST_GAP_S = float(os.environ.get("NOMAD_TPU_COALESCE_GAP", "0.02"))
+# 50ms: must ride out a GC pause or GIL-contention stall in the middle
+# of K eval threads' host prep at 10k+ nodes; precise member accounting
+# (burst_done) keeps the give-up path rare, so the gap mostly never pays.
+BURST_GAP_S = float(os.environ.get("NOMAD_TPU_COALESCE_GAP", "0.05"))
 BURST_WINDOW_S = float(os.environ.get("NOMAD_TPU_COALESCE_WINDOW", "0.25"))
 
 # Per-thread burst membership: False = this thread is an announced burst
