@@ -576,3 +576,118 @@ def test_concurrent_coalesced_race_no_overcommit(seed):
         assert total_live > 0
     finally:
         srv.shutdown()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_burst_mix_matches_serial(seed):
+    """Differential for the announced-burst machinery (enqueue_many +
+    hint_burst + generation-scoped accounting): a random mix of jobs —
+    columnar-scale counts, exact-path small counts, and system jobs —
+    lands once as ONE broker burst and once serially. Both modes must
+    complete every eval, place every asked task (total ask fits), and
+    leave every node within capacity; burst members that never reach the
+    coalescer (exact path) must resolve the hold, not stall it."""
+    import time as _time
+
+    from nomad_tpu.server import Server, ServerConfig
+
+    rng = np.random.default_rng(70_000 + seed)
+    n_nodes = 16
+    asks = []
+    for _ in range(int(rng.integers(3, 7))):
+        kind = rng.choice(["columnar", "exact", "system"])
+        if kind == "columnar":
+            count = int(rng.integers(129, 400))
+        elif kind == "exact":
+            count = int(rng.integers(1, 129))
+        else:
+            count = None  # one per node
+        asks.append((kind, count))
+    # Small per-task ask so the whole mix always fits: worst case
+    # 6*399 tasks * 10cpu = 23940 <= 16 nodes * 4000 cpu.
+    expected = sum(
+        (n_nodes if kind == "system" else count) for kind, count in asks
+    )
+
+    def run_mode(batch_size):
+        srv = Server(ServerConfig(
+            scheduler_backend="tpu", num_schedulers=2,
+            eval_batch_size=batch_size, periodic_dispatch=False,
+            prewarm_shapes=False,
+        ))
+        try:
+            nodes = []
+            for i in range(n_nodes):
+                node = Node(
+                    id=f"bm-{seed}-{i}", datacenter="dc1", name=f"n{i}",
+                    attributes={"kernel.name": "linux", "driver.exec": "1"},
+                    resources=Resources(cpu=4000, memory_mb=16384,
+                                        disk_mb=100_000, iops=1000),
+                    status=structs.NODE_STATUS_READY,
+                )
+                srv.raft.apply("node_register", {"node": node})
+                nodes.append(node)
+            jobs, evals = [], []
+            for j, (kind, count) in enumerate(asks):
+                tg = TaskGroup(
+                    name="work", count=1 if kind == "system" else count,
+                    restart_policy=RestartPolicy(
+                        attempts=0, interval=600.0, delay=1.0,
+                    ),
+                    tasks=[Task(name="t", driver="exec",
+                                resources=Resources(cpu=10, memory_mb=16))],
+                )
+                job = Job(
+                    region="global", id=generate_uuid(),
+                    name=f"bm-{j}-{kind}",
+                    type=(structs.JOB_TYPE_SYSTEM if kind == "system"
+                          else structs.JOB_TYPE_BATCH),
+                    priority=50, datacenters=["dc1"], task_groups=[tg],
+                )
+                srv.raft.apply("job_register", {"job": job})
+                jobs.append(job)
+                evals.append(Evaluation(
+                    id=generate_uuid(), priority=50, type=job.type,
+                    triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+                    job_id=job.id, status=structs.EVAL_STATUS_PENDING,
+                ))
+            srv.start()
+            if batch_size > 1:
+                srv.raft.apply("eval_update", {"evals": evals})
+            else:
+                for ev in evals:
+                    srv.raft.apply("eval_update", {"evals": [ev]})
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline:
+                done = [srv.state_store.eval_by_id(e.id) for e in evals]
+                if all(d is not None and d.status not in
+                       (structs.EVAL_STATUS_PENDING,) for d in done):
+                    break
+                _time.sleep(0.02)
+            else:
+                raise AssertionError((seed, batch_size, "evals stuck"))
+            statuses = {srv.state_store.eval_by_id(e.id).status
+                        for e in evals}
+            assert statuses == {structs.EVAL_STATUS_COMPLETE}, (
+                seed, batch_size, statuses)
+            placed = {}
+            for job in jobs:
+                placed[job.name] = sum(
+                    1 for a in srv.state_store.allocs_by_job(job.id)
+                    if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+                )
+            for node in nodes:
+                live = [
+                    a for a in srv.state_store.allocs_by_node(node.id)
+                    if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+                ]
+                fit, dim, _ = structs.allocs_fit(node, live)
+                assert fit, (seed, batch_size, node.id, dim)
+            return placed
+        finally:
+            srv.shutdown()
+
+    burst = run_mode(len(asks))
+    serial = run_mode(1)
+    assert burst == serial, (seed, burst, serial)
+    assert sum(burst.values()) == expected, (seed, burst, expected)
