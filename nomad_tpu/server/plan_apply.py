@@ -78,7 +78,7 @@ class _NodeTable:
     writes, while usage is re-read from the snapshot every call."""
 
     __slots__ = ("rows", "totals", "reserved", "dead", "scalar_only", "n",
-                 "block_rows_cache", "_mirror_maps")
+                 "block_rows_cache", "_mirror_maps", "block_usage_cache")
 
     def __init__(self, snap):
         import numpy as np
@@ -88,6 +88,11 @@ class _NodeTable:
         # id(block) -> (block, rows, counts): per-block node-run row
         # resolution, valid for this table's lifetime (blocks are COW).
         self.block_rows_cache = {}
+        # (id-set, block refs, usage[N,4], net_rows) of the last
+        # _existing_block_usage_rows accumulation — extended
+        # incrementally while the block set only grows (the applier's
+        # monotonic verify sequence), recomputed on any removal.
+        self.block_usage_cache = None
         # id(mirror id array) -> (array, table rows aligned with it):
         # one string resolve per (table, mirror) pair; every plan built
         # from that mirror then resolves node runs by pure gathers.
@@ -406,15 +411,12 @@ def _block_rows_cached(table, blk):
     return rows, counts
 
 
-def _existing_block_usage_rows(snap, table):
-    """Vectorized block usage over node-table rows: (usage[N,4] int64 or
-    None, net_rows bool[N] or None, blocks). One np.add.at per block;
-    per-block row resolution cached on the table."""
+def _accumulate_block_usage(table, blocks, usage, net_rows):
+    """Fold ``blocks`` into (usage[N,4], net_rows) — one np.add.at per
+    block, per-block row resolution cached on the table. Mutates and
+    returns the passed arrays (callers own them)."""
     import numpy as np
 
-    blocks = snap.alloc_blocks()
-    usage = None
-    net_rows = None
     for blk in blocks:
         rows, counts = _block_rows_cached(table, blk)
         valid = rows >= 0
@@ -427,6 +429,41 @@ def _existing_block_usage_rows(snap, table):
         if usage is None:
             usage = np.zeros((table.n, 4), dtype=np.int64)
         np.add.at(usage, rows[valid], vec[None, :] * counts[valid, None])
+    return usage, net_rows
+
+
+def _existing_block_usage_rows(snap, table):
+    """Vectorized block usage over node-table rows: (usage[N,4] int64 or
+    None, net_rows bool[N] or None, blocks).
+
+    Incremental across the applier's verify sequence: blocks are COW
+    (any exclusion/update/removal commits NEW objects), so while the
+    snapshot's block identity-set only GROWS relative to the cached
+    accumulation, only the new blocks fold in — a burst of K commits
+    costs O(total runs) across its K verifies instead of O(K x total).
+    Any removal (shrunk or replaced block) recomputes from scratch. The
+    cache holds the block refs, pinning their ids against reuse; arrays
+    are copied before extension so results already handed to concurrent
+    readers never mutate underneath them."""
+    blocks = snap.alloc_blocks()
+    cache = table.block_usage_cache
+    cur_ids = {id(b) for b in blocks}
+    if cache is not None:
+        cached_ids, _cached_refs, usage, net_rows = cache
+        if cached_ids <= cur_ids:
+            new = [b for b in blocks if id(b) not in cached_ids]
+            if not new:
+                return usage, net_rows, blocks
+            usage = None if usage is None else usage.copy()
+            net_rows = None if net_rows is None else net_rows.copy()
+            usage, net_rows = _accumulate_block_usage(
+                table, new, usage, net_rows
+            )
+            table.block_usage_cache = (cur_ids, list(blocks), usage,
+                                       net_rows)
+            return usage, net_rows, blocks
+    usage, net_rows = _accumulate_block_usage(table, blocks, None, None)
+    table.block_usage_cache = (cur_ids, list(blocks), usage, net_rows)
     return usage, net_rows, blocks
 
 
