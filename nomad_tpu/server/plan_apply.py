@@ -184,7 +184,9 @@ class _FitMap(dict):
     is the whole-commit hint: every node the plan's ask touches is live,
     port-free, and fits, so a caller whose plan has no other node sources
     can commit whole without unioning id sets or scanning values.
-    Entries are populated either way."""
+    When all_fit is set and the plan carries no update batches the
+    per-node entries are OMITTED (the whole-commit consumer never reads
+    them); otherwise entries are populated."""
 
     __slots__ = ("all_fit",)
 
@@ -205,22 +207,36 @@ class _AskAccum:
     def __init__(self):
         self.batches = []  # (node_ids, node_counts, vec, src)
         self.deltas = {}   # nid -> int64[4]
-        self.node_ids = set()
+        self._node_ids = None
         self._dict = None
+
+    @property
+    def node_ids(self):
+        """Union of all touched node ids, built on first read: the
+        whole-commit fast path (all_fit) never consults it, so a fresh
+        large placement skips the ~5k-string set build entirely."""
+        ids = self._node_ids
+        if ids is None:
+            ids = set()
+            for node_ids, _counts, _vec, _src in self.batches:
+                ids.update(node_ids)
+            ids.update(self.deltas)
+            self._node_ids = ids
+        return ids
 
     def add_batch(self, node_ids, node_counts, vec, src=None) -> None:
         """``src`` is the optional solver-mirror row hint carried by a
         columnar batch: (mirror id array, row indices into it) — lets the
         bulk verifier resolve table rows by gather instead of per-id dict
         walks."""
-        self.node_ids.update(node_ids)
         self.batches.append((node_ids, node_counts, vec, src))
+        self._node_ids = None
         self._dict = None
 
     def add_delta(self, nid: str, delta) -> None:
-        self.node_ids.add(nid)
         prev = self.deltas.get(nid)
         self.deltas[nid] = delta if prev is None else prev + delta
+        self._node_ids = None
         self._dict = None
 
     def get(self, nid: str):
@@ -474,7 +490,9 @@ def _prevaluate_nodes_bulk(snap, plan: Plan, ask: _AskAccum = None,
     per-node python only where object rows exist) + one native superset
     check. Nodes with any network asks (port collisions need the
     sequential NetworkIndex, funcs.go:73-86) stay out of the returned map
-    and fall through to evaluate_node_plan. Returns {node_id: fit}."""
+    and fall through to evaluate_node_plan. Returns {node_id: fit} — but
+    a map with all_fit=True and no update batches in the plan may carry
+    no entries at all (see _FitMap)."""
     if table is None:
         table = _node_table(snap)
     if ask is None:
@@ -550,10 +568,15 @@ def _prevaluate_nodes_bulk_rows(snap, plan: Plan, ask: _AskAccum, table):
             if bool(keep.all()) and bool(fit.all()):
                 # Every asked node is live, port-free, and fits. The
                 # caller can commit the plan whole without the id-set
-                # union or the all() scan; entries are still populated
-                # (cheap) so plans that ALSO carry delta-free update
-                # nodes keep riding the per-node merge with bulk answers.
+                # union or the all() scan.
                 out.all_fit = True
+                if not plan.update_batches:
+                    # evaluate_plan's whole-commit return never reads the
+                    # per-node entries when the plan carries no update
+                    # batches either — skip the ~5k dict stores. Plans
+                    # WITH delta-free update nodes still get populated
+                    # answers for the per-node merge.
+                    return out
             kept_idx = np.flatnonzero(keep)
             for i, ok in zip(kept_idx.tolist(), fit.tolist()):
                 out[flat_ids[i]] = ok
