@@ -62,6 +62,18 @@ def device_const(kind: str, value):
     return cached
 
 
+def _monotone_u32(score: jnp.ndarray) -> jnp.ndarray:
+    """Map float32 -> uint32 preserving total order (IEEE-754 trick:
+    flip all bits of negatives, flip only the sign bit of positives).
+    Lets kth-largest selection run as a 32-step integer binary search
+    instead of a sort. THE shared definition: ops/pallas_solve.py
+    imports this for its in-kernel selection — a change here changes
+    both paths together (the differential suite pins their equality)."""
+    bits = lax.bitcast_convert_type(score, jnp.uint32)
+    neg = bits >> 31 == 1
+    return jnp.where(neg, ~bits, bits | jnp.uint32(0x80000000))
+
+
 @partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
 def _greedy_step_state(
     total, sched_cap, used, job_count, tg_count, bw_avail, bw_used,
@@ -267,10 +279,31 @@ def solve_waterfill(
         job_distinct, tg_distinct,
     )
     candidates = fit & (cap > level)
-    n = total.shape[0]
-    order = jnp.argsort(-jnp.where(candidates, score, NEG_INF))
-    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
-    selected = candidates & (rank < remaining)
+    # Rank bisection instead of argsort (sorts are the weak op on the
+    # TPU vector unit; the pallas kernel uses the identical scheme):
+    # map scores to order-preserving uint32 keys, binary-search the
+    # remaining-th largest key in exactly 32 compare+reduce steps, then
+    # break boundary ties by ascending node index — the same selection
+    # a stable argsort(-score) produces.
+    u = jnp.where(candidates, _monotone_u32(score), jnp.uint32(0))
+
+    def kth_body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo + 1) // 2
+        ok = (candidates & (u >= mid)).sum(dtype=jnp.int32) >= remaining
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1))
+
+    # hi starts at 0xFFFFFFFE: real scores never map to the all-ones
+    # image (a positive NaN), and a full-range start would overflow
+    # (hi - lo + 1) on the first midpoint.
+    thresh, _ = lax.fori_loop(
+        0, 32, kth_body, (jnp.uint32(0), jnp.uint32(0xFFFFFFFE))
+    )
+    above = candidates & (u > thresh)
+    boundary = candidates & (u == thresh)
+    fill = remaining - above.sum(dtype=jnp.int32)
+    order = jnp.cumsum(boundary.astype(jnp.int32), axis=-1)
+    selected = (above | (boundary & (order <= fill))) & (remaining > 0)
     counts = base + selected.astype(jnp.int32)
     return counts, count - counts.sum()
 

@@ -48,14 +48,11 @@ _BIG = 2**30
 _NEG_INF = float("-inf")
 
 
-def _monotone_u32(score: jnp.ndarray) -> jnp.ndarray:
-    """Map float32 -> uint32 preserving total order (IEEE-754 trick:
-    flip all bits of negatives, flip only the sign bit of positives).
-    Lets the kth-largest search run in integer bit space, where binary
-    search terminates in exactly 32 steps."""
-    bits = jax.lax.bitcast_convert_type(score, jnp.uint32)
-    neg = bits >> 31 == 1
-    return jnp.where(neg, ~bits, bits | jnp.uint32(0x80000000))
+# Shared with the jnp water-fill's partial round: ONE definition of the
+# order-preserving float->uint32 map, so the kernel's and the fallback's
+# kth-largest selections can never drift on key semantics. (binpack has
+# no module-level import of this package, so no cycle.)
+from nomad_tpu.ops.binpack import _monotone_u32  # noqa: E402
 
 
 def _waterfill_kernel(
