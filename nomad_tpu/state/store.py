@@ -84,22 +84,45 @@ class _Watch:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._waiters: Dict[WatchItem, Set[threading.Event]] = {}
+        # Parked-waiter count per item kind ("alloc_node", "table", ...):
+        # lets bulk writers skip building per-member items for kinds
+        # nobody watches (a block commit touches thousands of nodes).
+        self._kind_counts: Dict[str, int] = {}
 
     def watch(self, items: Iterable[WatchItem], event: threading.Event) -> None:
         with self._lock:
             for item in items:
-                self._waiters.setdefault(item, set()).add(event)
+                waiters = self._waiters.setdefault(item, set())
+                if event not in waiters:
+                    waiters.add(event)
+                    self._kind_counts[item[0]] = (
+                        self._kind_counts.get(item[0], 0) + 1
+                    )
 
     def stop_watch(self, items: Iterable[WatchItem], event: threading.Event) -> None:
         with self._lock:
             for item in items:
                 waiters = self._waiters.get(item)
-                if waiters is not None:
+                if waiters is not None and event in waiters:
                     waiters.discard(event)
+                    self._kind_counts[item[0]] -= 1
                     if not waiters:
                         del self._waiters[item]
 
+    def has_waiters_for(self, kind: str) -> bool:
+        """True when any waiter is parked on an item of ``kind``.
+
+        ORDERING CONTRACT for writers using this to skip item building:
+        sample it AFTER the table mutation is visible. Then a waiter that
+        registered too late for the (skipped) notify runs its first query
+        against post-write state and doesn't need the wakeup; sampling
+        BEFORE the write would lose the wakeup of a waiter registering
+        during it."""
+        return self._kind_counts.get(kind, 0) > 0
+
     def notify(self, items: Iterable[WatchItem]) -> None:
+        if not self._waiters:
+            return
         with self._lock:
             for item in items:
                 for event in self._waiters.get(item, ()):
@@ -323,6 +346,7 @@ class StateSnapshot(_StateView):
         _upsert_allocs(self._t, index, allocs)
 
     def upsert_alloc_blocks(self, index: int, batches) -> None:
+        # Optimistic snapshot writes never notify: skip item building.
         _upsert_alloc_blocks(self._t, index, batches)
 
     def apply_update_batches(self, index: int, batches) -> None:
@@ -469,11 +493,18 @@ def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
     t.indexes["allocs"] = index
 
 
-def _apply_update_batches(t: _Tables, index: int, batches) -> List[WatchItem]:
+def _apply_update_batches(t: _Tables, index: int, batches,
+                          watch: "_Watch" = None) -> List[WatchItem]:
     """Columnar in-place updates: whole-block field swap when a batch
     covers all live members of a stored block; promotion for partial
-    coverage; row re-stamp for object allocs. Returns watch items."""
+    coverage; row re-stamp for object allocs. Returns watch items.
+    Job/eval container items always fire; per-member node/alloc items
+    (thousands per bulk update) build only when ``watch`` has waiters of
+    that kind — sampled AFTER the mutation lands (Watch.has_waiters_for
+    ordering contract)."""
     items: List[WatchItem] = [item_table("allocs")]
+    swapped_blks = []
+    stamped_rows = []
     for b in batches:
         members: Dict[str, Set[int]] = {}
         object_rows: List[Allocation] = []
@@ -517,7 +548,7 @@ def _apply_update_batches(t: _Tables, index: int, batches) -> List[WatchItem]:
                 items.append(item_alloc_job(new_blk.job_id))
                 items.append(item_alloc_eval(blk.eval_id))
                 items.append(item_alloc_eval(new_blk.eval_id))
-                items.extend(item_alloc_node(n) for n in new_blk.node_ids)
+                swapped_blks.append(new_blk)
             else:
                 for pos in positions:
                     object_rows.append(blk.materialize_pos(pos))
@@ -543,20 +574,38 @@ def _apply_update_batches(t: _Tables, index: int, batches) -> List[WatchItem]:
                 if ids is not None:
                     ids.discard(existing.id)
             _insert_alloc_row(t, new)
-            items.extend([
-                item_alloc(new.id),
-                item_alloc_job(new.job_id),
-                item_alloc_node(new.node_id),
-                item_alloc_eval(new.eval_id),
-            ])
+            stamped_rows.append(new)
     t.indexes["allocs"] = index
+    if stamped_rows:
+        # Container (job/eval) items fire unconditionally, deduped
+        # batch-wide: every row of a batch shares its eval id, and job
+        # ids collapse to one unless b.job was None.
+        items.extend(
+            item_alloc_job(j) for j in {r.job_id for r in stamped_rows}
+        )
+        items.extend(
+            item_alloc_eval(e) for e in {r.eval_id for r in stamped_rows}
+        )
+    if watch is not None:
+        if watch.has_waiters_for("alloc_node"):
+            for blk in swapped_blks:
+                items.extend(item_alloc_node(n) for n in blk.node_ids)
+            items.extend(item_alloc_node(r.node_id) for r in stamped_rows)
+        if watch.has_waiters_for("alloc"):
+            items.extend(item_alloc(r.id) for r in stamped_rows)
     return items
 
 
-def _upsert_alloc_blocks(t: _Tables, index: int, batches) -> List[WatchItem]:
+def _upsert_alloc_blocks(t: _Tables, index: int, batches,
+                         watch: "_Watch" = None) -> List[WatchItem]:
     """Commit columnar batches as stored blocks — O(runs), no object
-    expansion. Returns the watch items to notify."""
+    expansion. Returns the watch items to notify. Per-node items (a block
+    touches thousands of nodes) are built only when ``watch`` has
+    alloc_node waiters — sampled AFTER the mutation lands, so a waiter
+    registering mid-commit either gets the notify or reads post-write
+    state on its first query pass (Watch.has_waiters_for)."""
     items: List[WatchItem] = [item_table("allocs")]
+    committed = []
     for batch in batches:
         if batch.n == 0:
             continue
@@ -566,8 +615,11 @@ def _upsert_alloc_blocks(t: _Tables, index: int, batches) -> List[WatchItem]:
         t.blocks_by_eval.setdefault(blk.eval_id, set()).add(blk.block_id)
         items.append(item_alloc_job(blk.job_id))
         items.append(item_alloc_eval(blk.eval_id))
-        items.extend(item_alloc_node(nid) for nid in blk.node_ids)
+        committed.append(blk)
     t.indexes["allocs"] = index
+    if watch is not None and watch.has_waiters_for("alloc_node"):
+        for blk in committed:
+            items.extend(item_alloc_node(nid) for nid in blk.node_ids)
     return items
 
 
@@ -786,7 +838,9 @@ class StateStore(_StateView):
         """Commit columnar placement batches natively (no per-Allocation
         expansion); blocking queries on the touched nodes/job/eval fire."""
         with self._lock:
-            items = _upsert_alloc_blocks(self._t, index, batches)
+            items = _upsert_alloc_blocks(
+                self._t, index, batches, watch=self.watch,
+            )
         self.watch.notify(items)
 
     def apply_update_batches(self, index: int, batches) -> None:
@@ -797,7 +851,9 @@ class StateStore(_StateView):
         observable result is exactly the batch's materialize() expansion
         upserted row-wise."""
         with self._lock:
-            items = _apply_update_batches(self._t, index, batches)
+            items = _apply_update_batches(
+                self._t, index, batches, watch=self.watch,
+            )
         self.watch.notify(items)
 
     def update_alloc_from_client(self, index: int, alloc: Allocation) -> None:

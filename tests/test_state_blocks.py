@@ -401,3 +401,54 @@ def test_update_batch_wire_roundtrip_applies_on_replica():
     assert len(got) == 3
     assert all(a.resources.cpu == 333 and a.modify_index == 130 for a in got)
     assert len(store.alloc_blocks()) == 1
+
+
+def test_block_commit_skips_member_items_only_when_unwatched(monkeypatch):
+    """The bulk-commit fast path builds per-node watch items ONLY when an
+    alloc_node waiter is parked (pinned by counting item_alloc_node
+    constructions); a waiter registered before the commit fires, and one
+    registering late sees the state on its first check (the
+    register-then-run contract of blocking queries)."""
+    from nomad_tpu.state import store as store_mod
+
+    calls = {"n": 0}
+    real = item_alloc_node
+
+    def counting(nid):
+        calls["n"] += 1
+        return real(nid)
+
+    monkeypatch.setattr(store_mod, "item_alloc_node", counting)
+
+    store = StateStore()
+    nodes = [mock.node() for _ in range(3)]
+    for i, n in enumerate(nodes):
+        store.upsert_node(i + 1, n)
+    job = mock.job()
+    store.upsert_job(10, job)
+
+    def batch_for(seed):
+        return _mk_batch(job, [n.id for n in nodes], [1, 1, 1],
+                         eval_id=f"ev{seed}")
+
+    # No waiters: the fast path builds ZERO per-node items.
+    calls["n"] = 0
+    store.upsert_alloc_blocks(11, [batch_for(1)])
+    assert calls["n"] == 0, "unwatched commit built per-node items"
+    # State is visible to a late-registering reader regardless.
+    assert len(store.snapshot().allocs_by_node(nodes[0].id)) == 1
+
+    # A parked waiter on a node item fires on the next commit, and the
+    # per-node items were actually built.
+    fired = threading.Event()
+    store.watch.watch([real(nodes[1].id)], fired)
+    calls["n"] = 0
+    store.upsert_alloc_blocks(12, [batch_for(2)])
+    assert calls["n"] == 3, "watched commit must build per-node items"
+    assert fired.wait(2.0), "node watch did not fire on watched commit"
+
+    # stop_watch drops the kind count back to zero: fast path returns.
+    store.watch.stop_watch([real(nodes[1].id)], fired)
+    calls["n"] = 0
+    store.upsert_alloc_blocks(13, [batch_for(3)])
+    assert calls["n"] == 0, "kind counter leaked a waiter"
