@@ -745,6 +745,7 @@ class StateStore(_StateView):
         """Delete evals + allocs together, used by GC
         (reference: state_store.go DeleteEval)."""
         items: List[WatchItem] = [item_table("evals"), item_table("allocs")]
+        reaped_blocks: List[StoredAllocBlock] = []
         with self._lock:
             t = self._t
             for eval_id in eval_ids:
@@ -768,7 +769,7 @@ class StateStore(_StateView):
                             del t.blocks_by_job[blk.job_id]
                     items.append(item_alloc_job(blk.job_id))
                     items.append(item_alloc_eval(blk.eval_id))
-                    items.extend(item_alloc_node(n) for n in blk.node_ids)
+                    reaped_blocks.append(blk)
                 t.blocks_by_eval.pop(eval_id, None)
             block_members: Dict[str, Set[int]] = {}
             for alloc_id in alloc_ids:
@@ -815,6 +816,12 @@ class StateStore(_StateView):
                 _exclude_block_members(t, block_members)
             t.indexes["evals"] = index
             t.indexes["allocs"] = index
+            # Gated member items, sampled AFTER the index stamps (the
+            # has_waiters_for ordering contract): a late-registering
+            # blocking query re-checks against the stamped index.
+            if reaped_blocks and self.watch.has_waiters_for("alloc_node"):
+                for blk in reaped_blocks:
+                    items.extend(item_alloc_node(n) for n in blk.node_ids)
         self.watch.notify(items)
 
     # -- allocs -----------------------------------------------------------

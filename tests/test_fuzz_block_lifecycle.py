@@ -182,3 +182,85 @@ def test_block_vs_object_lifecycle(seed):
             if not a.terminal_status():
                 scan[a.job_id] = scan.get(a.job_id, 0) + 1
         assert scan == t.live_objs_by_job, (seed, op)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_no_lost_wakeup_under_concurrent_bulk_commits(seed):
+    """Stress the watch fast path's ordering contract: watcher threads
+    continuously run the register -> re-check -> wait loop (the
+    blocking-query pattern) against random nodes while a writer commits
+    columnar blocks. Every watcher must observe the final allocs index
+    promptly — a lost wakeup (member items skipped for a waiter that
+    registered mid-commit without post-write visibility) would strand a
+    watcher until its deadline."""
+    import threading
+    import time as _time
+
+    from nomad_tpu.state.store import item_alloc_node
+    from nomad_tpu.structs import AllocBatch, Resources, generate_uuid
+
+    def _mk_batch(job, node_ids, counts, eval_id):
+        n = sum(counts)
+        return AllocBatch(
+            eval_id=eval_id, job=job, tg_name=job.task_groups[0].name,
+            resources=Resources(cpu=1, memory_mb=1),
+            node_ids=list(node_ids), node_counts=list(counts),
+            name_idx=list(range(n)),
+            ids_hex="".join(
+                generate_uuid().replace("-", "") for _ in range(n)
+            ),
+        )
+
+    rng = np.random.default_rng(90_000 + seed)
+    store = StateStore()
+    nodes = [mock.node() for _ in range(12)]
+    for i, n in enumerate(nodes):
+        store.upsert_node(i + 1, n)
+    job = mock.job()
+    store.upsert_job(100, job)
+
+    N_COMMITS = 30
+    final_index = 100 + N_COMMITS
+    errors = []
+    observed = []
+
+    def watcher(widx):
+        # Per-thread RNG: np.random.Generator is not thread-safe, and a
+        # shared one would make seeded failures unreproducible.
+        wrng = np.random.default_rng(90_000 + seed * 100 + widx)
+        node = nodes[int(wrng.integers(0, len(nodes)))]
+        deadline = _time.monotonic() + 30.0
+        last = 0
+        while _time.monotonic() < deadline:
+            ev = threading.Event()
+            store.watch.watch([item_alloc_node(node.id)], ev)
+            try:
+                idx = store.snapshot().get_index("allocs")
+                if idx >= final_index:
+                    observed.append((widx, idx))
+                    return
+                if idx == last:
+                    # Park with a SHORT timeout: a lost wakeup shows up
+                    # as systematically timing out instead of waking.
+                    ev.wait(0.5)
+                last = idx
+            finally:
+                store.watch.stop_watch([item_alloc_node(node.id)], ev)
+        errors.append(f"watcher {widx} never saw index {final_index}")
+
+    threads = [threading.Thread(target=watcher, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for c in range(N_COMMITS):
+        k = int(rng.integers(1, len(nodes) + 1))
+        sel = rng.choice(len(nodes), size=k, replace=False)
+        batch = _mk_batch(
+            job, [nodes[i].id for i in sel], [1] * k,
+            eval_id=f"gen-{seed}-{c}",
+        )
+        store.upsert_alloc_blocks(101 + c, [batch])
+        _time.sleep(0.002)
+    for t in threads:
+        t.join(35.0)
+    assert not errors, errors
+    assert len(observed) == 6
