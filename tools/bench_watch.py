@@ -6,8 +6,8 @@ it. This watcher inverts the timing problem: it scans the relay's loopback
 ports continuously, and the moment a subprocess probe child reports the
 device claim completing, it immediately runs the full ``bench.py`` suite at
 the CURRENT commit (plus the compiled-pallas proof, when present) and
-appends the capture to ``BENCH_SELF_r04.json``. Every scan is also logged
-to ``BENCH_WATCH_r04.jsonl`` so a relay that never comes up all round is
+appends the capture to ``BENCH_SELF_r{N}.json``. Every scan is also logged
+to ``BENCH_WATCH_r{N}.jsonl`` so a relay that never comes up all round is
 provable from the log, not asserted.
 
 Runs as a detached background process for the whole session:
@@ -31,8 +31,42 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-WATCH_LOG = os.path.join(REPO, "BENCH_WATCH_r04.jsonl")
-CAPTURE_FILE = os.path.join(REPO, "BENCH_SELF_r04.json")
+def _round_tag() -> str:
+    """Current round, inferred from the bench artifacts on disk (one
+    past the max completed driver round, never behind an existing
+    self-capture tag), overridable via NOMAD_TPU_ROUND (accepts "5" or
+    "r05"). Keeps the watcher edit-free across rounds."""
+    env = os.environ.get("NOMAD_TPU_ROUND", "").lstrip("rR")
+    if env:
+        return f"r{int(env):02d}"
+    import re
+
+    # Driver files name COMPLETED rounds; self-capture/watch files name
+    # the round that produced them (a round whose driver bench never
+    # landed still leaves these). The round in progress is one past the
+    # max driver round, but never behind an existing self-capture tag.
+    driver = [
+        int(m.group(1))
+        for f in os.listdir(REPO)
+        for m in [re.match(r"BENCH_r(\d+)\.json$", f)]
+        if m
+    ]
+    selfcap = [
+        int(m.group(1))
+        for f in os.listdir(REPO)
+        for m in [re.match(r"BENCH_(?:SELF|WATCH)_r(\d+)\.", f)]
+        if m
+    ]
+    cur = max(
+        (max(driver) + 1 if driver else 1),
+        (max(selfcap) if selfcap else 1),
+    )
+    return f"r{cur:02d}"
+
+
+_TAG = _round_tag()
+WATCH_LOG = os.path.join(REPO, f"BENCH_WATCH_{_TAG}.jsonl")
+CAPTURE_FILE = os.path.join(REPO, f"BENCH_SELF_{_TAG}.json")
 SCAN_INTERVAL_S = 45.0
 # Wider than device_probe's default candidate list: relay listeners have
 # been observed anywhere in 8080..8117.
@@ -80,11 +114,12 @@ def head_commit() -> str:
 def append_capture(entry: dict) -> None:
     doc = {
         "note": (
-            "SELF-REPORTED opportunistic TPU captures from the round-4 "
+            f"SELF-REPORTED opportunistic TPU captures from the {_TAG} "
             "builder session (tools/bench_watch.py): the relay is scanned "
             "continuously and bench.py runs the moment a probe child "
-            "reports ready. BENCH_WATCH_r04.jsonl holds the full scan log; "
-            "the driver-captured BENCH_r04.json is the source of truth."
+            f"reports ready. BENCH_WATCH_{_TAG}.jsonl holds the full scan "
+            f"log; the driver-captured BENCH_{_TAG}.json is the source of "
+            "truth."
         ),
         "runs": [],
     }
