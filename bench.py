@@ -38,6 +38,11 @@ TARGET_PLACEMENTS_PER_SEC = N_TASKS / 0.2  # the north star: tasks in 200ms p50
 # mode: 15s probe timeout -> host fallback -> empty timing list -> crash).
 DEVICE_WAIT_S = float(os.environ.get("NOMAD_TPU_BENCH_DEVICE_WAIT", "600"))
 ALLOW_CPU = os.environ.get("NOMAD_TPU_BENCH_ALLOW_CPU", "") == "1"
+# Headline-only: skip the aux configs, the coalesced run and the breakdown
+# sweep. The watcher's first capture in a relay window uses this — windows
+# have historically died within minutes, so the first number banked must be
+# the cheapest one that still answers "what does the TPU do at 10k nodes".
+HEADLINE_ONLY = os.environ.get("NOMAD_TPU_BENCH_HEADLINE_ONLY", "") == "1"
 
 
 _EMITTED = threading.Event()
@@ -754,26 +759,37 @@ def main():
         solve_p50, e2e_p50, placed, nodes = _measure_headline()
         placements_per_sec = placed / solve_p50
 
-        coalesce_wall, coalesce_placed, coalesce_dispatches = run_coalesced(
-            nodes
-        )
-
-        # BASELINE configs 2 / 4 / 5 (config 1 is the unit-test scale
-        # covered by the suite; config 3 is the headline above). Failures
-        # report per-config without sinking the headline number.
         aux = {}
-        for name, fn in (("config2", run_config2), ("config4", run_config4),
-                         ("config5", run_config5)):
-            try:
-                aux[name] = fn()
-            except Exception as e:
-                aux[name] = {"error": f"{type(e).__name__}: {e}"}
+        coalesce = {}
+        if HEADLINE_ONLY:
+            aux["headline_only"] = True
+        else:
+            coalesce_wall, coalesce_placed, coalesce_dispatches = (
+                run_coalesced(nodes)
+            )
+            coalesce = {
+                "coalesced_evals": COALESCE_EVALS,
+                "coalesced_wall_ms": round(coalesce_wall * 1000, 2),
+                "coalesced_placed": coalesce_placed,
+                "coalesced_dispatches": coalesce_dispatches,
+            }
 
-        if BREAKDOWN:
-            try:
-                aux["breakdown"] = run_breakdown()
-            except Exception as e:
-                aux["breakdown"] = {"error": f"{type(e).__name__}: {e}"}
+            # BASELINE configs 2 / 4 / 5 (config 1 is the unit-test scale
+            # covered by the suite; config 3 is the headline above).
+            # Failures report per-config without sinking the headline.
+            for name, fn in (("config2", run_config2),
+                             ("config4", run_config4),
+                             ("config5", run_config5)):
+                try:
+                    aux[name] = fn()
+                except Exception as e:
+                    aux[name] = {"error": f"{type(e).__name__}: {e}"}
+
+            if BREAKDOWN:
+                try:
+                    aux["breakdown"] = run_breakdown()
+                except Exception as e:
+                    aux["breakdown"] = {"error": f"{type(e).__name__}: {e}"}
 
         emit(
             {
@@ -788,10 +804,7 @@ def main():
                 "placed": placed,
                 "n_nodes": N_NODES,
                 "n_tasks": N_TASKS,
-                "coalesced_evals": COALESCE_EVALS,
-                "coalesced_wall_ms": round(coalesce_wall * 1000, 2),
-                "coalesced_placed": coalesce_placed,
-                "coalesced_dispatches": coalesce_dispatches,
+                **coalesce,
                 "backend": backend,
                 "pallas": _pallas_outcome(),
                 **aux,

@@ -74,6 +74,20 @@ SCAN_PORTS = list(range(8080, 8121))
 BENCH_TIMEOUT_S = 2700.0  # > bench.py's own 2400s watchdog
 PROOF_TIMEOUT_S = 1500.0
 RECAPTURE_COOLDOWN_S = 30 * 60.0
+# Stage-1 fast capture: headline config only, 3 runs, no breakdown. Relay
+# windows have historically lasted minutes; this banks a TPU number in
+# <60s of bench time before the full suite gambles the rest of the window.
+# The device wait stays generous (300s): the 07-31 window was missed by a
+# short claim leash, and the fast stage's savings must come from doing
+# less bench work, not from giving up on a queued claim.
+FAST_TIMEOUT_S = 660.0
+FAST_ENV = {
+    "NOMAD_TPU_BENCH_HEADLINE_ONLY": "1",
+    "NOMAD_TPU_BENCH_RUNS": "3",
+    "NOMAD_TPU_BENCH_BREAKDOWN": "0",
+    "NOMAD_TPU_BENCH_DEVICE_WAIT": "300",
+    "NOMAD_TPU_BENCH_WATCHDOG": "600",
+}
 
 
 def now() -> str:
@@ -147,7 +161,8 @@ def last_json_line(text: str):
     return None
 
 
-def run_capture(kind: str, argv: list, timeout: float) -> dict:
+def run_capture(kind: str, argv: list, timeout: float,
+                extra_env: dict | None = None) -> dict:
     commit = head_commit()
     start = time.monotonic()
     try:
@@ -159,6 +174,7 @@ def run_capture(kind: str, argv: list, timeout: float) -> dict:
                 # keep the probe child's reachability diagnostic scanning
                 # the same ports the watcher scans
                 "NOMAD_TPU_RELAY_PORTS": ",".join(map(str, SCAN_PORTS)),
+                **(extra_env or {}),
             },
         )
         rc, out, err = proc.returncode, proc.stdout, proc.stderr
@@ -214,10 +230,18 @@ def main() -> None:
     log("start", pid=os.getpid(), ports=f"{SCAN_PORTS[0]}-{SCAN_PORTS[-1]}")
     last_capture_t = 0.0
     last_capture_commit = ""
+    # Per-window stage markers: reset when the relay goes dark so the next
+    # window re-banks a fresh fast number, but within one window a retrying
+    # full bench never re-spends time on an already-banked stage.
+    window_fast_ok = False
+    window_proof_done = False
     while True:
         try:
             open_ports = scan_ports()
             log("scan", open_ports=open_ports)
+            if not open_ports:
+                window_fast_ok = False
+                window_proof_done = False
             if open_ports:
                 commit = head_commit()
                 fresh_window = (
@@ -241,22 +265,36 @@ def main() -> None:
                     log("probe", ok=report.ok, last_stage=report.last_stage,
                         backend=report.backend, error=report.error)
                     if report.ok and report.backend != "cpu":
-                        # Relay answered with a real device: capture NOW —
-                        # historically it dies within minutes.
-                        bench = run_capture(
-                            "bench", [sys.executable, "bench.py"],
-                            BENCH_TIMEOUT_S,
-                        )
+                        # Relay answered with a real device. Staged capture:
+                        # bank the cheapest TPU number FIRST (headline only,
+                        # 3 runs, ~1 min), then the pallas proof, then the
+                        # full suite — a window that dies mid-full-suite has
+                        # still produced a driver-verifiable device number.
+                        # Each stage runs at most once per window (markers
+                        # reset when the relay goes dark) so a retrying full
+                        # bench never re-spends window time on banked stages.
+                        if not window_fast_ok:
+                            fast = run_capture(
+                                "bench-fast", [sys.executable, "bench.py"],
+                                FAST_TIMEOUT_S, extra_env=FAST_ENV,
+                            )
+                            window_fast_ok = fast["ok"]
                         proof = os.path.join(REPO, "tools", "pallas_proof.py")
-                        # A failed bench means the window may be closing —
-                        # don't burn it on the proof; retry the bench next
-                        # cycle instead.
-                        if bench["ok"] and os.path.exists(proof):
+                        # The probe already proved a live device, so the
+                        # proof is NOT gated on the fast stage's outcome —
+                        # a fast-stage timeout must not cost the window its
+                        # only compiled-pallas evidence.
+                        if not window_proof_done and os.path.exists(proof):
                             run_capture(
                                 "pallas_proof", [sys.executable, proof],
                                 PROOF_TIMEOUT_S,
                             )
-                        # Only a SUCCESSFUL bench closes the window; a
+                            window_proof_done = True
+                        bench = run_capture(
+                            "bench", [sys.executable, "bench.py"],
+                            BENCH_TIMEOUT_S,
+                        )
+                        # Only a SUCCESSFUL full bench closes the window; a
                         # failed one must keep retrying while the relay is
                         # still up — that window is the whole point.
                         if bench["ok"]:
