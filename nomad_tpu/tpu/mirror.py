@@ -106,6 +106,11 @@ class NodeMirror:
         # resolved over all nodes once and shared by every constraint
         # (and eval) touching that target.
         self._target_col_cache: Dict[str, Tuple] = {}
+        # target string -> (codes int32[n], uniques) factorization of the
+        # column above: one python pass per (mirror, target), after which
+        # every mask over that target is a per-DISTINCT-value evaluation
+        # plus a numpy gather instead of a 10k-iteration python loop.
+        self._target_code_cache: Dict[str, Tuple] = {}
         # Device-resident combined eligibility masks and clean-state usage
         # tensors: per-eval uploads are pure tunnel latency on remote
         # devices, so anything reusable across evals of one state
@@ -125,9 +130,10 @@ class NodeMirror:
     def driver_mask(self, drivers: Set[str]) -> np.ndarray:
         """Vectorized DriverIterator (reference: feasible.go:127-151).
 
-        One attribute-column pass per driver (shared with constraint
-        targets via the per-target column cache), bool-parsed once per
-        distinct attribute value — not a parse per node per driver."""
+        One factorized attribute column per driver (shared with constraint
+        targets via the per-target code cache), bool-parsed once per
+        DISTINCT attribute value and broadcast by gather — no per-node
+        python loop."""
         key = frozenset(drivers)
         cached = self._driver_mask_cache.get(key)
         if cached is not None:
@@ -135,21 +141,42 @@ class NodeMirror:
         mask = self.base_mask.copy()
         n = self.n
         for driver in drivers:
-            vals, _ = self._target_column(f"$attr.driver.{driver}")
-            memo: Dict = {}
-            for i in range(n):
-                if not mask[i]:
-                    continue
-                v = vals[i]
-                ok = memo.get(v)
-                if ok is None:
-                    ok = v is not _MISSING and v is not None \
-                        and bool(_parse_bool(v))
-                    memo[v] = ok
-                if not ok:
-                    mask[i] = False
+            # $attr. targets always factorize to a column (never a scalar
+            # literal), so codes is never None here.
+            codes, uniques = self._target_codes(f"$attr.driver.{driver}")
+            ok = np.fromiter(
+                (u is not _MISSING and u is not None and bool(_parse_bool(u))
+                 for u in uniques),
+                dtype=bool, count=len(uniques),
+            )
+            mask[:n] &= ok[codes]
         self._driver_mask_cache[key] = mask
         return mask
+
+    def _target_codes(self, target: str) -> Tuple:
+        """Factorization of a target column: ``(codes, uniques)`` where
+        ``codes`` is an int32[n] index into ``uniques`` (the distinct
+        values in first-seen order), or ``(None, literal)`` for scalar
+        targets. Built once per (mirror, target); cluster attributes have
+        a handful of distinct values, so every downstream mask evaluates
+        its predicate len(uniques) times and gathers."""
+        cached = self._target_code_cache.get(target)
+        if cached is not None:
+            return cached
+        vals, _ = self._target_column(target)
+        if isinstance(vals, str):
+            entry = (None, vals)
+        else:
+            # Two C-speed passes beat a python enumerate loop with
+            # per-element numpy stores: set() dedups, then fromiter maps.
+            uniques = list(set(vals))
+            code_map = {v: i for i, v in enumerate(uniques)}
+            codes = np.fromiter(
+                (code_map[v] for v in vals), dtype=np.int32, count=self.n
+            )
+            entry = (codes, uniques)
+        self._target_code_cache[target] = entry
+        return entry
 
     def _target_column(self, target: str) -> Tuple:
         """Resolve one constraint target over ALL nodes, once.
@@ -216,21 +243,37 @@ class NodeMirror:
         mask = self.base_mask.copy()
         n = self.n
         for c in constraints:
+            op = c.operand
             l_vals, _ = self._target_column(c.l_target)
             r_vals, _ = self._target_column(c.r_target)
             l_scalar = isinstance(l_vals, str)
             r_scalar = isinstance(r_vals, str)
             if l_scalar and r_scalar:
-                if not check_constraint(ctx, c.operand, l_vals, r_vals):
+                if not check_constraint(ctx, op, l_vals, r_vals):
                     mask[:n] = False
                 continue
+            if l_scalar or r_scalar:
+                # Column vs literal — the dominant shape. Evaluate the
+                # predicate once per distinct column value and gather.
+                col_target = c.r_target if l_scalar else c.l_target
+                codes, uniques = self._target_codes(col_target)
+                if l_scalar:
+                    pred = lambda u: check_constraint(ctx, op, l_vals, u)
+                else:
+                    pred = lambda u: check_constraint(ctx, op, u, r_vals)
+                ok = np.fromiter(
+                    (u is not _MISSING and pred(u) for u in uniques),
+                    dtype=bool, count=len(uniques),
+                )
+                mask[:n] &= ok[codes]
+                continue
+            # Column vs column (rare): per-(l, r) pair memo walk.
             memo: Dict[Tuple, bool] = {}
-            op = c.operand
             for i in range(n):
                 if not mask[i]:
                     continue
-                l = l_vals if l_scalar else l_vals[i]
-                r = r_vals if r_scalar else r_vals[i]
+                l = l_vals[i]
+                r = r_vals[i]
                 ok = memo.get((l, r))
                 if ok is None:
                     ok = (l is not _MISSING and r is not _MISSING

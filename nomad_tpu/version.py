@@ -109,7 +109,11 @@ def parse_constraints(s: str) -> List[Constraint]:
 def check_version_constraint(version_str: str, constraint_str: str) -> bool:
     """Whether ``version_str`` satisfies every constraint in
     ``constraint_str``. Returns False on parse failure, mirroring
-    checkVersionConstraint (feasible.go:405-446)."""
+    checkVersionConstraint (feasible.go:405-446). Non-string inputs
+    (a present-but-None node attribute) are parse failures, not crashes —
+    the same posture as check_lexical_order/check_regexp_constraint."""
+    if not isinstance(version_str, str) or not isinstance(constraint_str, str):
+        return False
     try:
         v = parse_version(version_str)
         constraints = parse_constraints(constraint_str)

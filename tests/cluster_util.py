@@ -18,16 +18,38 @@ from nomad_tpu.rpc import RPCError, RemoteError
 from nomad_tpu.server.cluster import ClusterConfig
 
 
+def _load_factor() -> float:
+    """Measured scheduling-stall multiplier for raft timing.
+
+    A full-suite run leaves daemon threads (broker timers, shape warmers)
+    and a large GC heap behind; a timer that expects to wake in 10ms can
+    oversleep several-fold under that load, which is exactly how
+    test_leader_failover flaked in round 4 (elections starved past the
+    wait deadline). Time a handful of short sleeps and scale the election
+    window by the observed overshoot — an idle box keeps the fast
+    timings, a loaded one gets proportionally wider windows. Capped so a
+    pathological stall cannot make failover tests crawl."""
+    expected = 0.0
+    t0 = time.monotonic()
+    for _ in range(5):
+        time.sleep(0.01)
+        expected += 0.01
+    elapsed = time.monotonic() - t0
+    return min(4.0, max(1.0, elapsed / expected))
+
+
 def relaxed_cluster_cfg(**kw) -> ClusterConfig:
     """Raft timing for IN-PROCESS test clusters. The production defaults
     (50ms heartbeat / 150-300ms elections) assume parallel servers; with
     3 servers' threads in one GIL, a busy test process can stall a
     leader's heartbeat past the election deadline and churn leadership
-    mid-test. Doubling the window makes churn rare while keeping failover
-    tests fast (elections still settle in under a second)."""
-    kw.setdefault("heartbeat_interval", 0.1)
-    kw.setdefault("election_timeout_min", 0.4)
-    kw.setdefault("election_timeout_max", 0.8)
+    mid-test. The base window is double production, further scaled by the
+    measured scheduling stall of the moment (see _load_factor) so a
+    suite-loaded box gets the wider elections it actually needs."""
+    f = _load_factor()
+    kw.setdefault("heartbeat_interval", 0.1 * f)
+    kw.setdefault("election_timeout_min", 0.4 * f)
+    kw.setdefault("election_timeout_max", 0.8 * f)
     return ClusterConfig(**kw)
 
 

@@ -481,3 +481,29 @@ def test_mirror_constraint_mask_matches_scalar_semantics():
         expect = {n.id for n in collect_feasible(it)}
         got = {nodes[i].id for i in range(len(nodes)) if mask[i]}
         assert got == expect, (constraints[0], got, expect)
+
+
+def test_mirror_version_constraint_over_null_attribute():
+    """The factorized mask path evaluates version predicates over ALL
+    distinct column values — including present-but-None attributes on
+    nodes an earlier constraint already excluded. A None version value
+    must be a parse failure (node infeasible), never a crash."""
+    from nomad_tpu.tpu.mirror import NodeMirror
+
+    _, ctx = make_context()
+    nodes = [mock.node() for _ in range(3)]
+    nodes[0].attributes["driver.docker.version"] = "1.10.0"
+    nodes[1].attributes["driver.docker.version"] = None
+    nodes[2].attributes.pop("driver.docker.version", None)
+
+    constraints = [Constraint(
+        l_target="$attr.driver.docker.version", r_target=">= 1.9",
+        operand="version",
+    )]
+    mirror = NodeMirror(list(nodes))
+    mask = mirror.constraint_mask(ctx, constraints)
+    static = StaticIterator(ctx, nodes)
+    it = ConstraintIterator(ctx, static, constraints)
+    expect = {n.id for n in collect_feasible(it)}
+    got = {nodes[i].id for i in range(len(nodes)) if mask[i]}
+    assert got == expect == {nodes[0].id}
