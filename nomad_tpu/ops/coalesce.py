@@ -199,7 +199,7 @@ class CoalescingSolver:
         self.coalesced = 0
 
     def hint_burst(self, n: int, window_s: float = BURST_WINDOW_S,
-                   gap_s: float = BURST_GAP_S) -> None:
+                   gap_s: float = BURST_GAP_S) -> int:
         """Announce ``n`` concurrent evals about to be processed (a batch
         worker's dequeue_batch drain): the dispatcher holds its next
         dispatch until every announced eval resolves (first submit or
@@ -210,19 +210,22 @@ class CoalescingSolver:
         Returns a generation token to pass to burst_begin, scoping each
         member thread's accounting to ITS burst — without it a straggler
         from a given-up or over-announced burst would decrement a
-        successor's expectation and release that hold early."""
+        successor's expectation and release that hold early. A lone eval
+        (n<=1) gets the -1 sentinel: it is NOT a burst member, and the
+        sentinel can never match a real generation, so passing it to
+        burst_begin cannot decrement a concurrent burst's expectation."""
         if n <= 1:
-            with self._lock:
-                return self._burst_gen
+            return -1
         with self._cond:
             now = time.monotonic()
-            if now >= self._burst_deadline:
-                # A prior burst that never resolved leaves its residue
-                # here (the dispatcher only clears it when a submit wakes
-                # it); don't stack a dead expectation onto this burst's.
-                self._burst_outstanding = 0
             self._burst_gen += 1
-            self._burst_outstanding += n
+            # REPLACE any unresolved expectation, never stack onto it:
+            # the generation bump just orphaned the previous burst's
+            # members (their gen no longer matches, so they can never
+            # account), and a stacked total could then only drain via
+            # the gap/window give-up — up to BURST_GAP_S of dispatch
+            # hold whenever two workers' hints overlap.
+            self._burst_outstanding = n
             self._burst_deadline = now + window_s
             self._burst_last = now
             self._burst_gap = gap_s
@@ -233,7 +236,9 @@ class CoalescingSolver:
         """Mark the calling thread as an announced burst member that has
         not yet accounted against the expectation. Call once per eval
         thread before scheduler invocation, with the token its worker's
-        hint_burst returned (None = the current generation)."""
+        hint_burst returned (None = the current generation; -1 = the
+        lone-eval sentinel, which matches no generation and so accounts
+        against nothing)."""
         if token is None:
             with self._lock:
                 token = self._burst_gen

@@ -2,7 +2,8 @@
 chained FeasibleIterators (/root/reference/scheduler/feasible.go).
 
 The TPU path computes the same predicates as dense boolean masks
-(nomad_tpu.ops.masks); this module is the scalar oracle it is
+(nomad_tpu.tpu.mirror NodeMirror.constraint_mask/driver_mask); this
+module is the scalar oracle it is
 differential-tested against, and handles the rare data-dependent cases
 (regex, distinct_hosts) that stay host-side in both paths.
 """

@@ -121,6 +121,12 @@ class _Watch:
         return self._kind_counts.get(kind, 0) > 0
 
     def notify(self, items: Iterable[WatchItem]) -> None:
+        # Unlocked emptiness probe: safe ONLY because blocking queries
+        # re-check the index after registering (register-then-recheck in
+        # blocking.py), so a waiter that races this read never depends on
+        # the missed wakeup. A free-threaded build keeping that protocol
+        # keeps the safety; move the check under the lock if the protocol
+        # ever changes.
         if not self._waiters:
             return
         with self._lock:

@@ -26,23 +26,32 @@ def _alloc_port() -> int:
 
 
 class ForkedAgent:
-    """Forked ``nomad-tpu agent -dev`` with its own HTTP port."""
+    """Forked ``nomad-tpu agent`` with its own HTTP port (dev mode by
+    default; pass ``agent_args`` to run a config-file agent instead —
+    the caller then owns port selection and must pass ``http_port``)."""
 
-    def __init__(self, timeout: float = 60.0):
+    def __init__(self, timeout: float = 60.0, agent_args=None,
+                 http_port=None):
         from nomad_tpu.discover import nomad_command
 
-        self.port = _alloc_port()
+        if agent_args is not None and http_port is None:
+            raise ValueError(
+                "http_port is required with agent_args: the config-file "
+                "agent binds the config's port, not an allocated one"
+            )
+        self.port = http_port if http_port is not None else _alloc_port()
         self.addr = f"http://127.0.0.1:{self.port}"
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = {**os.environ, "PYTHONPATH": repo_root, "JAX_PLATFORMS": "cpu"}
-        self.proc = subprocess.Popen(
-            nomad_command()
-            + [
-                "agent", "-dev",
+        if agent_args is None:
+            agent_args = [
+                "-dev",
                 "-http-port", str(self.port),
                 "-scheduler-backend", "host",
                 "-log-level", "WARN",
-            ],
+            ]
+        self.proc = subprocess.Popen(
+            nomad_command() + ["agent"] + list(agent_args),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             env=env,

@@ -1,0 +1,130 @@
+"""Black-box TLS: a forked real agent with a tls config block serves its
+RPC tier over mutual TLS and rejects plaintext.
+
+tests/test_tls.py proves the in-process wiring (listener, pool, uplink);
+this module proves the AGENT wiring end-to-end — config file → agent →
+ClusterServer → TLS listener — the reference's optional rpcTLS arm
+(/root/reference/nomad/rpc.go:104-110) as deployed, not as a unit.
+"""
+
+import json
+import subprocess
+
+import pytest
+
+from blackbox_util import ForkedAgent, _alloc_port
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("bb-tls")
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    srv_key, srv_csr, srv_crt = d / "srv.key", d / "srv.csr", d / "srv.crt"
+    ext = d / "san.cnf"
+    ext.write_text(
+        "subjectAltName=DNS:localhost,IP:127.0.0.1\n"
+        "basicConstraints=CA:FALSE\n"
+    )
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True)
+
+    try:
+        run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+            "-subj", "/CN=nomad-tpu-test-ca")
+    except (OSError, subprocess.CalledProcessError) as e:
+        pytest.skip(f"openssl unavailable: {e}")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(srv_key), "-out", str(srv_csr),
+        "-subj", "/CN=localhost")
+    run("openssl", "x509", "-req", "-in", str(srv_csr), "-CA", str(ca_crt),
+        "-CAkey", str(ca_key), "-CAcreateserial", "-days", "1",
+        "-extfile", str(ext), "-out", str(srv_crt))
+    return {"ca": str(ca_crt), "cert": str(srv_crt), "key": str(srv_key)}
+
+
+@pytest.fixture(scope="module")
+def tls_agent(certs, tmp_path_factory):
+    """A non-dev single-server agent from a JSON config file with TLS on
+    the RPC tier (dev mode runs the in-process server and never opens a
+    network RPC listener, so the TLS arm needs the cluster path)."""
+    d = tmp_path_factory.mktemp("bb-tls-agent")
+    http_port, rpc_port = _alloc_port(), _alloc_port()
+    cfg = {
+        "data_dir": str(d / "data"),
+        "name": "bb-tls-server",
+        "ports": {"http": http_port, "rpc": rpc_port},
+        "server": {"enabled": True, "bootstrap_expect": 1},
+        "scheduler_backend": "host",
+        "log_level": "WARN",
+        "tls": {
+            "enabled": True,
+            "ca_file": certs["ca"],
+            "cert_file": certs["cert"],
+            "key_file": certs["key"],
+            "verify_incoming": True,
+        },
+    }
+    cfg_path = d / "agent.json"
+    cfg_path.write_text(json.dumps(cfg))
+    try:
+        agent = ForkedAgent(
+            agent_args=["-config", str(cfg_path)], http_port=http_port,
+        )
+    except (RuntimeError, TimeoutError, OSError) as e:
+        pytest.skip(f"cannot fork black-box agent: {e}")
+    agent.rpc_addr = f"127.0.0.1:{rpc_port}"
+    yield agent
+    agent.stop()
+
+
+def _tls_cfg(certs):
+    from nomad_tpu.tlsutil import TLSConfig
+
+    return TLSConfig(
+        enabled=True, ca_file=certs["ca"], cert_file=certs["cert"],
+        key_file=certs["key"], verify_incoming=True, verify_hostname=False,
+    )
+
+
+def test_tls_rpc_roundtrip_against_forked_agent(certs, tls_agent):
+    """A mutual-TLS client reaches the forked agent's RPC tier
+    cross-process: the config-file tls block made it to the listener."""
+    from nomad_tpu.rpc import ConnPool
+
+    import time
+
+    pool = ConnPool(ssl_context=_tls_cfg(certs).outgoing_context())
+    try:
+        assert pool.call(tls_agent.rpc_addr, "Status.Ping", {}) == "pong"
+        # The HTTP ready-check does not wait for the election (production
+        # raft timing: 1-2s windows) — poll the leader over TLS.
+        deadline = time.monotonic() + 20.0
+        leader = ""
+        while time.monotonic() < deadline and not leader:
+            leader = pool.call(tls_agent.rpc_addr, "Status.Leader", {})
+            if not leader:
+                time.sleep(0.2)
+        assert leader == tls_agent.rpc_addr
+    finally:
+        pool.shutdown()
+
+
+def test_plaintext_rejected_by_forked_tls_agent(tls_agent):
+    """A plaintext pool must not get through the agent's TLS listener."""
+    from nomad_tpu.rpc import ConnPool, RPCError
+
+    pool = ConnPool(timeout=3.0)
+    try:
+        with pytest.raises(RPCError):
+            pool.call(tls_agent.rpc_addr, "Status.Ping", {})
+    finally:
+        pool.shutdown()
+
+
+def test_http_api_alive_alongside_tls_rpc(tls_agent):
+    """The HTTP plane still answers while the RPC tier is TLS-armed, and
+    reports the server role (the blackbox ready-check contract)."""
+    info = tls_agent.http_get("/v1/agent/self")
+    assert info.get("stats", {}).get("server")

@@ -203,6 +203,28 @@ def run_capture(kind: str, argv: list, timeout: float,
     }
     append_capture(entry)
     log("capture", kind=kind, rc=rc, commit=commit, ok=ok)
+    if ok:
+        # A successful capture is a milestone worth landing immediately —
+        # the session may die before any manual commit, and the round tag
+        # in the message ties the artifact to the round that produced it.
+        try:
+            # Add (the first capture creates the file untracked, which a
+            # bare commit pathspec would reject) then commit with the
+            # SAME pathspec: only the capture artifacts land, never
+            # whatever the interactive session happens to have staged.
+            paths = [os.path.basename(CAPTURE_FILE),
+                     os.path.basename(WATCH_LOG)]
+            subprocess.run(
+                ["git", "add", "--"] + paths,
+                cwd=REPO, capture_output=True, timeout=30,
+            )
+            subprocess.run(
+                ["git", "commit", "-m",
+                 f"Device capture ({_TAG} {kind}): {commit}", "--"] + paths,
+                cwd=REPO, capture_output=True, timeout=30,
+            )
+        except Exception:
+            pass  # a capture must never be lost to a git hiccup
     return entry
 
 
