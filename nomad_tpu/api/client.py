@@ -43,6 +43,7 @@ class QueryOptions:
     allow_stale: bool = False
     wait_index: int = 0
     wait_time: str = ""
+    prefix: str = ""
 
 
 @dataclass
@@ -74,6 +75,8 @@ class ApiClient:
                 query["stale"] = "1"
             if q.region:
                 query["region"] = q.region
+            if q.prefix:
+                query["prefix"] = q.prefix
         qs = urllib.parse.urlencode(query)
         return f"{self.address}{path}" + (f"?{qs}" if qs else "")
 

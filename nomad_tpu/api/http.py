@@ -25,6 +25,16 @@ from nomad_tpu.state.store import (
 from nomad_tpu.structs import MAX_QUERY_TIME, Job, ValidationError
 
 
+def _prefix_filter(items, query):
+    """Apply the list endpoints' ``?prefix=`` filter over item ids (the
+    reference api's QueryOptions.Prefix: CLI short-id resolution lists
+    with a prefix and disambiguates client-side)."""
+    prefix = query.get("prefix", "")
+    if not prefix:
+        return items
+    return [it for it in items if it.id.startswith(prefix)]
+
+
 class HTTPCodedError(Exception):
     def __init__(self, code: int, message: str):
         super().__init__(message)
@@ -203,6 +213,7 @@ class HTTPServer:
         if req.command == "GET":
             self._maybe_block(query, "jobs")
             jobs = sorted(srv.state_store.jobs(), key=lambda j: j.id)
+            jobs = _prefix_filter(jobs, query)
             return [j.stub() for j in jobs], srv.state_store.get_index("jobs")
         if req.command in ("PUT", "POST"):
             payload = self._read_body(req)
@@ -258,6 +269,7 @@ class HTTPServer:
         srv = self._srv()
         self._maybe_block(query, "nodes")
         nodes = sorted(srv.state_store.nodes(), key=lambda n: n.id)
+        nodes = _prefix_filter(nodes, query)
         return [n.stub() for n in nodes], srv.state_store.get_index("nodes")
 
     def node_request(self, req, query, node_id: str) -> Tuple[Any, int]:
@@ -295,6 +307,7 @@ class HTTPServer:
         srv = self._srv()
         self._maybe_block(query, "allocs")
         allocs = sorted(srv.state_store.allocs(), key=lambda a: a.id)
+        allocs = _prefix_filter(allocs, query)
         return [a.stub() for a in allocs], srv.state_store.get_index("allocs")
 
     def alloc_request(self, req, query, alloc_id: str) -> Tuple[Any, int]:
@@ -309,6 +322,7 @@ class HTTPServer:
         srv = self._srv()
         self._maybe_block(query, "evals")
         evals = sorted(srv.state_store.evals(), key=lambda e: e.id)
+        evals = _prefix_filter(evals, query)
         return evals, srv.state_store.get_index("evals")
 
     def eval_request(self, req, query, eval_id: str) -> Tuple[Any, int]:
