@@ -251,10 +251,14 @@ def build_state(nodes, job):
     return state
 
 
-def run_once(state, job):
+def run_once(state, job, trace_ids=None):
+    """One scheduler pass. When ``trace_ids`` is a list, the eval runs
+    under a root trace span (the worker posture) so the solver records
+    its per-stage spans, and the eval id is appended for later span
+    retrieval — the tracing-overhead arm of the headline."""
     import logging
 
-    from nomad_tpu import structs
+    from nomad_tpu import structs, trace
     from nomad_tpu.scheduler import new_scheduler
     from nomad_tpu.structs import Evaluation, PlanResult, generate_uuid
 
@@ -288,7 +292,14 @@ def run_once(state, job):
         "tpu-batch", state.snapshot(), _Planner(), logging.getLogger("bench")
     )
     start = time.perf_counter()
-    sched.process(ev)
+    if trace_ids is not None:
+        span = trace.get_tracer().start_span(ev.id, "eval", root=True)
+        with trace.use_span(span):
+            sched.process(ev)
+        span.finish()
+        trace_ids.append(ev.id)
+    else:
+        sched.process(ev)
     e2e = time.perf_counter() - start
 
     plan = _Planner.plan
@@ -711,6 +722,7 @@ def run_breakdown(scales=BREAKDOWN_SCALES):
 
     from nomad_tpu.ops.binpack import device_const, solve_waterfill
     from nomad_tpu.tpu.mirror import NodeMirror
+    from nomad_tpu.trace import StageTimer
 
     ask = (100, 128, 0, 0)  # the headline task's resource vector
     penalty_dev = device_const("f32", 0.0)
@@ -720,16 +732,20 @@ def run_breakdown(scales=BREAKDOWN_SCALES):
         count = 10 * n
         nodes_list = _mk_nodes(n, with_net=False)
 
-        t0 = time.perf_counter()
-        mirror = NodeMirror(nodes_list)
-        usage = mirror.clean_usage()
-        eligible = mirror.device_mask(None, set(), None, None)[0]
-        t1 = time.perf_counter()
+        # Stage cuts through the SAME StageTimer the production solver's
+        # trace spans use (nomad_tpu.trace) — one shared stage-timing
+        # path, not a second parallel timer.
+        prep_st = StageTimer()
+        with prep_st.stage("staging"):
+            mirror = NodeMirror(nodes_list)
+            usage = mirror.clean_usage()
+            eligible = mirror.device_mask(None, set(), None, None)[0]
         inputs = (mirror.total, mirror.sched_cap, mirror.bw_avail,
                   eligible, *usage)
-        for arr in inputs:
-            arr.block_until_ready()
-        t2 = time.perf_counter()
+        with prep_st.stage("transfer"):
+            for arr in inputs:
+                arr.block_until_ready()
+        prep_ms = prep_st.durations_ms()
         transfer_bytes = int(sum(getattr(a, "nbytes", 0) for a in inputs))
 
         ask_dev = device_const("ask", ask)
@@ -748,14 +764,16 @@ def run_breakdown(scales=BREAKDOWN_SCALES):
 
         exec_times, read_times, e2e_times = [], [], []
         for _ in range(RUNS):
-            t = time.perf_counter()
-            counts, unplaced = dispatch()
-            counts.block_until_ready()
-            unplaced.block_until_ready()
-            exec_times.append(time.perf_counter() - t)
-            t = time.perf_counter()
-            counts_host, _ = jax.device_get((counts, unplaced))
-            read_times.append(time.perf_counter() - t)
+            st = StageTimer()
+            with st.stage("execute"):
+                counts, unplaced = dispatch()
+                counts.block_until_ready()
+                unplaced.block_until_ready()
+            with st.stage("readback"):
+                counts_host, _ = jax.device_get((counts, unplaced))
+            d = st.durations_ms()
+            exec_times.append(d["execute"] / 1000.0)
+            read_times.append(d["readback"] / 1000.0)
             t = time.perf_counter()
             c2, u2 = dispatch()
             jax.device_get((c2, u2))
@@ -767,8 +785,8 @@ def run_breakdown(scales=BREAKDOWN_SCALES):
             "n_nodes": n,
             "count": count,
             "placed": placed,
-            "staging_ms": round((t1 - t0) * 1000, 2),
-            "transfer_ms": round((t2 - t1) * 1000, 2),
+            "staging_ms": round(prep_ms.get("staging", 0.0), 2),
+            "transfer_ms": round(prep_ms.get("transfer", 0.0), 2),
             "transfer_bytes": transfer_bytes,
             "execute_ms_p50": round(
                 statistics.median(exec_times) * 1000, 3),
@@ -801,8 +819,14 @@ def _measure_headline():
     """The one headline measurement protocol (config 3): build, warm one
     pass, clear, RUNS timed passes under a quiesced GC, distributions.
     Shared by main() and the cpu-fallback path so the two emitted figures
-    stay comparable. Returns (solve_dist, e2e_dist, placed, nodes) where
-    each dist is the _dist() summary over the RUNS samples."""
+    stay comparable. Returns (solve_dist, e2e_dist, placed, nodes,
+    trace_info): the headline dists are measured with tracing DISABLED
+    (comparable with prior rounds); ``trace_info`` carries a second,
+    tracing-ENABLED set of RUNS over the same state — the per-stage
+    solver spans (one shared stage-timing path with the breakdown) and
+    the measured overhead of leaving tracing on."""
+    from nomad_tpu import trace as _trace
+
     nodes, job = build_cluster()
     state = build_state(nodes, job)
     _TimingStack.install()
@@ -811,23 +835,76 @@ def _measure_headline():
     run_once(state, job)
     _TimingStack.solve_times.clear()
 
-    e2e_times = []
+    # Interleaved arms: each iteration runs one tracing-DISABLED and one
+    # tracing-ENABLED pass (the production worker posture: each traced
+    # eval under a root span, so solver stage spans record). Interleaving
+    # matters — same-box drift between two sequential sets has been
+    # observed to exceed any real tracing cost, which would make a
+    # sequential overhead figure pure noise.
+    tracer = _trace.configure(max_traces=2 * RUNS + 8, enabled=True)
+    trace_ids = []
+    e2e_times, e2e_traced = [], []
+    solve_untraced, solve_traced = [], []
     placed = 0
     with _quiesced():
         for _ in range(RUNS):
+            tracer.enabled = False
+            mark = len(_TimingStack.solve_times)
             e2e, placed = run_once(state, job)
             e2e_times.append(e2e)
+            solve_untraced.extend(_TimingStack.solve_times[mark:])
 
-    if not _TimingStack.solve_times:
+            tracer.enabled = True
+            mark = len(_TimingStack.solve_times)
+            e2e, _p = run_once(state, job, trace_ids=trace_ids)
+            e2e_traced.append(e2e)
+            solve_traced.extend(_TimingStack.solve_times[mark:])
+
+    if not solve_untraced:
         raise RuntimeError(
             "no device solves recorded — the TPU factories fell back "
             "to the host scheduler mid-run"
         )
+
+    if not solve_traced:
+        # A traced-arm-only device fallback must surface as an error, not
+        # be averaged into a nonsensical overhead figure.
+        trace_info = {"error": "no traced solves recorded — device "
+                               "fallback during the traced arm"}
+    else:
+        stage_samples = {}
+        tracer = _trace.get_tracer()
+        for tid in trace_ids:
+            for s in tracer.get_trace(tid) or []:
+                if (s["name"].startswith("solver.")
+                        and s["duration_ms"] is not None):
+                    stage_samples.setdefault(
+                        s["name"][len("solver."):], []
+                    ).append(s["duration_ms"])
+        sp50_off = statistics.median(solve_untraced)
+        sp50_on = statistics.median(solve_traced)
+        trace_info = {
+            "solve_ms_p50_traced": round(sp50_on * 1000, 3),
+            "e2e_eval_ms_p50_traced": round(
+                statistics.median(e2e_traced) * 1000, 3),
+            # The acceptance bound: < 5% warm-path regression with
+            # tracing on.
+            "overhead_pct": (
+                round((sp50_on / sp50_off - 1.0) * 100.0, 2)
+                if sp50_off else 0.0
+            ),
+            "stages_ms_p50": {
+                k: round(statistics.median(v), 4)
+                for k, v in stage_samples.items()
+            },
+        }
+
     return (
-        _dist(_TimingStack.solve_times, warmup=1),
+        _dist(solve_untraced, warmup=1),
         _dist(e2e_times, warmup=1),
         placed,
         nodes,
+        trace_info,
     )
 
 
@@ -837,7 +914,7 @@ def main():
     try:
         backend = acquire_device()
 
-        solve_dist, e2e_dist, placed, nodes = _measure_headline()
+        solve_dist, e2e_dist, placed, nodes, trace_info = _measure_headline()
         solve_p50 = solve_dist["p50_ms"] / 1000
         e2e_p50 = e2e_dist["p50_ms"] / 1000
         placements_per_sec = placed / solve_p50
@@ -886,6 +963,7 @@ def main():
                 "e2e_eval_ms_p50": round(e2e_p50 * 1000, 2),
                 "solve_ms": solve_dist,
                 "e2e_eval_ms": e2e_dist,
+                "tracing": trace_info,
                 "placed": placed,
                 "n_nodes": N_NODES,
                 "n_tasks": N_TASKS,
@@ -958,7 +1036,7 @@ def _cpu_fallback_headline():
     # The manager may have been past the force-cpu check and finished the
     # REAL device init during our wait — label whatever actually claimed.
     fb_backend = str(status.get("backend", "cpu"))
-    solve_dist, e2e_dist, placed, _nodes = _measure_headline()
+    solve_dist, e2e_dist, placed, _nodes, trace_info = _measure_headline()
     solve_p50 = solve_dist["p50_ms"] / 1000
     e2e_p50 = e2e_dist["p50_ms"] / 1000
     breakdown = None
@@ -987,6 +1065,7 @@ def _cpu_fallback_headline():
         "e2e_eval_ms_p50": round(e2e_p50 * 1000, 2),
         "solve_ms": solve_dist,
         "e2e_eval_ms": e2e_dist,
+        "tracing": trace_info,
         "placed": placed,
         "n_nodes": N_NODES,
         "n_tasks": N_TASKS,

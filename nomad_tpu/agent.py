@@ -44,6 +44,10 @@ class AgentConfig:
     statsite_addr: str = ""
     statsd_addr: str = ""
     disable_hostname_metrics: bool = False
+    # Eval-lifecycle tracing (nomad_tpu.trace): ring size of retained
+    # traces (0 = default 256) and the master enable.
+    trace_buffer_size: int = 0
+    disable_tracing: bool = False
     enable_syslog: bool = False
     syslog_facility: str = "LOCAL0"
     leave_on_interrupt: bool = False
@@ -103,6 +107,8 @@ class AgentConfig:
             statsite_addr=fc.telemetry.statsite_address,
             statsd_addr=fc.telemetry.statsd_address,
             disable_hostname_metrics=fc.telemetry.disable_hostname,
+            trace_buffer_size=fc.telemetry.trace_buffer_size,
+            disable_tracing=fc.telemetry.disable_tracing,
             enable_syslog=fc.enable_syslog,
             syslog_facility=fc.syslog_facility,
             leave_on_interrupt=fc.leave_on_interrupt,
@@ -232,10 +238,12 @@ class Agent:
         )
 
     def setup_telemetry(self) -> None:
-        """Metrics sinks + SIGUSR1 dump (command/agent/command.go:486-520)."""
+        """Metrics sinks + SIGUSR1 dump (command/agent/command.go:486-520)
+        + the eval tracer (nomad_tpu.trace, served at
+        /v1/agent/metrics and the trace endpoints)."""
         import threading
 
-        from nomad_tpu import telemetry
+        from nomad_tpu import telemetry, trace
 
         inmem, sink = telemetry.build_sink(
             statsite_addr=self.config.statsite_addr,
@@ -248,6 +256,10 @@ class Agent:
                 service="nomad",
                 enable_hostname=not self.config.disable_hostname_metrics,
             )
+        )
+        self.tracer = trace.configure(
+            max_traces=self.config.trace_buffer_size or 256,
+            enabled=not self.config.disable_tracing,
         )
         if threading.current_thread() is threading.main_thread():
             telemetry.setup_signal_dump(inmem)

@@ -77,11 +77,16 @@ class ServerBlock:
 
 @dataclass
 class Telemetry:
-    """config.go Telemetry block."""
+    """config.go Telemetry block, extended with eval-trace knobs
+    (nomad_tpu.trace): ``trace_buffer_size`` bounds the completed-trace
+    ring (0 = the default of 256), ``disable_tracing`` turns span
+    recording off entirely."""
 
     statsite_address: str = ""
     statsd_address: str = ""
     disable_hostname: bool = False
+    trace_buffer_size: int = 0
+    disable_tracing: bool = False
 
 
 @dataclass
@@ -214,6 +219,14 @@ class FileConfig:
             disable_hostname=(
                 other.telemetry.disable_hostname or self.telemetry.disable_hostname
             ),
+            trace_buffer_size=(
+                other.telemetry.trace_buffer_size
+                or self.telemetry.trace_buffer_size
+            ),
+            disable_tracing=(
+                other.telemetry.disable_tracing
+                or self.telemetry.disable_tracing
+            ),
         )
         out.atlas = Atlas(
             infrastructure=other.atlas.infrastructure or self.atlas.infrastructure,
@@ -309,6 +322,8 @@ def _from_mapping(data: dict) -> FileConfig:
                     setattr(cfg.server, k, v)
         elif key == "telemetry":
             for k, v in value.items():
+                if k == "trace_buffer_size":
+                    v = int(v)
                 setattr(cfg.telemetry, k, v)
         elif key == "atlas":
             for k, v in value.items():

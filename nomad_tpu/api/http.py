@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from nomad_tpu import telemetry, trace
 from nomad_tpu.api.codec import from_dict, to_dict
 from nomad_tpu.jobspec import parse_duration
 from nomad_tpu.state.store import (
@@ -39,6 +40,17 @@ class HTTPCodedError(Exception):
     def __init__(self, code: int, message: str):
         super().__init__(message)
         self.code = code
+
+
+class RawResponse:
+    """Non-JSON handler result (e.g. Prometheus text exposition): the
+    dispatcher writes the body verbatim with the given content type."""
+
+    __slots__ = ("body", "content_type")
+
+    def __init__(self, body: bytes, content_type: str):
+        self.body = body
+        self.content_type = content_type
 
 
 class HTTPServer:
@@ -86,7 +98,10 @@ class HTTPServer:
             (r"^/v1/evaluation/(?P<eval_id>[^/]+)$", self.eval_request),
             (r"^/v1/evaluation/(?P<eval_id>[^/]+)/allocations$",
              self.eval_allocations),
+            (r"^/v1/evaluation/(?P<eval_id>[^/]+)/trace$", self.eval_trace),
             (r"^/v1/agent/self$", self.agent_self),
+            (r"^/v1/agent/metrics$", self.agent_metrics),
+            (r"^/v1/agent/traces$", self.agent_traces),
             (r"^/v1/agent/debug$", self.agent_debug),
             (r"^/v1/agent/logs$", self.agent_logs),
             (r"^/v1/agent/members$", self.agent_members),
@@ -127,7 +142,10 @@ class HTTPServer:
                 self.logger.exception("http: request failed")
                 self._respond_error(req, 500, str(e))
             else:
-                self._respond_json(req, out, index)
+                if isinstance(out, RawResponse):
+                    self._respond_raw(req, out)
+                else:
+                    self._respond_json(req, out, index)
             return
         self._respond_error(req, 404, "not found")
 
@@ -143,6 +161,13 @@ class HTTPServer:
             req.send_header("X-Nomad-LastContact", "0")
         req.end_headers()
         req.wfile.write(body)
+
+    def _respond_raw(self, req, out: RawResponse) -> None:
+        req.send_response(200)
+        req.send_header("Content-Type", out.content_type)
+        req.send_header("Content-Length", str(len(out.body)))
+        req.end_headers()
+        req.wfile.write(out.body)
 
     def _respond_error(self, req, code: int, message: str) -> None:
         body = message.encode()
@@ -339,10 +364,53 @@ class HTTPServer:
         allocs = srv.state_store.allocs_by_eval(eval_id)
         return [a.stub() for a in allocs], srv.state_store.get_index("allocs")
 
+    def eval_trace(self, req, query, eval_id: str) -> Tuple[Any, Optional[int]]:
+        """Per-evaluation trace: the span tree recorded across broker →
+        worker → solver → plan applier → FSM (nomad_tpu.trace).
+        ``?format=chrome`` returns Chrome trace-event JSON that loads
+        straight into Perfetto."""
+        tracer = trace.get_tracer()
+        if query.get("format") == "chrome":
+            doc = tracer.chrome_trace(eval_id)
+            if doc is None:
+                raise HTTPCodedError(404, "no trace for evaluation")
+            return doc, None
+        spans = tracer.get_trace(eval_id)
+        if spans is None:
+            raise HTTPCodedError(404, "no trace for evaluation")
+        return {"eval_id": eval_id, "spans": spans}, None
+
     # -- agent + status endpoints --------------------------------------------
 
     def agent_self(self, req, query) -> Tuple[Any, Optional[int]]:
         return self.agent.self_info(), None
+
+    def agent_metrics(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Live InmemSink aggregates. Default JSON (all retained
+        intervals); ``?format=prometheus`` serves text exposition for a
+        Prometheus scrape (pull model — the reference only had the
+        SIGUSR1 dump and push sinks)."""
+        sink = getattr(self.agent, "inmem_sink", None)
+        if sink is None:
+            raise HTTPCodedError(404, "telemetry sink not initialized")
+        if query.get("format") == "prometheus":
+            return RawResponse(
+                telemetry.prometheus_text(sink).encode(),
+                "text/plain; version=0.0.4",
+            ), None
+        return {"timestamp": trace.now(), "intervals": sink.data()}, None
+
+    def agent_traces(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Summaries of the tracer's retained traces, newest first
+        (``?n=`` limits)."""
+        out = trace.get_tracer().traces()
+        try:
+            n = int(query.get("n", "0"))
+        except ValueError:
+            n = 0
+        if n > 0:
+            out = out[:n]
+        return out, None
 
     def agent_debug(self, req, query) -> Tuple[Any, Optional[int]]:
         """Runtime introspection, gated by enable_debug — the pprof-analog

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nomad_tpu import trace
 from nomad_tpu.ops.fit import NEG_INF, score_fit
 
 
@@ -353,7 +354,13 @@ def solve_many_async(
             )
 
         def fetch_exact():
-            i, o = jax.device_get((idxs, oks))
+            # Stage cuts ride the caller's thread-local timer (installed
+            # by TPUStack.solve_group; no-op otherwise): execute = device
+            # completion wait, readback = D2H copy.
+            with trace.stage("execute"):
+                jax.block_until_ready((idxs, oks))
+            with trace.stage("readback"):
+                i, o = jax.device_get((idxs, oks))
             return i[:count], o[:count]
 
         return fetch_exact
@@ -369,12 +376,17 @@ def solve_many_async(
 
     def fetch_fused():
         counts, _unplaced = fetch_counts()
-        idxs = np.repeat(np.arange(counts.shape[0], dtype=np.int64), counts)
-        n_placed = idxs.shape[0]
-        out_idx = np.full(count, -1, dtype=np.int64)
-        out_idx[:n_placed] = idxs[:count]
-        oks = np.zeros(count, dtype=bool)
-        oks[: min(n_placed, count)] = True
+        # Host expansion of the columnar counts is readback-side work:
+        # attribute it to the same stage the D2H copy lands in.
+        with trace.stage("readback"):
+            idxs = np.repeat(
+                np.arange(counts.shape[0], dtype=np.int64), counts
+            )
+            n_placed = idxs.shape[0]
+            out_idx = np.full(count, -1, dtype=np.int64)
+            out_idx[:n_placed] = idxs[:count]
+            oks = np.zeros(count, dtype=bool)
+            oks[: min(n_placed, count)] = True
         return out_idx, oks
 
     return fetch_fused

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nomad_tpu import telemetry
+from nomad_tpu import telemetry, trace
 from nomad_tpu.ops import pallas_solve
 from nomad_tpu.ops.binpack import solve_waterfill
 
@@ -120,7 +120,11 @@ class _Entry:
     def result(self) -> Tuple[np.ndarray, int]:
         """Block for the dispatch, then return (counts[N], n_unplaced) —
         or re-raise the dispatch failure instead of hanging."""
-        self.event.wait()
+        # The dispatcher-hold + device wall both land in the caller's
+        # 'execute' stage cut (trace.stage no-ops when the calling thread
+        # carries no stage timer).
+        with trace.stage("execute"):
+            self.event.wait()
         if self.group is None:
             raise RuntimeError("coalesced solve failed") from self.error
         return self.group.fetch(self.index)
@@ -143,9 +147,17 @@ class _Group:
         with self._fetch_lock:
             if self._host is None:
                 try:
-                    counts, remaining = jax.device_get(
-                        (self.counts_dev, self.remaining_dev)
-                    )
+                    # Split the first fetcher's wall into the shared
+                    # execute/readback stage cuts (bench.py's breakdown
+                    # uses the same names through the same StageTimer).
+                    with trace.stage("execute"):
+                        jax.block_until_ready(
+                            (self.counts_dev, self.remaining_dev)
+                        )
+                    with trace.stage("readback"):
+                        counts, remaining = jax.device_get(
+                            (self.counts_dev, self.remaining_dev)
+                        )
                 except Exception:
                     # Post-proof dispatches skip the synchronous prove
                     # (block_until_ready inside _pallas_dispatch's try),

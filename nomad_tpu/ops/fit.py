@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from nomad_tpu import telemetry
+
 NEG_INF = -jnp.inf
 
 
@@ -28,6 +30,11 @@ def score_fit(sched_capacity: jnp.ndarray, used: jnp.ndarray) -> jnp.ndarray:
     used:           [N, 2] float — utilization including reserved.
     Returns [N] float scores in [0, 18]; higher = fuller = preferred.
     """
+    # This body runs only while jax TRACES a caller (a fresh shape
+    # bucket), never per solve — the counter is therefore a direct
+    # recompilation-storm detector (SURVEY §7 "dynamic shapes"), visible
+    # at /v1/agent/metrics as nomad.solver.jit_trace.score_fit.
+    telemetry.incr_counter(("solver", "jit_trace", "score_fit"))
     safe_cap = jnp.maximum(sched_capacity, 1.0)
     free = 1.0 - used / safe_cap
     # Zero schedulable capacity -> -inf free -> 10**x underflows to 0,
@@ -46,4 +53,5 @@ def fit_mask(
     used_plus_ask: [N, D] int — proposed utilization incl. the new ask.
     Returns [N] bool.
     """
+    telemetry.incr_counter(("solver", "jit_trace", "fit_mask"))
     return jnp.all(used_plus_ask <= total, axis=-1)

@@ -29,7 +29,10 @@ import logging
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
+
+from nomad_tpu import telemetry
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 << 20
@@ -246,18 +249,27 @@ class RPCServer:
             conn.close()
 
     def _dispatch(self, req: dict) -> dict:
+        # Request counters/timers (reference: nomad/rpc.go:68 rpc.request
+        # + per-method MeasureSince at the endpoint handlers).
         seq = req.get("seq")
         method = req.get("method", "")
         handler = self._handlers.get(method)
+        telemetry.incr_counter(("rpc", "request"))
         if handler is None:
+            telemetry.incr_counter(("rpc", "unknown_method"))
             return {"seq": seq, "error": f"unknown method {method!r}",
                     "result": None}
+        start = time.perf_counter()
         try:
-            return {"seq": seq, "error": None, "result": handler(req.get("args", {}))}
+            out = {"seq": seq, "error": None,
+                   "result": handler(req.get("args", {}))}
         except Exception as e:
             self.logger.debug("rpc: handler %s failed: %s", method, e)
-            return {"seq": seq, "error": f"{type(e).__name__}: {e}",
-                    "result": None}
+            telemetry.incr_counter(("rpc", "request_error"))
+            out = {"seq": seq, "error": f"{type(e).__name__}: {e}",
+                   "result": None}
+        telemetry.measure_since(("rpc", method), start)
+        return out
 
 
 class _Waiter:

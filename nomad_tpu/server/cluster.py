@@ -15,6 +15,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu import trace
 from nomad_tpu.api.codec import from_dict, to_dict
 from nomad_tpu.raft import NotLeaderError, RaftConfig, RaftNode
 from nomad_tpu.rpc import (
@@ -290,8 +291,11 @@ class ClusterServer(Server):
         )
         if out.get("eval") is None:
             return None, "", 0
-        return (from_dict(Evaluation, out["eval"]), out["token"],
-                int(out.get("wait_index", 0)))
+        ev = from_dict(Evaluation, out["eval"])
+        # Adopt the leader broker's root span context so this follower's
+        # worker spans parent correctly across the RPC boundary.
+        trace.get_tracer().adopt_root(ev.id, out.get("span_ctx") or {})
+        return ev, out["token"], int(out.get("wait_index", 0))
 
     def eval_dequeue_batch(self, schedulers: List[str], max_batch: int,
                            timeout: float):
@@ -303,11 +307,14 @@ class ClusterServer(Server):
              "timeout": timeout},
             timeout=timeout + 5.0,
         )
-        return [
-            (from_dict(Evaluation, item["eval"]), item["token"],
-             int(item.get("wait_index", 0)))
-            for item in out["batch"]
-        ]
+        batch = []
+        tracer = trace.get_tracer()
+        for item in out["batch"]:
+            ev = from_dict(Evaluation, item["eval"])
+            tracer.adopt_root(ev.id, item.get("span_ctx") or {})
+            batch.append((ev, item["token"],
+                          int(item.get("wait_index", 0))))
+        return batch
 
     def eval_ack(self, eval_id: str, token: str) -> None:
         if self.raft.is_leader:
@@ -430,15 +437,18 @@ class ClusterServer(Server):
         if ev is None:
             return {"eval": None, "token": ""}
         return {"eval": to_dict(ev), "token": token,
-                "wait_index": wait_index}
+                "wait_index": wait_index,
+                "span_ctx": trace.get_tracer().root_ctx(ev.id)}
 
     def _rpc_eval_dequeue_batch(self, args: dict):
         batch = self.eval_dequeue_batch(
             args["schedulers"], int(args.get("max_batch", 1)),
             min(float(args.get("timeout", 0.5)), 10.0),
         )
+        tracer = trace.get_tracer()
         return {"batch": [
-            {"eval": to_dict(ev), "token": token, "wait_index": wait_index}
+            {"eval": to_dict(ev), "token": token, "wait_index": wait_index,
+             "span_ctx": tracer.root_ctx(ev.id)}
             for ev, token, wait_index in batch
         ]}
 

@@ -28,6 +28,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu import telemetry, trace
 from nomad_tpu.structs import Evaluation, generate_uuid
 
 FAILED_QUEUE = "_failed"
@@ -131,6 +132,12 @@ class EvalBroker:
         # eval ID -> count of token-verified plans currently in the
         # applier (redelivery deferred while nonzero; see plan_inflight).
         self._inflight_plans: Dict[str, int] = {}
+        # Trace spans (nomad_tpu.trace): the root 'eval' span opened at
+        # enqueue (finished at ack/flush) and the current 'broker.wait'
+        # span (enqueue/nack -> dequeue). The broker is the trace's
+        # birthplace: trace_id IS the eval id.
+        self._trace_root: Dict[str, object] = {}
+        self._trace_wait: Dict[str, object] = {}
         # eval ID -> raft index the processing worker must observe in ITS
         # local FSM before snapshotting. For a freshly-created eval this is
         # the eval's own apply index (same as modify_index); for an eval
@@ -182,6 +189,18 @@ class EvalBroker:
             return
         if self._enabled:
             self._evals[ev.id] = 0
+            telemetry.incr_counter(("broker", "enqueue"))
+            if ev.id not in self._trace_root:
+                root = trace.get_tracer().start_span(
+                    ev.id, "eval", root=True,
+                    annotations={
+                        "job_id": ev.job_id, "type": ev.type,
+                        "priority": ev.priority,
+                        "triggered_by": ev.triggered_by,
+                    },
+                )
+                if root is not trace.NULL_SPAN:
+                    self._trace_root[ev.id] = root
 
         if ev.wait > 0:
             timer = threading.Timer(ev.wait, self._enqueue_waiting, args=(ev,))
@@ -204,6 +223,21 @@ class EvalBroker:
         if not self._enabled:
             return
 
+        # The ready/blocked wait starts here (redeliveries and
+        # blocked->ready promotions restart it); finished at dequeue so
+        # the span covers the full queue wait. A still-open prior wait
+        # span (the eval transited the blocked queue) is finished first —
+        # overwriting it would leak an open span into the trace forever.
+        root = self._trace_root.get(ev.id)
+        if root is not None:
+            prior = self._trace_wait.pop(ev.id, None)
+            if prior is not None:
+                prior.finish()
+            self._trace_wait[ev.id] = trace.get_tracer().start_span(
+                ev.id, "broker.wait", parent=root,
+                annotations={"queue": queue},
+            )
+
         pending_eval = self._job_evals.get(ev.job_id, "")
         if pending_eval == "":
             self._job_evals[ev.job_id] = ev.id
@@ -211,6 +245,9 @@ class EvalBroker:
             blocked = self._blocked.setdefault(ev.job_id, _PriorityQueue())
             blocked.push(ev)
             self.stats.total_blocked += 1
+            wait = self._trace_wait.get(ev.id)
+            if wait is not None:
+                wait.annotate("blocked", True)
             return
 
         ready = self._ready.setdefault(queue, _PriorityQueue())
@@ -325,9 +362,23 @@ class EvalBroker:
         by_sched = self.stats.sched(sched)
         by_sched.ready -= 1
         by_sched.unacked += 1
+
+        telemetry.incr_counter(("broker", "dequeue"))
+        wait_span = self._trace_wait.pop(ev.id, None)
+        if wait_span is not None:
+            wait_span.annotate("attempt", self._evals[ev.id])
+            wait_span.finish()
+            if wait_span.end is not None:
+                telemetry.add_sample(
+                    ("broker", "wait"),
+                    (wait_span.end - wait_span.start) * 1000.0,
+                )
         return ev, token
 
-    def _nack_from_timer(self, eval_id: str, token: str) -> None:
+    def _nack_from_timer(self, eval_id: str, token: str,
+                         from_timer: bool = True) -> None:
+        # ``from_timer`` rides deferral re-arms so a deferred WORKER nack
+        # retried through this callback is not miscounted as a timeout.
         # Defer redelivery while a plan for this delivery sits in the
         # applier: nacking now would hand the eval to a second worker whose
         # snapshot races the in-flight plan's commit — the duplicate-
@@ -337,7 +388,7 @@ class EvalBroker:
         try:
             # nack() itself defers (short re-check) while a plan from this
             # delivery is mid-commit in the applier.
-            self.nack(eval_id, token)
+            self.nack(eval_id, token, _from_timer=from_timer)
         except BrokerError:
             pass
 
@@ -430,6 +481,15 @@ class EvalBroker:
             self.logger.debug("broker %x: ACK eval=%s token=%s",
                               id(self), eval_id[:8], token[:8])
 
+            telemetry.incr_counter(("broker", "ack"))
+            wait = self._trace_wait.pop(eval_id, None)
+            if wait is not None:
+                wait.finish()
+            root = self._trace_root.pop(eval_id, None)
+            if root is not None:
+                root.annotate("outcome", "ack").finish()
+                trace.get_tracer().mark_done(eval_id)
+
             blocked = self._blocked.get(job_id)
             if blocked is not None and len(blocked) > 0:
                 ev = blocked.pop()
@@ -438,9 +498,11 @@ class EvalBroker:
                 self.stats.total_blocked -= 1
                 self._enqueue_locked(ev, ev.type)
 
-    def nack(self, eval_id: str, token: str) -> None:
+    def nack(self, eval_id: str, token: str, _from_timer: bool = False) -> None:
         """Negative acknowledgment: redeliver or fail
-        (eval_broker.go:464-497)."""
+        (eval_broker.go:464-497). ``_from_timer`` marks the nack-timeout
+        path so the broker.nack_timeout counter counts only ACTUAL
+        timeout redeliveries — not deferral retries or stale timer fires."""
         with self._lock:
             unack = self._unack.get(eval_id)
             if unack is None:
@@ -455,8 +517,12 @@ class EvalBroker:
                 # short re-check timer retries the nack after plan_done
                 # has bumped wait_index past the commit.
                 unack.nack_timer.cancel()
+                # Propagate the ORIGIN of this nack into the retry: a
+                # deferred worker nack must not count as a timeout when
+                # the retry lands.
                 retry = threading.Timer(
-                    0.25, self._nack_from_timer, args=(eval_id, token)
+                    0.25, self._nack_from_timer,
+                    args=(eval_id, token, _from_timer),
                 )
                 retry.daemon = True
                 unack.nack_timer = retry
@@ -470,6 +536,9 @@ class EvalBroker:
             self.logger.debug("broker %x: NACK eval=%s token=%s",
                               id(self), eval_id[:8], token[:8])
 
+            telemetry.incr_counter(("broker", "nack"))
+            if _from_timer:
+                telemetry.incr_counter(("broker", "nack_timeout"))
             self.stats.total_unacked -= 1
             self.stats.sched(unack.eval.type).unacked -= 1
 
@@ -487,6 +556,12 @@ class EvalBroker:
                 unack.nack_timer.cancel()
             for timer in self._time_wait.values():
                 timer.cancel()
+            for wait in self._trace_wait.values():
+                wait.finish()
+            for root in self._trace_root.values():
+                root.annotate("outcome", "flush").finish()
+            self._trace_root = {}
+            self._trace_wait = {}
             self.stats = BrokerStats()
             self._evals = {}
             self._job_evals = {}

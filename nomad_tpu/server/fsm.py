@@ -16,9 +16,11 @@ from __future__ import annotations
 import logging
 import pickle
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
 
+from nomad_tpu import telemetry, trace
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import Allocation, Evaluation, Job, Node
 
@@ -58,7 +60,24 @@ class FSM:
         handler = self._handlers.get(msg_type)
         if handler is None:
             raise ValueError(f"failed to apply request: unknown type {msg_type!r}")
-        return handler(index, payload)
+        # Per-message-type apply timing (reference: nomad/fsm.go:148
+        # `defer metrics.MeasureSince([]string{"nomad","fsm",...})`), plus
+        # a child span when the applying thread carries one (the plan
+        # applier's synchronous-raft posture).
+        start = time.perf_counter()
+        parent = trace.current_span()
+        span = (
+            trace.get_tracer().start_span(
+                parent.trace_id, "fsm.apply", parent=parent,
+                annotations={"msg_type": msg_type, "index": index},
+            )
+            if parent is not None else trace.NULL_SPAN
+        )
+        try:
+            return handler(index, payload)
+        finally:
+            span.finish()
+            telemetry.measure_since(("fsm", "apply", msg_type), start)
 
     # -- handlers (fsm.go:146-297) ----------------------------------------
 

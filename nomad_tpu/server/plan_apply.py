@@ -14,7 +14,7 @@ import time
 from typing import Optional
 
 from nomad_tpu.server.eval_broker import BrokerError, EvalBroker
-from nomad_tpu import telemetry
+from nomad_tpu import telemetry, trace
 from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
 from nomad_tpu.structs import (
     Allocation,
@@ -956,6 +956,17 @@ class PlanApplier(threading.Thread):
             if pending is None:
                 continue
 
+            # Trace context: the worker's submit span rode the request
+            # envelope (Plan.span_ctx); the queue wait is reconstructed
+            # from the enqueue stamp so it covers the real parked time.
+            tracer = trace.get_tracer()
+            eval_id = pending.plan.eval_id
+            plan_ctx = pending.plan.span_ctx or tracer.root_ctx(eval_id)
+            tracer.start_span(
+                eval_id, "plan.queue_wait", parent=plan_ctx,
+                start=pending.enqueue_time,
+            ).finish()
+
             # Token verification guards split-brain evals
             # (plan_apply.go:52-58, structs.go:1466-1471). Verify + mark
             # inflight ATOMICALLY: the inflight mark stops the nack timer
@@ -983,7 +994,12 @@ class PlanApplier(threading.Thread):
                 snap = self.fsm.state.snapshot()
 
             t0 = time.perf_counter()
+            eval_span = tracer.start_span(
+                eval_id, "plan.evaluate", parent=plan_ctx
+            )
             result = evaluate_plan(snap, pending.plan)
+            eval_span.annotate("refresh_index", result.refresh_index)
+            eval_span.finish()
             telemetry.measure_since(("plan", "evaluate"), t0)
 
             if result.is_noop():
@@ -998,16 +1014,19 @@ class PlanApplier(threading.Thread):
                 # Re-evaluate against fresh state? The reference keeps the
                 # earlier verification (bounded staleness); so do we.
 
-            future = self._apply(result, snap)
+            apply_span = tracer.start_span(
+                eval_id, "plan.apply", parent=plan_ctx
+            )
+            future = self._apply(result, snap, span=apply_span)
             wait_event = threading.Event()
             t = threading.Thread(
                 target=self._async_plan_wait,
-                args=(wait_event, future, result, pending),
+                args=(wait_event, future, result, pending, apply_span),
                 daemon=True,
             )
             t.start()
 
-    def _apply(self, result: PlanResult, snap):
+    def _apply(self, result: PlanResult, snap, span=None):
         """Dispatch the replicated alloc update + optimistic snapshot apply
         (plan_apply.go:119-144)."""
         t0 = time.perf_counter()
@@ -1017,7 +1036,12 @@ class PlanApplier(threading.Thread):
             payload["alloc_batches"] = result.alloc_batches
         if result.update_batches:
             payload["update_batches"] = result.update_batches
-        future = self.raft.apply("alloc_update", payload)
+        # A synchronous replication layer (InProcRaft) applies on THIS
+        # thread: the active-span install lets the FSM hang its fsm.apply
+        # span under plan.apply. An async raft applies elsewhere and only
+        # gets the aggregate timer.
+        with trace.use_span(span if span is not None else trace.NULL_SPAN):
+            future = self.raft.apply("alloc_update", payload)
         telemetry.measure_since(("plan", "submit"), t0)
         if snap is not None:
             # Stamp the optimistic snapshot with the entry's real index: with
@@ -1037,7 +1061,8 @@ class PlanApplier(threading.Thread):
                 snap.apply_update_batches(idx, result.update_batches)
         return future
 
-    def _async_plan_wait(self, wait_event, future, result, pending: PendingPlan):
+    def _async_plan_wait(self, wait_event, future, result,
+                         pending: PendingPlan, span=None):
         """plan_apply.go:146-162"""
         index = 0
         try:
@@ -1045,10 +1070,14 @@ class PlanApplier(threading.Thread):
                 index = future.result()
             except Exception as e:  # raft apply failed
                 self.logger.error("failed to apply plan: %s", e)
+                if span is not None:
+                    span.annotate("error", str(e)).finish()
                 pending.respond(None, e)
                 wait_event.set()
                 return
             result.alloc_index = index
+            if span is not None:
+                span.annotate("alloc_index", index).finish()
             pending.respond(result, None)
             wait_event.set()
         finally:

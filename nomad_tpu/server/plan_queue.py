@@ -13,6 +13,7 @@ import threading
 from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
+from nomad_tpu import telemetry, trace
 from nomad_tpu.structs import Plan, PlanResult
 
 
@@ -24,13 +25,16 @@ ERR_QUEUE_DISABLED = "plan queue is disabled"
 
 
 class PendingPlan:
-    """A submitted plan + its response future (plan_queue.go:50-69)."""
+    """A submitted plan + its response future (plan_queue.go:50-69).
+    ``enqueue_time`` stamps queue admission so the applier can emit the
+    plan.queue_wait span without a side channel."""
 
-    __slots__ = ("plan", "future")
+    __slots__ = ("plan", "future", "enqueue_time")
 
     def __init__(self, plan: Plan):
         self.plan = plan
         self.future: Future = Future()
+        self.enqueue_time = trace.now()
 
     def respond(self, result: Optional[PlanResult], err: Optional[Exception]) -> None:
         if err is not None:
@@ -74,6 +78,10 @@ class PlanQueue:
             heapq.heappush(
                 self._heap, (-plan.priority, next(self._counter), pending)
             )
+            # Depth is gauged by the server's 1 Hz stats loop (the single
+            # writer — it keeps the series alive through idle intervals);
+            # the enqueue counter here gives the rate side.
+            telemetry.incr_counter(("plan", "queue_enqueue"))
             self._work.notify_all()
             return pending
 

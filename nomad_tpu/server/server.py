@@ -219,6 +219,9 @@ class Server:
             telemetry.set_gauge(
                 ("broker", "total_blocked"), broker.total_blocked
             )
+            telemetry.set_gauge(
+                ("broker", "total_waiting"), broker.total_waiting
+            )
             for queue, stats in broker.by_scheduler.items():
                 telemetry.set_gauge(
                     ("broker", queue, "ready"), stats.ready
@@ -226,6 +229,10 @@ class Server:
                 telemetry.set_gauge(
                     ("broker", queue, "unacked"), stats.unacked
                 )
+            # The ONE plan.queue_depth writer: a periodic gauge keeps the
+            # series present in every retained interval (an event-driven
+            # write would vanish from the exposition after 60s of queue
+            # inactivity, breaking absent()-style alerts).
             telemetry.set_gauge(
                 ("plan", "queue_depth"), self.plan_queue.depth()
             )

@@ -13,7 +13,7 @@ import threading
 import time
 from typing import Optional, Tuple
 
-from nomad_tpu import telemetry
+from nomad_tpu import telemetry, trace
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.server.eval_broker import BrokerError
 from nomad_tpu.structs import JOB_TYPE_CORE, Evaluation, Plan, PlanResult
@@ -126,14 +126,22 @@ class Worker(threading.Thread):
         # a redelivered eval's wait_index covers any plan an earlier
         # delivery committed before a leader died — snapshotting short of
         # it double-places the eval.
+        tracer = trace.get_tracer()
+        root_ctx = tracer.root_ctx(ev.id)
+        sync_span = tracer.start_span(
+            ev.id, "worker.wait_for_index", parent=root_ctx,
+            annotations={"index": max(ev.modify_index, wait_index)},
+        )
         try:
             self._wait_for_index(
                 max(ev.modify_index, wait_index), RAFT_SYNC_LIMIT
             )
         except TimeoutError as e:
+            sync_span.annotate("error", str(e)).finish()
             self.logger.error("error waiting for state sync: %s", e)
             self._send_ack(ev.id, token, ack=False)
             return
+        sync_span.finish()
         # Touch the broker's nack timer while the scheduler runs: a cold
         # first compile of a new shape bucket can exceed eval_nack_timeout
         # before any plan is submitted, and a redelivered eval mid-solve
@@ -171,13 +179,19 @@ class Worker(threading.Thread):
         # — a daemon worker of a shut-down server can still be mid-solve.
         from nomad_tpu.ops.coalesce import device_activity
 
+        inv_span = tracer.start_span(
+            ev.id, "worker.invoke_scheduler", parent=root_ctx,
+            annotations={"worker": self.name, "type": ev.type},
+        )
+        ok = False
         try:
-            with device_activity():
+            with device_activity(), trace.use_span(inv_span):
                 ok = self._invoke_scheduler(
                     ev, token, planner=_EvalRun(self, token)
                 )
         finally:
             stop_touch.set()
+            inv_span.annotate("ok", ok).finish()
         self._send_ack(ev.id, token, ack=ok)
 
     # -- internals ---------------------------------------------------------
@@ -314,7 +328,19 @@ class _EvalRun:
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         start = time.perf_counter()
         plan.eval_token = self.eval_token
-        result = self.worker.server.plan_submit(plan)
+        # The submit span's context rides the request envelope
+        # (Plan.span_ctx) so the leader's applier parents its plan.* spans
+        # on it even across the RPC boundary.
+        tracer = trace.get_tracer()
+        span = tracer.start_span(
+            plan.eval_id, "worker.submit_plan",
+            parent=trace.current_span() or tracer.root_ctx(plan.eval_id),
+        )
+        plan.span_ctx = span.ctx()
+        try:
+            result = self.worker.server.plan_submit(plan)
+        finally:
+            span.finish()
         telemetry.measure_since(("worker", "submit_plan"), start)
 
         new_state = None

@@ -1112,6 +1112,10 @@ class Plan:
 
     eval_id: str = ""
     eval_token: str = ""
+    # Trace span context of the submitting worker (nomad_tpu.trace): rides
+    # the Plan.Submit envelope so the leader's applier parents its plan.*
+    # spans on the worker's submit span across the RPC boundary.
+    span_ctx: Dict[str, str] = field(default_factory=dict)
     priority: int = 0
     all_at_once: bool = False
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)

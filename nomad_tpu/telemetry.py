@@ -26,8 +26,20 @@ from typing import Dict, List, Optional, Tuple
 Key = Tuple[str, ...]
 
 
+_FLAT_CACHE: Dict[Key, str] = {}
+
+
 def _flat(key: Key) -> str:
-    return ".".join(str(p) for p in key)
+    # Memoized: metric keys are a small fixed vocabulary, and the join
+    # shows up in profiles once the FSM/RPC/solver hot paths emit on
+    # every operation. Bounded against pathological dynamic keys.
+    s = _FLAT_CACHE.get(key)
+    if s is None:
+        s = ".".join(str(p) for p in key)
+        if len(_FLAT_CACHE) > 4096:
+            _FLAT_CACHE.clear()
+        _FLAT_CACHE[key] = s
+    return s
 
 
 class AggregateSample:
@@ -93,6 +105,13 @@ class InmemSink:
         self.interval = interval
         self.max_intervals = max(1, int(retain / interval))
         self.intervals: List[IntervalMetrics] = []
+        # Process-lifetime cumulative totals, never evicted (the key
+        # vocabulary is finite): the Prometheus exposition needs
+        # monotonic counters — a rolling-window sum DECREASES as
+        # intervals age out, which rate()/increase() reads as counter
+        # resets and turns into spurious rate spikes.
+        self._cum_counters: Dict[str, List[float]] = {}  # [sum, count]
+        self._cum_samples: Dict[str, List[float]] = {}   # [sum, count, max]
         self._lock = threading.Lock()
 
     def _current(self) -> IntervalMetrics:
@@ -111,20 +130,77 @@ class InmemSink:
             self._current().gauges[_flat(key)] = value
 
     def incr_counter(self, key: Key, value: float) -> None:
+        name = _flat(key)
         with self._lock:
             cur = self._current()
-            agg = cur.counters.get(_flat(key))
+            agg = cur.counters.get(name)
             if agg is None:
-                agg = cur.counters[_flat(key)] = AggregateSample()
+                agg = cur.counters[name] = AggregateSample()
             agg.ingest(value)
+            cum = self._cum_counters.get(name)
+            if cum is None:
+                self._cum_counters[name] = [value, 1]
+            else:
+                cum[0] += value
+                cum[1] += 1
 
     def add_sample(self, key: Key, value: float) -> None:
+        name = _flat(key)
         with self._lock:
             cur = self._current()
-            agg = cur.samples.get(_flat(key))
+            agg = cur.samples.get(name)
             if agg is None:
-                agg = cur.samples[_flat(key)] = AggregateSample()
+                agg = cur.samples[name] = AggregateSample()
             agg.ingest(value)
+            cum = self._cum_samples.get(name)
+            if cum is None:
+                self._cum_samples[name] = [value, 1, value]
+            else:
+                cum[0] += value
+                cum[1] += 1
+                if value > cum[2]:
+                    cum[2] = value
+
+    def cumulative(self) -> Tuple[Dict[str, List[float]],
+                                  Dict[str, List[float]]]:
+        """(counters {name: [sum, count]}, samples {name: [sum, count,
+        max]}) over the process lifetime — the monotonic series the
+        Prometheus exposition serves."""
+        with self._lock:
+            return (
+                {k: list(v) for k, v in self._cum_counters.items()},
+                {k: list(v) for k, v in self._cum_samples.items()},
+            )
+
+    def data(self) -> List[dict]:
+        """Structured dump of all retained intervals — the JSON body of
+        ``/v1/agent/metrics`` (api/http.py agent_metrics)."""
+
+        def agg_dict(agg: AggregateSample) -> dict:
+            return {
+                "count": agg.count,
+                "sum": agg.sum,
+                "min": agg.min,
+                "max": agg.max,
+                "mean": agg.mean,
+                "stddev": agg.stddev,
+                "last": agg.last,
+            }
+
+        out: List[dict] = []
+        with self._lock:
+            for ivl in self.intervals:
+                out.append({
+                    "interval": ivl.interval,
+                    "gauges": dict(ivl.gauges),
+                    "counters": {
+                        k: agg_dict(a) for k, a in ivl.counters.items()
+                    },
+                    "samples": {
+                        k: agg_dict(a) for k, a in ivl.samples.items()
+                    },
+                })
+        return out
 
     def dump(self, out=None) -> str:
         """Formatted dump of all retained intervals (inmem_signal.go)."""
@@ -298,6 +374,64 @@ def add_sample(key: Key, value: float) -> None:
 
 def measure_since(key: Key, start: float) -> None:
     get_global().measure_since(key, start)
+
+
+def _prom_name(key: str) -> str:
+    """Sanitize a flattened metric key to the Prometheus data model
+    ([a-zA-Z_:][a-zA-Z0-9_:]*): every run of invalid characters maps to a
+    single underscore."""
+    out = []
+    prev_us = False
+    for ch in key:
+        ok = ch.isascii() and (ch.isalnum() or ch in "_:")
+        if ok:
+            out.append(ch)
+            prev_us = False
+        elif not prev_us:
+            out.append("_")
+            prev_us = True
+    name = "".join(out)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name or "_"
+
+
+def prometheus_text(inmem: InmemSink) -> str:
+    """Prometheus text exposition (version 0.0.4): gauges take their
+    latest retained value; counters and sample summaries serve the
+    sink's PROCESS-LIFETIME cumulative totals — a rolling-window sum
+    would decrease as ring intervals age out, which rate()/increase()
+    reads as counter resets and turns into spurious rate spikes."""
+    intervals = inmem.data()
+    gauges: Dict[str, float] = {}
+    for ivl in intervals:
+        gauges.update(ivl["gauges"])  # later intervals win
+    counters, samples = inmem.cumulative()
+
+    def _fmt(v: float) -> str:
+        # Shortest-exact float (.17g), NOT %g: %g truncates to 6
+        # significant digits, so a counter past ~1e6 quantizes and
+        # Prometheus rate() reads phantom resets between scrapes.
+        return format(float(v), ".17g")
+
+    lines: List[str] = []
+    for key in sorted(gauges):
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(gauges[key])}")
+    for key in sorted(counters):
+        name = _prom_name(key) + "_total"
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt(counters[key][0])}")
+    for key in sorted(samples):
+        name = _prom_name(key) + "_ms"
+        total, count, peak = samples[key]
+        lines.append(f"# TYPE {name} summary")
+        lines.append(f"{name}_sum {_fmt(total)}")
+        lines.append(f"{name}_count {int(count)}")
+        lines.append(f"# TYPE {name}_max gauge")
+        lines.append(f"{name}_max {_fmt(peak)}")
+    return "\n".join(lines) + "\n"
 
 
 def setup_signal_dump(sink: InmemSink, signum: int = signal.SIGUSR1) -> None:

@@ -168,8 +168,9 @@ class NodeMirror:
             entry = (None, vals)
         else:
             # Two C-speed passes beat a python enumerate loop with
-            # per-element numpy stores: set() dedups, then fromiter maps.
-            uniques = list(set(vals))
+            # per-element numpy stores: dict.fromkeys dedups in first-seen
+            # order (run-to-run deterministic), then fromiter maps.
+            uniques = list(dict.fromkeys(vals))
             code_map = {v: i for i, v in enumerate(uniques)}
             codes = np.fromiter(
                 (code_map[v] for v in vals), dtype=np.int32, count=self.n

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from nomad_tpu import telemetry, trace
 from nomad_tpu.network import NetworkIndex
 from nomad_tpu.ops.binpack import device_const, solve_counts_async, solve_many_async
 from nomad_tpu.scheduler.context import EvalContext
@@ -101,6 +102,32 @@ def _new_ids_seed() -> int:
     return int.from_bytes(_os.urandom(16), "little")
 
 
+def _solve_stages() -> "trace.StageTimer":
+    """A live stage timer when this eval carries a trace span (the worker
+    installed one via trace.use_span); the inert singleton otherwise, so
+    an untraced solve pays one thread-local read."""
+    if trace.current_span() is not None:
+        return trace.StageTimer()
+    return trace.NULL_STAGES
+
+
+def _emit_solver_trace(st, start: float, count: int) -> None:
+    """Publish one solve's stage cuts: child spans under the eval's active
+    span (solver.staging/transfer/execute/readback — the SAME cuts
+    bench.py's breakdown publishes, through the same StageTimer), plus
+    the aggregate device-solve wall as a telemetry sample. Per-stage
+    aggregates live in the spans, not the sink — four extra sink writes
+    per solve measurably eat the <5% tracing-overhead budget."""
+    ms = (time.perf_counter() - start) * 1000.0
+    telemetry.add_sample(("solver", "solve"), ms)
+    if st is trace.NULL_STAGES:
+        return
+    span = trace.current_span()
+    if span is not None:
+        span.annotate("solve_count", count)
+    st.emit_spans(span)
+
+
 class _SolveInputs:
     """Device inputs for one task-group solve, assembled by TPUStack.prepare."""
 
@@ -169,24 +196,33 @@ class TPUStack:
         (uuid batches, name materialization) rides the transfer round-trip.
         """
         start = time.perf_counter()
-        tg_constr = task_group_constraints(tg)
-        prep = self.prepare(tg, tg_constr)
-        if prep is None:
+        st = _solve_stages()
+        with trace.use_stages(st):
+            with st.stage("staging"):
+                tg_constr = task_group_constraints(tg)
+                prep = self.prepare(tg, tg_constr)
+            if prep is None:
+                if overlap is not None:
+                    overlap()
+                self.ctx.metrics().allocation_time = (
+                    time.perf_counter() - start
+                )
+                _emit_solver_trace(st, start, count)
+                return None, None, tg_constr.size
+
+            with st.stage("transfer"):
+                fetch = solve_many_async(
+                    self.mirror.total, self.mirror.sched_cap, prep.used,
+                    prep.job_count, prep.tg_count, self.mirror.bw_avail,
+                    prep.bw_used, prep.mask, prep.ask, prep.bw_ask, count,
+                    self.penalty, job_distinct=prep.job_distinct,
+                    tg_distinct=prep.tg_distinct,
+                )
             if overlap is not None:
                 overlap()
-            self.ctx.metrics().allocation_time = time.perf_counter() - start
-            return None, None, tg_constr.size
-
-        fetch = solve_many_async(
-            self.mirror.total, self.mirror.sched_cap, prep.used,
-            prep.job_count, prep.tg_count, self.mirror.bw_avail, prep.bw_used,
-            prep.mask, prep.ask, prep.bw_ask, count, self.penalty,
-            job_distinct=prep.job_distinct, tg_distinct=prep.tg_distinct,
-        )
-        if overlap is not None:
-            overlap()
-        idxs, oks = fetch()
+            idxs, oks = fetch()
         self.ctx.metrics().allocation_time = time.perf_counter() - start
+        _emit_solver_trace(st, start, count)
         return idxs, oks, tg_constr.size
 
     def solve_group_counts(self, tg: TaskGroup, count: int, overlap=None):
@@ -194,24 +230,33 @@ class TPUStack:
         (counts[N] per mirror row, n_unplaced, size). The AllocBatch path —
         no per-placement expansion anywhere."""
         start = time.perf_counter()
-        tg_constr = task_group_constraints(tg)
-        prep = self.prepare(tg, tg_constr)
-        if prep is None:
+        st = _solve_stages()
+        with trace.use_stages(st):
+            with st.stage("staging"):
+                tg_constr = task_group_constraints(tg)
+                prep = self.prepare(tg, tg_constr)
+            if prep is None:
+                if overlap is not None:
+                    overlap()
+                self.ctx.metrics().allocation_time = (
+                    time.perf_counter() - start
+                )
+                _emit_solver_trace(st, start, count)
+                return None, count, tg_constr.size
+
+            with st.stage("transfer"):
+                fetch = solve_counts_async(
+                    self.mirror.total, self.mirror.sched_cap, prep.used,
+                    prep.job_count, prep.tg_count, self.mirror.bw_avail,
+                    prep.bw_used, prep.mask, prep.ask, prep.bw_ask, count,
+                    self.penalty, job_distinct=prep.job_distinct,
+                    tg_distinct=prep.tg_distinct,
+                )
             if overlap is not None:
                 overlap()
-            self.ctx.metrics().allocation_time = time.perf_counter() - start
-            return None, count, tg_constr.size
-
-        fetch = solve_counts_async(
-            self.mirror.total, self.mirror.sched_cap, prep.used,
-            prep.job_count, prep.tg_count, self.mirror.bw_avail, prep.bw_used,
-            prep.mask, prep.ask, prep.bw_ask, count, self.penalty,
-            job_distinct=prep.job_distinct, tg_distinct=prep.tg_distinct,
-        )
-        if overlap is not None:
-            overlap()
-        counts, unplaced = fetch()
+            counts, unplaced = fetch()
         self.ctx.metrics().allocation_time = time.perf_counter() - start
+        _emit_solver_trace(st, start, count)
         return counts, unplaced, tg_constr.size
 
     def select_many(self, tg: TaskGroup, count: int) -> Tuple[List[Optional[_Placement]], Resources]:

@@ -214,17 +214,31 @@ def run_capture(kind: str, argv: list, timeout: float,
             # whatever the interactive session happens to have staged.
             paths = [os.path.basename(CAPTURE_FILE),
                      os.path.basename(WATCH_LOG)]
-            subprocess.run(
+            add = subprocess.run(
                 ["git", "add", "--"] + paths,
-                cwd=REPO, capture_output=True, timeout=30,
+                cwd=REPO, capture_output=True, text=True, timeout=30,
             )
-            subprocess.run(
+            cm = subprocess.run(
                 ["git", "commit", "-m",
                  f"Device capture ({_TAG} {kind}): {commit}", "--"] + paths,
-                cwd=REPO, capture_output=True, timeout=30,
+                cwd=REPO, capture_output=True, text=True, timeout=30,
             )
-        except Exception:
-            pass  # a capture must never be lost to a git hiccup
+            if add.returncode != 0 or cm.returncode != 0:
+                # A persistently failing auto-commit (index.lock
+                # contention, rebase in progress, unset identity) must be
+                # visible in the watch log, not silently defeated.
+                log("autocommit-failed",
+                    add_rc=add.returncode, commit_rc=cm.returncode,
+                    stderr="\n".join(
+                        (add.stderr or "").strip().splitlines()[-3:]
+                        + (cm.stderr or "").strip().splitlines()[-3:]
+                    ))
+            else:
+                log("autocommit", kind=kind, commit=commit)
+        except Exception as e:
+            # A capture must never be lost to a git hiccup — but the
+            # hiccup itself must be loggable evidence.
+            log("autocommit-error", error=f"{type(e).__name__}: {e}")
     return entry
 
 
@@ -307,11 +321,16 @@ def main() -> None:
                         # a fast-stage timeout must not cost the window its
                         # only compiled-pallas evidence.
                         if not window_proof_done and os.path.exists(proof):
-                            run_capture(
+                            proof_cap = run_capture(
                                 "pallas_proof", [sys.executable, proof],
                                 PROOF_TIMEOUT_S,
                             )
-                            window_proof_done = True
+                            # Only a SUCCESSFUL proof banks the stage
+                            # (mirroring window_fast_ok): a transient
+                            # failure retries while the relay is still up
+                            # instead of forfeiting the window's only
+                            # compiled-pallas evidence.
+                            window_proof_done = proof_cap["ok"]
                         bench = run_capture(
                             "bench", [sys.executable, "bench.py"],
                             BENCH_TIMEOUT_S,
