@@ -500,6 +500,14 @@ def _scaled(n):
 
 AUX_RUNS = max(1, int(os.environ.get("NOMAD_TPU_BENCH_AUX_RUNS", 3)))
 
+# Config-5 pass/fail floors, env-overridable for new hardware baselines.
+CONFIG5_INPLACE_BAR = float(
+    os.environ.get("NOMAD_TPU_CONFIG5_INPLACE_BAR", 100_000)
+)
+CONFIG5_ROLLED_BAR = float(
+    os.environ.get("NOMAD_TPU_CONFIG5_ROLLED_BAR", 5_000)
+)
+
 
 def run_config2():
     """BASELINE config 2: 1k-node / 5k-taskgroup service bin-pack, CPU+mem
@@ -672,12 +680,29 @@ def run_config5():
     state.upsert_job(n_nodes + 5, job3)
     with _quiesced():
         e2e, placed = _eval_once(state, job3, "tpu-service", n_nodes + 6)
+    inplace_rate = round(count / inplace_e2e, 1) if inplace_e2e else 0
+    rolled_rate = round(placed / e2e, 1) if e2e else 0
     return {
         "n_nodes": n_nodes, "existing": count,
         "inplace_updated": count,
         "inplace_e2e_ms": round(inplace_e2e * 1000, 2),
+        "inplace_updates_per_sec": inplace_rate,
         "rolled": placed, "max_parallel": _scaled(1000),
         "e2e_ms": round(e2e * 1000, 2),
+        "rolled_updates_per_sec": rolled_rate,
+        # Pass/fail bars (full 50k-node scale): conservative floors under
+        # the worst CPU-backend capture on record (BENCH_SELF_r04: in-place
+        # 10k/58ms ≈ 171k/s, rolled 1k/120ms ≈ 8.3k/s) — a regression
+        # below them means the update machinery got slower, not noisier.
+        # Only asserted at full scale: smoke runs shrink the task count
+        # faster than the fixed per-eval overheads they still pay.
+        "bar_inplace_updates_per_sec": CONFIG5_INPLACE_BAR,
+        "bar_rolled_updates_per_sec": CONFIG5_ROLLED_BAR,
+        "pass": (
+            None if n_nodes < 50_000
+            else bool(inplace_rate >= CONFIG5_INPLACE_BAR
+                      and rolled_rate >= CONFIG5_ROLLED_BAR)
+        ),
         # Phases mutate state (rolling update over the phase-1 allocs), so
         # each figure is a single sample; dispersion comes from the
         # repeatable configs.
@@ -1051,8 +1076,22 @@ def _cpu_fallback_headline():
             )
         except Exception as e:
             breakdown = {"error": f"{type(e).__name__}: {e}"}
+    # The BASELINE configs ride the fallback too (unless headline-only):
+    # a round whose relay never answers must still produce comparable
+    # config2/4/5 numbers, honestly backend-labeled, instead of losing
+    # the whole aux tier to the device tier's weather.
+    aux = {}
+    if not HEADLINE_ONLY:
+        for name, fn in (("config2", run_config2),
+                         ("config4", run_config4),
+                         ("config5", run_config5)):
+            try:
+                aux[name] = fn()
+            except Exception as e:
+                aux[name] = {"error": f"{type(e).__name__}: {e}"}
     return {
         **({"breakdown": breakdown} if breakdown is not None else {}),
+        **aux,
         "backend": fb_backend,
         "note": (
             f"measured on the {fb_backend} backend after device "

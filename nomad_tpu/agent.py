@@ -64,6 +64,11 @@ class AgentConfig:
     # a nomad_tpu.tlsutil.TLSConfig, or None for plaintext.
     tls: object = None
     tls_uplink: bool = False
+    # Deterministic fault-injection plan (nomad_tpu.faults): the
+    # ``faults{}`` config block as a {"seed": int, "sites": {...}} spec,
+    # armed at agent start; live reconfiguration rides the debug-gated
+    # /v1/agent/faults endpoint.
+    faults: Optional[Dict] = None
 
     @classmethod
     def dev(cls) -> "AgentConfig":
@@ -121,6 +126,10 @@ class AgentConfig:
             atlas_endpoint=fc.atlas.endpoint,
             tls=(_tls_from_block(fc.tls) if fc.tls.enabled else None),
             tls_uplink=_check_uplink_tls(fc.tls),
+            faults=(
+                {"seed": fc.faults.seed, "sites": dict(fc.faults.sites)}
+                if fc.faults.sites else None
+            ),
         )
 
 
@@ -280,6 +289,19 @@ class Agent:
             self.setup_logging()
         if getattr(self, "inmem_sink", None) is None:
             self.setup_telemetry()
+        if self.config.faults:
+            # Arm the configured fault plan BEFORE any subsystem starts so
+            # the very first heartbeat/RPC/solve is already under test.
+            # The registry is process-global (like the telemetry registry)
+            # — a validation error here must fail agent start loudly, not
+            # leave a half-armed plan.
+            from nomad_tpu import faults
+
+            faults.get_registry().load(self.config.faults)
+            self.logger.warning(
+                "fault injection armed: %s",
+                ", ".join(sorted(self.config.faults.get("sites", {}))),
+            )
         if self.server is not None:
             self.server.start()
         if self.config.client_enabled:

@@ -104,6 +104,27 @@ class Atlas:
 
 
 @dataclass
+class FaultsBlock:
+    """Deterministic fault-injection plan (nomad_tpu.faults) — a tpu-native
+    extension with no reference analog. ``sites`` maps a site name
+    (faults.SITES) to one rule mapping or a list of them::
+
+        faults {
+          seed = 42
+          sites {
+            "rpc.send" = { mode = "drop"  probability = 0.2 }
+            "solver.execute" = { mode = "error"  count = 5 }
+          }
+        }
+
+    Faults configured here arm at agent start; the debug-gated
+    ``/v1/agent/faults`` endpoint reconfigures them live."""
+
+    seed: int = 0
+    sites: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
 class TLSBlock:
     """TLS for the server RPC tier and the uplink tunnel (reference:
     nomad/tlsutil feeding the rpcTLS listener arm, nomad/rpc.go:104-110).
@@ -137,6 +158,7 @@ class FileConfig:
     telemetry: Telemetry = field(default_factory=Telemetry)
     atlas: Atlas = field(default_factory=Atlas)
     tls: TLSBlock = field(default_factory=TLSBlock)
+    faults: FaultsBlock = field(default_factory=FaultsBlock)
     leave_on_interrupt: bool = False
     leave_on_terminate: bool = False
     enable_syslog: bool = False
@@ -247,6 +269,12 @@ class FileConfig:
                              or self.tls.verify_hostname),
             uplink=other.tls.uplink or self.tls.uplink,
         )
+        out.faults = FaultsBlock(
+            seed=other.faults.seed or self.faults.seed,
+            # Site rules merge key-by-key like client.meta: a later file
+            # overrides a site's whole rule (list), never splices into it.
+            sites={**self.faults.sites, **other.faults.sites},
+        )
         return out
 
 
@@ -333,6 +361,16 @@ def _from_mapping(data: dict) -> FileConfig:
                 if not hasattr(cfg.tls, k):
                     raise ValueError(f"unknown tls config key {k!r}")
                 setattr(cfg.tls, k, v)
+        elif key == "faults":
+            for k, v in value.items():
+                if k == "seed":
+                    cfg.faults.seed = int(v)
+                elif k == "sites":
+                    if not isinstance(v, dict):
+                        raise ValueError("faults.sites must be a mapping")
+                    cfg.faults.sites.update(v)
+                else:
+                    raise ValueError(f"unknown faults config key {k!r}")
         else:
             raise ValueError(f"unknown agent config key {key!r}")
     return cfg

@@ -103,6 +103,7 @@ class HTTPServer:
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
             (r"^/v1/agent/debug$", self.agent_debug),
+            (r"^/v1/agent/faults$", self.agent_faults),
             (r"^/v1/agent/logs$", self.agent_logs),
             (r"^/v1/agent/members$", self.agent_members),
             (r"^/v1/agent/servers$", self.agent_servers),
@@ -422,6 +423,32 @@ class HTTPServer:
             raise HTTPCodedError(404, "debug endpoints disabled "
                                       "(set enable_debug)")
         return self.agent.debug_info(query), None
+
+    def agent_faults(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Deterministic fault injection (nomad_tpu.faults), gated by
+        enable_debug like /v1/agent/debug — an ungated fault surface on a
+        production agent would be an outage button.
+
+        GET returns the armed plan + per-rule fire counts; PUT/POST
+        REPLACES the armed plan with a ``{"seed": .., "sites": {site:
+        rule|[rules]}}`` spec (validated atomically — a typo'd site arms
+        nothing, and sites absent from the new plan are disarmed); DELETE
+        clears one site (``?site=``) or everything."""
+        if not getattr(self.agent, "debug_enabled", lambda: False)():
+            raise HTTPCodedError(404, "fault endpoints disabled "
+                                      "(set enable_debug)")
+        from nomad_tpu import faults
+
+        reg = faults.get_registry()
+        if req.command == "GET":
+            return reg.snapshot(), None
+        if req.command in ("PUT", "POST"):
+            reg.load(self._read_body(req))
+            return reg.snapshot(), None
+        if req.command == "DELETE":
+            reg.clear(query.get("site") or None)
+            return reg.snapshot(), None
+        raise HTTPCodedError(405, "method not allowed")
 
     def agent_logs(self, req, query) -> Tuple[Any, Optional[int]]:
         """Tail of the agent's circular log buffer (the reference streams

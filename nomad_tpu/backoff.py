@@ -1,0 +1,264 @@
+"""Shared retry discipline: jittered exponential backoff + circuit breaker.
+
+Before this module every retry loop in the control plane rolled its own
+sleep schedule — fixed 50/100ms sleeps in the worker's dequeue loops, a
+hand-unrolled doubling in wait_for_index, a flat 100ms in the cluster
+forwarder. Under injected faults those flat sleeps either hammer a down
+peer or oversleep a fast recovery; the jittered exponential here is the
+one policy all of them share: with d = min(cap, base*2^n), the sleep is
+drawn U(d*(1-jitter), d] — the AWS architecture-blog "equal jitter"
+family (jitter=0.5 by default; 1.0 gives full jitter) — so a thundering
+herd of workers retrying the same dead leader decorrelates while every
+retry still waits a floor that actually backs off.
+
+``retry_undelivered`` encodes the transport tier's ONE safe auto-retry
+rule: RPCUndeliveredError means the frame provably never reached the peer
+(rpc.py:78-83), so even non-idempotent calls replay safely; timeouts and
+lost responses (RPCTimeoutError, rpc.py:85-88) are NEVER auto-retried here
+— the request may have executed, and redelivery belongs to the layer that
+owns idempotency (the broker's nack machinery, raft-upsert semantics).
+
+``CircuitBreaker`` is the classic three-state machine (closed → open on N
+consecutive failures → half-open probe after a cooldown that itself backs
+off) used by tpu/solver.py to stop feeding evals to a dead device: while
+open, the scheduler factory routes straight to the host-oracle CPU path
+instead of failing every eval into the nack/delivery-limit reaper. State
+transitions are counted in telemetry (``<name>.to_<state>`` counters plus
+a ``<name>.state`` gauge: 0 closed / 1 half-open / 2 open) so a tripped
+breaker is visible in /v1/agent/metrics, not just in latency.
+"""
+
+from __future__ import annotations
+
+import random as _random
+import threading
+import time
+from random import Random
+from typing import Callable, Optional, Tuple
+
+from nomad_tpu import telemetry
+
+
+class Backoff:
+    """Jittered exponential backoff with an optional deadline.
+
+    next_delay() grows base * factor^n capped at max_delay, jittered by
+    drawing uniformly from [delay*(1-jitter), delay] ("equal jitter" at
+    the default jitter=0.5; jitter=1.0 is full jitter, 0 disables);
+    sleep() applies it and returns False once the deadline has expired
+    (callers use that as their give-up signal). reset() re-arms after a
+    success. A seeded ``rng`` makes the schedule deterministic for tests.
+    """
+
+    __slots__ = ("base", "max_delay", "factor", "jitter", "deadline",
+                 "attempts", "_rng")
+
+    def __init__(self, base: float = 0.05, max_delay: float = 2.0,
+                 factor: float = 2.0, jitter: float = 0.5,
+                 deadline: Optional[float] = None,
+                 rng: Optional[Random] = None):
+        self.base = base
+        self.max_delay = max_delay
+        self.factor = factor
+        self.jitter = jitter
+        # Absolute time.monotonic() stamp, or None for no deadline.
+        self.deadline = (
+            time.monotonic() + deadline if deadline is not None else None
+        )
+        self.attempts = 0
+        # None = the module's shared PRNG: Backoff objects are built on
+        # hot paths (one per wait_for_index call), and instantiating a
+        # fresh os.urandom-seeded Random there is a syscall + MT init
+        # that jitter=0 users never even draw from.
+        self._rng = rng
+
+    def reset(self) -> None:
+        self.attempts = 0
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+    def next_delay(self) -> float:
+        # Exponent capped: a worker soaking a no-leader period for hours
+        # keeps counting attempts, and float 2.0**1024 raises
+        # OverflowError — the cap saturates the growth far past any real
+        # max_delay without ever overflowing.
+        exp = min(self.attempts, 64)
+        delay = min(self.max_delay, self.base * (self.factor ** exp))
+        self.attempts += 1
+        if self.jitter > 0:
+            draw = (self._rng or _random).random()
+            delay *= 1.0 - self.jitter * draw
+        return delay
+
+    def sleep(self, stop: Optional[threading.Event] = None) -> bool:
+        """Sleep the next delay (clamped to the deadline). Returns True to
+        keep retrying, False when the deadline expired or ``stop`` was set
+        mid-sleep."""
+        if self.expired:
+            return False
+        delay = self.next_delay()
+        if self.deadline is not None:
+            delay = min(delay, max(self.deadline - time.monotonic(), 0.0))
+        if stop is not None:
+            if stop.wait(delay):
+                return False
+        else:
+            time.sleep(delay)
+        return not self.expired
+
+
+def retry_undelivered(fn: Callable, retries: int = 2,
+                      backoff: Optional[Backoff] = None):
+    """Run ``fn`` retrying ONLY provably-undelivered transport failures.
+
+    The distinction this encodes (rpc.py:78-88): RPCUndeliveredError means
+    the handler never ran — safe to replay even non-idempotent RPCs;
+    anything else (RemoteError, RPCTimeoutError, plain RPCError) may have
+    executed remotely and surfaces to the caller immediately.
+    """
+    from nomad_tpu.rpc import RPCUndeliveredError
+
+    bo = backoff or Backoff(base=0.05, max_delay=0.5)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except RPCUndeliveredError:
+            attempt += 1
+            if attempt > retries:
+                raise
+            telemetry.incr_counter(("rpc", "client", "retry_undelivered"))
+            if not bo.sleep():
+                raise
+
+
+# Circuit breaker states. Gauge values chosen so "bigger = less healthy".
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+_STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    """Three-state breaker with backoff-growing cooldown and half-open
+    probing.
+
+    - closed: all calls allowed; ``threshold`` consecutive failures trip it.
+    - open: allow() is False until ``cooldown`` elapses (cooldown doubles
+      per consecutive trip, capped at ``max_cooldown``), then the next
+      allow() transitions to half-open and grants ONE probe.
+    - half-open: exactly one in-flight probe; success closes the breaker
+      (and resets the cooldown), failure re-opens with a longer cooldown.
+      A probe that never reports (caller died mid-solve) is reclaimed
+      after ``cooldown`` so the breaker can't wedge half-open forever.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown: float = 15.0,
+                 max_cooldown: float = 300.0,
+                 name: Tuple[str, ...] = ("breaker",)):
+        self.threshold = int(threshold)
+        self.cooldown = float(cooldown)
+        self.max_cooldown = float(max_cooldown)
+        self.name = tuple(name)
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0          # consecutive failures while closed
+        self._trips = 0             # consecutive opens (grows the cooldown)
+        self._opened_at = 0.0
+        self._probe_started = 0.0   # half-open probe grant time (0 = none)
+
+    # -- inspection --------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._failures,
+                "trips": self._trips,
+                "threshold": self.threshold,
+                "cooldown": self._current_cooldown(),
+            }
+
+    # -- state machine -----------------------------------------------------
+
+    def _current_cooldown(self) -> float:
+        # Exponent capped like Backoff.next_delay: trips grow unbounded
+        # on a permanently-dead device and 2.0**1024 would overflow.
+        grown = self.cooldown * (2.0 ** min(max(0, self._trips - 1), 32))
+        return min(grown, self.max_cooldown)
+
+    def _transition(self, state: str) -> None:
+        # Lock held. Telemetry from inside the lock is fine: sinks are
+        # lock-cheap and transitions are rare by construction.
+        if state == self._state:
+            return
+        self._state = state
+        telemetry.incr_counter(self.name + (f"to_{state}",))
+        telemetry.set_gauge(self.name + ("state",), _STATE_GAUGE[state])
+
+    def allow(self) -> bool:
+        """Whether a call may take the guarded path right now. In open
+        state, the first caller after the cooldown gets the half-open
+        probe; everyone else keeps getting False until that probe
+        resolves."""
+        now = time.monotonic()
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if now - self._opened_at < self._current_cooldown():
+                    return False
+                self._transition(HALF_OPEN)
+                self._probe_started = now
+                return True
+            # half-open: one probe at a time. An abandoned probe (the
+            # granted eval never reached a device dispatch — a stop-only
+            # or deregister eval, or its caller died) reclaims after the
+            # BASE cooldown, not the trip-grown one: the grown cooldown
+            # paces re-probing a failing device, but a probe nobody
+            # resolved says nothing about the device and must not stall
+            # recovery for minutes.
+            if self._probe_started and (
+                now - self._probe_started < self.cooldown
+            ):
+                return False
+            self._probe_started = now
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_started = 0.0
+            if self._state != CLOSED:
+                self._trips = 0
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probe_started = 0.0
+            if self._state == HALF_OPEN:
+                # The probe failed: back off harder.
+                self._trips += 1
+                self._opened_at = time.monotonic()
+                self._transition(OPEN)
+                return
+            self._failures += 1
+            if self._state == CLOSED and self._failures >= self.threshold:
+                self._trips += 1
+                self._opened_at = time.monotonic()
+                self._transition(OPEN)
+
+    def reset(self) -> None:
+        """Force-close (tests, operator intervention)."""
+        with self._lock:
+            self._failures = 0
+            self._trips = 0
+            self._probe_started = 0.0
+            self._transition(CLOSED)

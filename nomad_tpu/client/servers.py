@@ -19,6 +19,7 @@ import threading
 from typing import List, Optional, Tuple
 
 from nomad_tpu.api.codec import from_dict, to_dict
+from nomad_tpu.backoff import Backoff
 from nomad_tpu.rpc import ConnPool, RPCError
 from nomad_tpu.structs import Allocation, Node
 
@@ -105,7 +106,18 @@ class RemoteEndpoint:
         for _ in range(len(self.servers)):
             addr = self.servers[0]
             try:
-                return self.pool.call(addr, method, args, timeout=timeout)
+                # One IMMEDIATE same-server replay for provably-
+                # undelivered frames (a severed pooled conn re-dials on
+                # retry; the handler never ran, rpc.py:78-83) BEFORE
+                # burning the rotation — a healthy server must not be
+                # skipped over a stale connection. No sleep: the replay
+                # either re-dials instantly or fails instantly, and a
+                # dead server should rotate without added latency.
+                # Timeouts/lost responses rotate immediately.
+                return self.pool.call_retry(
+                    addr, method, args, timeout=timeout, retries=1,
+                    backoff=Backoff(base=0.0, jitter=0.0),
+                )
             except RPCError as e:
                 last = e
                 # Rotate the failed server to the back (client.go:246-252)

@@ -43,6 +43,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from nomad_tpu import faults
 from nomad_tpu.raft.log_codec import decode_payload, encode_payload
 from nomad_tpu.rpc import ConnPool, RPCError, RPCServer, RemoteError
 
@@ -515,6 +516,13 @@ class RaftNode:
 
         def request(pid: str, addr: str) -> None:
             nonlocal votes
+            # Injected vote loss: the request never leaves this candidate
+            # (one edge, one direction — target "<self>-><peer>").
+            fault = faults.fire(
+                "raft.vote", target=f"{self.config.node_id}->{pid}"
+            )
+            if fault is not None and fault.mode in ("drop", "partition"):
+                return
             try:
                 resp = self.pool.call(addr, "Raft.RequestVote", {
                     "term": term,
@@ -649,6 +657,15 @@ class RaftNode:
                     for e in self.log[next_idx - self.log_offset - 1:]
                 ]
             commit = self.commit_index
+        # Injected append loss (covers the InstallSnapshot arm too: both
+        # are the leader's replication stream to this peer). A drop here is
+        # ordinary message loss — the next heartbeat retries, exactly the
+        # redundancy Raft's correctness argument assumes.
+        fault = faults.fire(
+            "raft.append", target=f"{self.config.node_id}->{pid}"
+        )
+        if fault is not None and fault.mode in ("drop", "partition"):
+            return
         if snap is not None:
             self._send_snapshot(pid, addr, term, *snap)
             return

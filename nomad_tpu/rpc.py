@@ -32,9 +32,15 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from nomad_tpu import telemetry
+from nomad_tpu import faults, telemetry
 
 _LEN = struct.Struct(">I")
+
+# Sentinel a dispatcher returns to swallow the response frame entirely —
+# the injected-fault path for "request executed, response lost" (the
+# caller then times out with RPCTimeoutError: possibly-executed, NOT
+# auto-retried). Organic code never returns it.
+SWALLOW_RESPONSE = object()
 MAX_FRAME = 64 << 20
 # Kernel-level send timeout (SO_SNDTIMEO): bounds sendall on a peer that
 # stopped reading WITHOUT touching recv (the demux reader blocks forever by
@@ -137,6 +143,8 @@ def serve_frames(
     def handle(req: Any) -> None:
         try:
             resp = dispatch(req)
+            if resp is SWALLOW_RESPONSE:
+                return
             try:
                 with write_lock:
                     _send_frame(conn, resp)
@@ -253,6 +261,29 @@ class RPCServer:
         # + per-method MeasureSince at the endpoint handlers).
         seq = req.get("seq")
         method = req.get("method", "")
+        fault = faults.fire("rpc.recv", target=method)
+        if fault is not None:
+            if fault.mode == "drop":
+                # Execute, then lose the response: the caller's deadline
+                # expires with the request POSSIBLY EXECUTED — the
+                # RPCTimeoutError half of the retry-safety distinction.
+                handler = self._handlers.get(method)
+                if handler is not None:
+                    try:
+                        handler(req.get("args", {}))
+                    except Exception:
+                        pass
+                return SWALLOW_RESPONSE
+            if fault.mode == "partition":
+                # The request silently never arrives (handler NOT run):
+                # like every other site's partition, loss — never a fast
+                # explicit error. The caller still times out, and from
+                # its side that is indistinguishable from a lost
+                # response, exactly as with a real partition.
+                return SWALLOW_RESPONSE
+            if fault.mode == "error":
+                return {"seq": seq, "error": "injected fault: rpc.recv",
+                        "result": None}
         handler = self._handlers.get(method)
         telemetry.incr_counter(("rpc", "request"))
         if handler is None:
@@ -354,6 +385,17 @@ class ConnPool:
         transport failures (after invalidating the pooled conn). A per-call
         timeout does NOT kill the shared connection — the late response is
         simply dropped by the demuxer."""
+        fault = faults.fire("rpc.send", target=f"{addr} {method}")
+        if fault is not None:
+            if fault.mode in ("drop", "partition"):
+                # The frame never goes out: provably undelivered, so the
+                # injected failure is retry-safe exactly like a connect
+                # failure (the distinction callers' retry policies key on).
+                raise RPCUndeliveredError(
+                    f"injected fault: rpc.send to {addr} dropped"
+                )
+            if fault.mode == "error":
+                raise RPCError(f"injected fault: rpc.send to {addr}")
         mux = self._acquire(addr)
         with self._lock:
             self._seq += 1
@@ -379,6 +421,23 @@ class ConnPool:
         if resp.get("error"):
             raise RemoteError(resp["error"])
         return resp.get("result")
+
+    def call_retry(self, addr: str, method: str, args: dict,
+                   timeout: Optional[float] = None, retries: int = 2,
+                   backoff=None):
+        """``call`` with the transport tier's one safe auto-retry: only
+        RPCUndeliveredError (the handler provably never ran, rpc.py:78-83)
+        is replayed, under jittered backoff (or a caller-supplied
+        ``backoff`` — a severed-conn single replay wants no sleep at all).
+        RPCTimeoutError and lost responses surface immediately — the
+        request may have executed, and redelivery belongs to the caller's
+        idempotency machinery (broker nacks, raft-upsert semantics)."""
+        from nomad_tpu.backoff import retry_undelivered
+
+        return retry_undelivered(
+            lambda: self.call(addr, method, args, timeout=timeout),
+            retries=retries, backoff=backoff,
+        )
 
     def _acquire(self, addr: str) -> _MuxConn:
         with self._lock:

@@ -96,6 +96,24 @@ import os as _os
 import threading as _threading
 import time as _time
 
+from nomad_tpu.backoff import CircuitBreaker
+
+# Device circuit breaker: after N consecutive DEVICE errors mid-solve
+# (XLA/transport faults or injected solver.execute faults — counted by
+# tpu/solver.py around each dispatch), the scheduler factory stops
+# routing evals to the device and takes the host-oracle CPU path (same
+# placements, scalar speed) instead of failing every eval into the
+# broker's nack/delivery-limit reaper. After the cooldown, ONE half-open
+# probe eval rides the device path again: success closes the breaker,
+# failure re-opens it with a doubled cooldown. Transitions are visible in
+# /v1/agent/metrics (solver.breaker.to_open / to_half_open / to_closed
+# counters + solver.breaker.state gauge) and in solver_stats().
+DEVICE_BREAKER = CircuitBreaker(
+    threshold=int(_os.environ.get("NOMAD_TPU_BREAKER_THRESHOLD", "3")),
+    cooldown=float(_os.environ.get("NOMAD_TPU_BREAKER_COOLDOWN", "15")),
+    name=("solver", "breaker"),
+)
+
 # Grace the FIRST caller gives the manager before falling back to the host
 # solver (single-threaded flows — tests, dev agents — stay on the device
 # path without a warm-up blip; concurrent callers never block).
@@ -276,6 +294,17 @@ def _register_builtins() -> None:
     def _lazy_tpu(variant: str) -> Factory:
         def factory(state, planner, logger):
             solver = _tpu_solver(logger)
+            if solver is not None and not DEVICE_BREAKER.allow():
+                # Breaker open: the device is failing solves. Degrade to
+                # the host oracle for this eval instead of burning one of
+                # its delivery attempts on a dead device; allow() hands
+                # the post-cooldown half-open probe to exactly one eval.
+                from nomad_tpu import telemetry
+
+                telemetry.incr_counter(
+                    ("scheduler", "device", "breaker_fallback")
+                )
+                solver = None
             if solver is None:
                 from nomad_tpu import telemetry
 

@@ -251,13 +251,23 @@ class ClusterServer(Server):
         Undelivered requests (stale leader address across an election, a
         connection the peer closed before the frame went out) are retried
         twice against the freshly-discovered leader — the handler provably
-        never ran, so even non-idempotent RPCs are safe to replay.
-        Timeouts and lost responses are NOT retried: the request may have
-        executed, and the delivery guarantees belong to the caller (the
-        broker's Nack machinery, raft-upsert idempotency)."""
+        never ran, so even non-idempotent RPCs are safe to replay (the
+        RPCUndeliveredError contract, rpc.py:78-83; policy shared with
+        backoff.retry_undelivered). Timeouts and lost responses are NOT
+        retried: the request may have executed, and the delivery
+        guarantees belong to the caller (the broker's Nack machinery,
+        raft-upsert idempotency)."""
         import time as _time
 
+        from nomad_tpu.backoff import Backoff
+
         deadline = _time.monotonic() + 1.0
+        # Jittered, not flat: every follower worker forwarding to a dead
+        # leader retries on this path at once, and the decorrelation is
+        # what keeps the freshly-elected leader from absorbing a synchro-
+        # nized thundering herd.
+        retry_bo = Backoff(base=0.05, max_delay=0.5)
+        discover_bo = Backoff(base=0.02, max_delay=0.2)
         # At most one retry per address: a severed-but-healthy leader conn
         # reconnects on the first retry; a blackholed leader (connect
         # timeout) must not burn attempt x connect-timeout before failing.
@@ -274,11 +284,11 @@ class ClusterServer(Server):
                         raise
                     undelivered_to[leader] = 1
                     deadline = _time.monotonic() + 1.0
-                    _time.sleep(0.1)
+                    retry_bo.sleep()
                     continue
             if self.raft.is_leader or _time.monotonic() >= deadline:
                 raise NotLeaderError("")
-            _time.sleep(0.02)
+            discover_bo.sleep()
 
     # -- overridden server seams ----------------------------------------------
 

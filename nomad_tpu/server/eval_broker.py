@@ -28,7 +28,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from nomad_tpu import telemetry, trace
+from nomad_tpu import faults, telemetry, trace
 from nomad_tpu.structs import Evaluation, generate_uuid
 
 FAILED_QUEUE = "_failed"
@@ -264,6 +264,13 @@ class EvalBroker:
         """Blocking dequeue of the highest-priority ready eval for any of the
         given scheduler types (eval_broker.go:214-246). Returns (None, "")
         on timeout."""
+        # Injected dequeue failure/stall BEFORE the lock: the worker's
+        # dequeue loop sees exactly what a leader-transition blip looks
+        # like (BrokerError -> backoff + retry), and a delay never holds
+        # the broker lock against acks/nacks.
+        fault = faults.fire("broker.dequeue", target=",".join(schedulers))
+        if fault is not None and fault.mode in ("error", "drop"):
+            raise BrokerError("injected fault: broker.dequeue")
         deadline = None
         with self._lock:
             while True:

@@ -20,7 +20,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
 
-from nomad_tpu import telemetry, trace
+from nomad_tpu import faults, telemetry, trace
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import Allocation, Evaluation, Job, Node
 
@@ -60,6 +60,10 @@ class FSM:
         handler = self._handlers.get(msg_type)
         if handler is None:
             raise ValueError(f"failed to apply request: unknown type {msg_type!r}")
+        # Injected apply stall (mode 'delay' only — fire() sleeps it; an
+        # injected ERROR would make a deterministic FSM diverge per
+        # replica, which is not a failure mode production exhibits).
+        faults.fire("fsm.apply", target=msg_type)
         # Per-message-type apply timing (reference: nomad/fsm.go:148
         # `defer metrics.MeasureSince([]string{"nomad","fsm",...})`), plus
         # a child span when the applying thread carries one (the plan

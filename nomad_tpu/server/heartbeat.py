@@ -13,6 +13,7 @@ import random
 import threading
 from typing import Dict
 
+from nomad_tpu import faults
 from nomad_tpu.structs import NODE_STATUS_DOWN
 
 
@@ -33,6 +34,23 @@ class HeartbeatManager:
         """(Re)arm the TTL timer for a node; returns the granted TTL
         (heartbeat.go:13-54)."""
         cfg = self.server.config
+        # Injected missed beat: discard a RENEWAL so the already-armed TTL
+        # keeps running toward expiry — the node-down eval fan-out path
+        # (heartbeat.go:84-104) driven on demand. Only renewals are
+        # droppable: the initial arm must happen or no TTL timer exists to
+        # expire and the node would sit unmonitored forever (the opposite
+        # of a missed beat). The 0.0 returned here is DISCARDED by the
+        # client (`if ttl:` in client.py), which keeps beating at its
+        # stale cadence — so one dropped renewal only races the old timer
+        # against the next beat; deterministically downing a node needs a
+        # PERSISTENT drop rule (probability 1, no count), which starves
+        # the timer until it fires. Matches a renewal lost in flight.
+        with self._lock:
+            has_timer = node_id in self._timers
+        if has_timer:
+            fault = faults.fire("heartbeat.tick", target=node_id)
+            if fault is not None and fault.mode in ("drop", "partition"):
+                return 0.0
         with self._lock:
             existing = self._timers.pop(node_id, None)
             if existing is not None:

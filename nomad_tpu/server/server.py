@@ -619,9 +619,10 @@ class Server:
         path (host fallback: same placements, order-of-magnitude latency
         cliff) is operator-visible. Metrics posture mirrors the
         reference's broker stats (nomad/eval_broker.go:557-575)."""
-        from nomad_tpu.scheduler import device_probe_status
+        from nomad_tpu.scheduler import DEVICE_BREAKER, device_probe_status
 
-        out: Dict = {"device": device_probe_status()}
+        out: Dict = {"device": device_probe_status(),
+                     "breaker": DEVICE_BREAKER.stats()}
         try:
             import sys
 

@@ -14,6 +14,7 @@ import time
 from typing import Optional, Tuple
 
 from nomad_tpu import telemetry, trace
+from nomad_tpu.backoff import Backoff
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.server.eval_broker import BrokerError
 from nomad_tpu.structs import JOB_TYPE_CORE, Evaluation, Plan, PlanResult
@@ -37,6 +38,15 @@ class Worker(threading.Thread):
         self._snapshot = None
         # Size of the most recent broker batch drain (observability/tests)
         self.last_batch_size = 0
+        # Shared jittered backoff for dequeue failures (broker disabled,
+        # leader-forwarding blips, injected broker.dequeue faults): resets
+        # on any successful dequeue so a healthy broker pays nothing, and
+        # decorrelates N workers hammering the same recovering leader.
+        # max_delay deliberately small: a worker mid-sleep when leadership
+        # returns adds this much to first-eval pickup after failover, so
+        # the cap trades retry rate (<=4/s/worker while down) against
+        # recovery latency (<=0.25s added).
+        self._dequeue_backoff = Backoff(base=0.05, max_delay=0.25)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -203,13 +213,14 @@ class Worker(threading.Thread):
                 self.server.config.enabled_schedulers, timeout=DEQUEUE_TIMEOUT
             )
         except BrokerError:
-            time.sleep(0.05)
+            self._dequeue_backoff.sleep(stop=self._stop)
             return None
         except Exception as e:
             # Transient cluster conditions (no leader yet, forwarding error)
             self.logger.debug("dequeue failed, retrying: %s", e)
-            time.sleep(0.1)
+            self._dequeue_backoff.sleep(stop=self._stop)
             return None
+        self._dequeue_backoff.reset()
         if ev is None:
             return None
         telemetry.measure_since(("worker", "dequeue_eval"), start)
@@ -224,12 +235,13 @@ class Worker(threading.Thread):
                 timeout=DEQUEUE_TIMEOUT,
             )
         except BrokerError:
-            time.sleep(0.05)
+            self._dequeue_backoff.sleep(stop=self._stop)
             return []
         except Exception as e:
             self.logger.debug("batch dequeue failed, retrying: %s", e)
-            time.sleep(0.1)
+            self._dequeue_backoff.sleep(stop=self._stop)
             return []
+        self._dequeue_backoff.reset()
         if batch:
             telemetry.measure_since(("worker", "dequeue_eval"), start)
             self.logger.debug(
@@ -260,16 +272,15 @@ class Worker(threading.Thread):
         """Spin until the FSM has applied ``index`` (worker.go:204-230).
         Timing recorded as nomad.worker.wait_for_index (worker.go:212)."""
         t0 = time.perf_counter()
-        start = time.monotonic()
-        delay = 0.001
+        bo = Backoff(base=0.001, max_delay=0.1, jitter=0.0, deadline=timeout)
+        alive = True
         while True:
             if self.server.raft.applied_index >= index:
                 telemetry.measure_since(("worker", "wait_for_index"), t0)
                 return
-            if time.monotonic() - start > timeout:
+            if not alive:
                 raise TimeoutError("sync wait timeout reached")
-            time.sleep(delay)
-            delay = min(delay * 2, 0.1)
+            alive = bo.sleep()  # one final index check after expiry
 
     def _invoke_scheduler(self, ev: Evaluation, token: str,
                           planner: Optional["_EvalRun"] = None) -> bool:

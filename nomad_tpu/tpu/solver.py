@@ -24,14 +24,16 @@ from __future__ import annotations
 
 import logging
 import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
-from nomad_tpu import telemetry, trace
+from nomad_tpu import faults, telemetry, trace
 from nomad_tpu.network import NetworkIndex
 from nomad_tpu.ops.binpack import device_const, solve_counts_async, solve_many_async
+from nomad_tpu.scheduler import DEVICE_BREAKER
 from nomad_tpu.scheduler.context import EvalContext
 from nomad_tpu.scheduler.feasible import _has_distinct_hosts
 from nomad_tpu.scheduler.generic import GenericScheduler
@@ -100,6 +102,42 @@ def _new_ids_seed() -> int:
     import os as _os
 
     return int.from_bytes(_os.urandom(16), "little")
+
+
+# What counts as a DEVICE failure for the circuit breaker: XLA runtime
+# errors (jaxlib's XlaRuntimeError subclasses RuntimeError), transport
+# errors to a tunneled device (OSError), and injected DeviceFault — which
+# records itself before raising. Deliberately NOT Exception: a
+# deterministic host-side bug (TypeError/ValueError in staging code) must
+# propagate and fail loudly, not trip the breaker and silently reroute
+# every eval to the host path where the differential tests can no longer
+# see it.
+_DEVICE_ERRORS = (RuntimeError, OSError, SystemError)
+
+
+@contextmanager
+def _device_dispatch():
+    """Breaker accounting around one device dispatch+readback: device-class
+    errors feed the breaker and re-raise; success closes/holds it. The ONE
+    definition all dispatch sites share, so what 'counts as a device
+    error' can never drift between them."""
+    try:
+        yield
+    except _DEVICE_ERRORS:
+        DEVICE_BREAKER.record_failure()
+        raise
+    DEVICE_BREAKER.record_success()
+
+
+def _check_device_fault(target: str) -> None:
+    """Injected device death at the ``solver.execute`` site: counts against
+    the circuit breaker exactly like an organic dispatch failure, then
+    raises. The eval fails, is nacked, and redelivers; once the breaker
+    trips, the factory routes redeliveries to the host-oracle path."""
+    fault = faults.fire("solver.execute", target=target)
+    if fault is not None and fault.mode in ("error", "drop", "partition"):
+        DEVICE_BREAKER.record_failure()
+        raise faults.DeviceFault("injected fault: solver.execute")
 
 
 def _solve_stages() -> "trace.StageTimer":
@@ -210,17 +248,19 @@ class TPUStack:
                 _emit_solver_trace(st, start, count)
                 return None, None, tg_constr.size
 
-            with st.stage("transfer"):
-                fetch = solve_many_async(
-                    self.mirror.total, self.mirror.sched_cap, prep.used,
-                    prep.job_count, prep.tg_count, self.mirror.bw_avail,
-                    prep.bw_used, prep.mask, prep.ask, prep.bw_ask, count,
-                    self.penalty, job_distinct=prep.job_distinct,
-                    tg_distinct=prep.tg_distinct,
-                )
-            if overlap is not None:
-                overlap()
-            idxs, oks = fetch()
+            _check_device_fault(tg.name)
+            with _device_dispatch():
+                with st.stage("transfer"):
+                    fetch = solve_many_async(
+                        self.mirror.total, self.mirror.sched_cap, prep.used,
+                        prep.job_count, prep.tg_count, self.mirror.bw_avail,
+                        prep.bw_used, prep.mask, prep.ask, prep.bw_ask, count,
+                        self.penalty, job_distinct=prep.job_distinct,
+                        tg_distinct=prep.tg_distinct,
+                    )
+                if overlap is not None:
+                    overlap()
+                idxs, oks = fetch()
         self.ctx.metrics().allocation_time = time.perf_counter() - start
         _emit_solver_trace(st, start, count)
         return idxs, oks, tg_constr.size
@@ -244,17 +284,19 @@ class TPUStack:
                 _emit_solver_trace(st, start, count)
                 return None, count, tg_constr.size
 
-            with st.stage("transfer"):
-                fetch = solve_counts_async(
-                    self.mirror.total, self.mirror.sched_cap, prep.used,
-                    prep.job_count, prep.tg_count, self.mirror.bw_avail,
-                    prep.bw_used, prep.mask, prep.ask, prep.bw_ask, count,
-                    self.penalty, job_distinct=prep.job_distinct,
-                    tg_distinct=prep.tg_distinct,
-                )
-            if overlap is not None:
-                overlap()
-            counts, unplaced = fetch()
+            _check_device_fault(tg.name)
+            with _device_dispatch():
+                with st.stage("transfer"):
+                    fetch = solve_counts_async(
+                        self.mirror.total, self.mirror.sched_cap, prep.used,
+                        prep.job_count, prep.tg_count, self.mirror.bw_avail,
+                        prep.bw_used, prep.mask, prep.ask, prep.bw_ask, count,
+                        self.penalty, job_distinct=prep.job_distinct,
+                        tg_distinct=prep.tg_distinct,
+                    )
+                if overlap is not None:
+                    overlap()
+                counts, unplaced = fetch()
         self.ctx.metrics().allocation_time = time.perf_counter() - start
         _emit_solver_trace(st, start, count)
         return counts, unplaced, tg_constr.size
@@ -1383,19 +1425,22 @@ class TPUSystemScheduler(SystemScheduler):
         prep = self.stack.prepare(tg, tg_constr)
         if prep is None:
             return None
-        ask, bw_ask, zero = prep.ask, prep.bw_ask, jnp.float32(0.0)
-        mesh = mesh_lib.mesh_for_nodes(mirror.total.shape[0])
-        if mesh is not None:
-            ask, bw_ask, zero = mesh_lib.replicate_on_mesh(
-                mesh, ask, bw_ask, zero
+        _check_device_fault(tg.name)
+        with _device_dispatch():
+            ask, bw_ask, zero = prep.ask, prep.bw_ask, jnp.float32(0.0)
+            mesh = mesh_lib.mesh_for_nodes(mirror.total.shape[0])
+            if mesh is not None:
+                ask, bw_ask, zero = mesh_lib.replicate_on_mesh(
+                    mesh, ask, bw_ask, zero
+                )
+            _score, fit = _greedy_step_state(
+                mirror.total, mirror.sched_cap, prep.used, prep.job_count,
+                prep.tg_count, mirror.bw_avail, prep.bw_used, prep.mask,
+                ask, bw_ask, zero,
+                prep.job_distinct, prep.tg_distinct,
             )
-        _score, fit = _greedy_step_state(
-            mirror.total, mirror.sched_cap, prep.used, prep.job_count,
-            prep.tg_count, mirror.bw_avail, prep.bw_used, prep.mask,
-            ask, bw_ask, zero,
-            prep.job_distinct, prep.tg_distinct,
-        )
-        return prep, np.asarray(fit)
+            fit_np = np.asarray(fit)
+        return prep, fit_np
 
     def compute_job_allocs(self) -> None:
         if self._fresh_columnar_allocs():

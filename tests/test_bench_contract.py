@@ -59,6 +59,26 @@ def test_fallback_measurement_inside_parsed_json():
     assert payload["pallas"] in {"off", "untried", "proven", "fallback",
                                  "unknown"}
     _check_breakdown(fb["breakdown"])
+    # The BASELINE configs ride the fallback line too: a dead relay must
+    # not cost the round its config2/4/5 comparables.
+    for name in ("config2", "config4", "config5"):
+        assert name in fb, f"fallback payload missing {name}"
+        assert "error" not in fb[name], fb[name]
+    _check_config5(fb["config5"])
+
+
+def _check_config5(c5):
+    """Config-5 must state its rates AND its pass/fail bars; at reduced
+    (smoke) scale the verdict abstains rather than judging scaled-down
+    rates against full-scale bars."""
+    assert c5["inplace_updates_per_sec"] > 0
+    assert c5["rolled_updates_per_sec"] > 0
+    assert c5["bar_inplace_updates_per_sec"] > 0
+    assert c5["bar_rolled_updates_per_sec"] > 0
+    if c5["n_nodes"] < 50_000:
+        assert c5["pass"] is None
+    else:
+        assert isinstance(c5["pass"], bool)
 
 
 def _check_breakdown(sweep):
@@ -80,3 +100,4 @@ def test_allow_cpu_smoke_run_succeeds():
     assert payload["backend"] == "cpu"
     assert "error" not in payload
     _check_breakdown(payload["breakdown"])
+    _check_config5(payload["config5"])

@@ -1,0 +1,856 @@
+"""Deterministic fault injection + graceful degradation.
+
+Unit tier: the registry's seeded decision streams (the reproducibility
+contract), the shared backoff/retry policy, the circuit breaker's state
+machine, and each named site's injection semantics.
+
+Chaos tier: seeded end-to-end scenarios over real clusters — 20% RPC drop
+under load, a one-way leader partition mid-plan, and device death
+mid-solve — asserting the exactly-once invariants the reference's failure
+machinery exists for: no placement lost or duplicated, every eval reaches
+a terminal status (or the _failed reaper), no node overcommitted.
+Reference posture: nomad/eval_broker.go nack/delivery-limit redelivery,
+nomad/plan_apply.go serialized verification, nomad/leader.go failover.
+"""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import faults, mock, structs, telemetry
+from nomad_tpu.backoff import Backoff, CircuitBreaker, retry_undelivered
+from nomad_tpu.rpc import (
+    ConnPool,
+    RPCError,
+    RPCServer,
+    RPCTimeoutError,
+    RPCUndeliveredError,
+    RemoteError,
+)
+
+CHAOS_SEED = int(os.environ.get("NOMAD_TPU_CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """The registry is process-global (like telemetry): every test starts
+    and ends unarmed, and the device breaker is force-closed so a tripped
+    state can't leak across tests."""
+    faults.get_registry().clear()
+    yield
+    faults.get_registry().clear()
+    from nomad_tpu.scheduler import DEVICE_BREAKER
+
+    DEVICE_BREAKER.reset()
+
+
+# ---------------------------------------------------------------------------
+# Registry: determinism, scoping, lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_registry_same_seed_same_decisions():
+    """The acceptance contract: with a fixed seed the n-th check at a site
+    decides identically, run after run — the per-site decision trace is a
+    pure function of (seed, site, ordinal)."""
+    def trace_of(seed):
+        reg = faults.FaultRegistry(seed=seed)
+        for site in ("rpc.send", "raft.append", "broker.dequeue"):
+            reg.configure(site, mode="drop", probability=0.3)
+        return {
+            site: [bool(reg.check(site, "t")) for _ in range(50)]
+            for site in ("rpc.send", "raft.append", "broker.dequeue")
+        }
+
+    t1, t2 = trace_of(1234), trace_of(1234)
+    assert t1 == t2
+    # Sites draw from independent streams: traces differ across sites.
+    assert len({tuple(v) for v in t1.values()}) > 1
+    # And a different seed produces a different plan.
+    assert trace_of(99) != t1
+
+
+def test_registry_site_isolation():
+    """Adding a rule at one site must not shift another site's decision
+    sequence (the site-salted seed contract)."""
+    reg1 = faults.FaultRegistry(seed=7)
+    reg1.configure("rpc.send", mode="drop", probability=0.5)
+    solo = [bool(reg1.check("rpc.send")) for _ in range(30)]
+
+    reg2 = faults.FaultRegistry(seed=7)
+    reg2.configure("rpc.send", mode="drop", probability=0.5)
+    reg2.configure("fsm.apply", mode="delay", delay=0.001)
+    interleaved = []
+    for _ in range(30):
+        reg2.check("fsm.apply")
+        interleaved.append(bool(reg2.check("rpc.send")))
+    assert interleaved == solo
+
+
+def test_rule_count_duration_match():
+    reg = faults.FaultRegistry()
+    reg.configure("rpc.send", mode="drop", count=2)
+    assert [bool(reg.check("rpc.send")) for _ in range(4)] == [
+        True, True, False, False,
+    ]
+    reg.clear()
+    reg.configure("rpc.send", mode="drop", duration=0.05)
+    assert reg.check("rpc.send") is not None
+    time.sleep(0.08)
+    assert reg.check("rpc.send") is None
+    reg.clear()
+    # match scopes to an edge: only matching targets fire, but each check
+    # still consumes one draw (ordinal alignment).
+    reg.configure("raft.append", mode="drop", match="a->b")
+    assert reg.check("raft.append", "a->b") is not None
+    assert reg.check("raft.append", "a->c") is None
+    assert reg.check("raft.append", "b->a") is None
+
+
+def test_exhausted_rules_retire_but_keep_forensics():
+    """Once every rule spends its count/duration budget the registry
+    deactivates (fire() back to one global read, no lock) while
+    snapshot() keeps the spent rules' fired counts for the chaos run's
+    forensics."""
+    reg = faults.FaultRegistry()
+    reg.configure("rpc.send", mode="drop", count=2)
+    assert reg.active
+    assert reg.check("rpc.send") is not None
+    assert reg.check("rpc.send") is not None
+    assert not reg.active  # budget spent on the firing check itself
+    assert reg.check("rpc.send") is None
+    snap = reg.snapshot()
+    assert snap["sites"]["rpc.send"][0]["fired"] == 2  # forensics kept
+    reg.clear("rpc.send")
+    assert reg.snapshot()["sites"] == {}
+
+
+def test_load_validates_atomically():
+    reg = faults.FaultRegistry()
+    with pytest.raises(ValueError):
+        reg.load({"sites": {"rpc.send": {"mode": "drop"},
+                            "no.such.site": {"mode": "drop"}}})
+    # Nothing armed: the good site must not have been half-applied.
+    assert not reg.active
+    with pytest.raises(ValueError):
+        reg.load({"sites": {"rpc.send": {"mode": "frobnicate"}}})
+    reg.load({"seed": 3, "sites": {
+        "rpc.send": [{"mode": "drop", "probability": 0.5},
+                     {"mode": "delay", "delay": 0.001}],
+    }})
+    snap = reg.snapshot()
+    assert snap["seed"] == 3 and len(snap["sites"]["rpc.send"]) == 2
+
+
+def test_fire_counts_telemetry():
+    faults.get_registry().load(
+        {"sites": {"broker.dequeue": {"mode": "error", "count": 1}}}
+    )
+    assert faults.fire("broker.dequeue") is not None
+    assert faults.fire("broker.dequeue") is None
+    sink = telemetry.get_global().sink
+    if hasattr(sink, "cumulative"):
+        counters, _ = sink.cumulative()
+        assert any("faults.broker.dequeue.error" in k for k in counters)
+
+
+# ---------------------------------------------------------------------------
+# Backoff + retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_growth_cap_and_jitter():
+    from random import Random
+
+    bo = Backoff(base=0.1, max_delay=0.5, factor=2.0, jitter=0.0)
+    assert [bo.next_delay() for _ in range(4)] == [0.1, 0.2, 0.4, 0.5]
+    bo.reset()
+    assert bo.next_delay() == 0.1
+
+    jittered = Backoff(base=0.1, max_delay=10.0, jitter=0.5,
+                       rng=Random(42))
+    for n in range(6):
+        d = jittered.next_delay()
+        full = 0.1 * (2.0 ** n)
+        assert 0.5 * full <= d <= full
+
+
+def test_backoff_deadline():
+    bo = Backoff(base=0.01, max_delay=0.02, deadline=0.05)
+    t0 = time.monotonic()
+    while bo.sleep():
+        pass
+    assert bo.expired
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_retry_undelivered_policy():
+    """ONLY provably-undelivered failures replay (rpc.py:78-88): the
+    undelivered path retries to success; timeout and remote errors
+    surface immediately."""
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RPCUndeliveredError("nope")
+        return "ok"
+
+    assert retry_undelivered(
+        flaky, retries=3, backoff=Backoff(base=0.001, max_delay=0.002)
+    ) == "ok"
+    assert calls["n"] == 3
+
+    def timed_out():
+        calls["n"] += 1
+        raise RPCTimeoutError("maybe executed")
+
+    calls["n"] = 0
+    with pytest.raises(RPCTimeoutError):
+        retry_undelivered(timed_out, retries=3)
+    assert calls["n"] == 1  # never retried
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trip_halfopen_recover():
+    br = CircuitBreaker(threshold=3, cooldown=0.05, name=("t", "breaker"))
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # below threshold
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    time.sleep(0.06)
+    # First caller after cooldown gets the half-open probe; others wait.
+    assert br.allow() and br.state == "half_open"
+    assert not br.allow()
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_failed_probe_reopens_with_longer_cooldown():
+    br = CircuitBreaker(threshold=1, cooldown=0.05, name=("t", "breaker2"))
+    br.record_failure()
+    assert br.state == "open"
+    time.sleep(0.06)
+    assert br.allow()  # half-open probe
+    br.record_failure()
+    assert br.state == "open"
+    # Cooldown doubled: the original 0.05 is no longer enough.
+    time.sleep(0.06)
+    assert not br.allow()
+    time.sleep(0.06)
+    assert br.allow()
+
+
+def test_backoff_and_cooldown_never_overflow():
+    """A worker soaking a no-leader period for hours keeps counting
+    attempts; float 2.0**1024 raises OverflowError — the exponent caps."""
+    bo = Backoff(base=0.001, max_delay=0.01, jitter=0.0)
+    bo.attempts = 5000
+    assert bo.next_delay() == 0.01
+    br = CircuitBreaker(threshold=1, cooldown=0.1, max_cooldown=5.0,
+                        name=("t", "breaker_ovf"))
+    br._trips = 5000
+    assert br._current_cooldown() == 5.0
+
+
+def test_host_side_bug_does_not_feed_breaker(monkeypatch):
+    """Only device-class errors (RuntimeError/OSError + DeviceFault) count
+    toward the breaker: a deterministic host-side bug must propagate and
+    fail loudly, not silently reroute every eval to the host path."""
+    from sched_harness import Harness
+
+    from nomad_tpu import mock as mock_mod
+    from nomad_tpu.scheduler import DEVICE_BREAKER
+    from nomad_tpu.structs import EVAL_TRIGGER_JOB_REGISTER, Evaluation, \
+        generate_uuid
+    from nomad_tpu.tpu import solver as solver_mod
+
+    def boom(*a, **k):
+        raise TypeError("host-side staging bug")
+
+    monkeypatch.setattr(solver_mod, "solve_many_async", boom)
+    DEVICE_BREAKER.reset()
+    h = Harness()
+    node = mock_mod.node()
+    h.state.upsert_node(h.next_index(), node)
+    job = mock_mod.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = Evaluation(
+        id=generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+    with pytest.raises(TypeError, match="host-side staging bug"):
+        h.process(f"tpu-{job.type}", ev)
+    assert DEVICE_BREAKER.stats()["consecutive_failures"] == 0
+    assert DEVICE_BREAKER.state == "closed"
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(threshold=2, cooldown=60.0, name=("t", "breaker3"))
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"  # never two CONSECUTIVE failures
+
+
+# ---------------------------------------------------------------------------
+# Site semantics: rpc.send / rpc.recv
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def echo_server():
+    srv = RPCServer()
+    hits = []
+
+    def echo(args):
+        hits.append(args)
+        return {"hi": args.get("name")}
+
+    srv.register("Echo.Hello", echo)
+    srv.start()
+    pool = ConnPool(timeout=1.0)
+    yield srv, pool, hits
+    pool.shutdown()
+    srv.shutdown()
+
+
+def test_rpc_send_drop_is_undelivered(echo_server):
+    srv, pool, hits = echo_server
+    faults.get_registry().configure("rpc.send", mode="drop", count=1)
+    with pytest.raises(RPCUndeliveredError):
+        pool.call(srv.addr, "Echo.Hello", {"name": "x"})
+    assert hits == []  # provably never dispatched
+    # Rule exhausted: traffic flows again.
+    assert pool.call(srv.addr, "Echo.Hello", {"name": "y"})["hi"] == "y"
+
+
+def test_rpc_send_error_and_partition_match(echo_server):
+    srv, pool, _ = echo_server
+    faults.get_registry().configure("rpc.send", mode="error", count=1)
+    with pytest.raises(RPCError):
+        pool.call(srv.addr, "Echo.Hello", {"name": "x"})
+    # A partition matched to a different address never fires here.
+    faults.get_registry().clear()
+    faults.get_registry().configure(
+        "rpc.send", mode="partition", match="203.0.113.9:1"
+    )
+    assert pool.call(srv.addr, "Echo.Hello", {"name": "z"})["hi"] == "z"
+
+
+def test_rpc_recv_drop_times_out_after_executing(echo_server):
+    """The possibly-executed half of the distinction: the handler RUNS but
+    the response is lost — the caller sees RPCTimeoutError, which the
+    retry policy must never blindly replay."""
+    srv, pool, hits = echo_server
+    faults.get_registry().configure("rpc.recv", mode="drop", count=1)
+    with pytest.raises(RPCTimeoutError):
+        pool.call(srv.addr, "Echo.Hello", {"name": "x"}, timeout=0.3)
+    deadline = time.monotonic() + 2.0
+    while not hits and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(hits) == 1  # it DID execute
+
+
+def test_rpc_recv_error_skips_handler(echo_server):
+    srv, pool, hits = echo_server
+    faults.get_registry().configure("rpc.recv", mode="error", count=1)
+    with pytest.raises(RemoteError, match="injected"):
+        pool.call(srv.addr, "Echo.Hello", {"name": "x"})
+    assert hits == []
+
+
+def test_rpc_recv_partition_is_silent_loss(echo_server):
+    """Partition at the receiver = the request silently never arrives:
+    handler NOT run, no error frame — the caller just times out, like
+    every other site's partition semantics (never a fast explicit
+    error)."""
+    srv, pool, hits = echo_server
+    faults.get_registry().configure("rpc.recv", mode="partition", count=1)
+    with pytest.raises(RPCTimeoutError):
+        pool.call(srv.addr, "Echo.Hello", {"name": "x"}, timeout=0.3)
+    time.sleep(0.1)
+    assert hits == []  # never dispatched
+
+
+def test_call_retry_replays_only_undelivered(echo_server):
+    srv, pool, hits = echo_server
+    faults.get_registry().configure("rpc.send", mode="drop", count=2)
+    out = pool.call_retry(srv.addr, "Echo.Hello", {"name": "r"}, retries=3)
+    assert out["hi"] == "r" and len(hits) == 1
+    faults.get_registry().clear()
+    faults.get_registry().configure("rpc.recv", mode="drop", count=1)
+    with pytest.raises(RPCTimeoutError):
+        pool.call_retry(srv.addr, "Echo.Hello", {"name": "t"}, timeout=0.3)
+
+
+# ---------------------------------------------------------------------------
+# Site semantics: broker / heartbeat / fsm
+# ---------------------------------------------------------------------------
+
+
+def test_broker_dequeue_fault_raises_broker_error():
+    from nomad_tpu.server.eval_broker import BrokerError, EvalBroker
+
+    broker = EvalBroker(nack_timeout=5.0)
+    broker.set_enabled(True)
+    faults.get_registry().configure("broker.dequeue", mode="error", count=1)
+    with pytest.raises(BrokerError, match="injected"):
+        broker.dequeue(["service"], timeout=0.1)
+    assert broker.dequeue(["service"], timeout=0.05) == (None, "")
+    broker.set_enabled(False)
+
+
+def test_heartbeat_tick_drop_skips_renewal():
+    from nomad_tpu.server.heartbeat import HeartbeatManager
+
+    class _Cfg:
+        min_heartbeat_ttl = 10.0
+        max_heartbeats_per_second = 50.0
+
+    class _Srv:
+        config = _Cfg()
+
+        class logger:
+            warning = staticmethod(lambda *a, **k: None)
+
+    hb = HeartbeatManager(_Srv())
+    faults.get_registry().configure("heartbeat.tick", mode="drop", count=1)
+    # The INITIAL arm is never droppable: without it no TTL timer exists
+    # to expire, which would be the opposite of a missed beat.
+    ttl = hb.reset_heartbeat_timer("node-1")
+    assert ttl >= 10.0 and hb.num_timers() == 1
+    # The renewal IS droppable: the armed timer keeps running toward
+    # expiry instead of being re-armed.
+    first_timer = hb._timers["node-1"]
+    assert hb.reset_heartbeat_timer("node-1") == 0.0
+    assert hb._timers["node-1"] is first_timer  # not re-armed
+    # Rule exhausted: renewals re-arm again.
+    assert hb.reset_heartbeat_timer("node-1") >= 10.0
+    assert hb._timers["node-1"] is not first_timer
+    hb.clear_all()
+
+
+def test_fsm_apply_delay_only():
+    from nomad_tpu.server.fsm import FSM
+
+    fsm = FSM()
+    faults.get_registry().configure(
+        "fsm.apply", mode="delay", delay=0.05, count=1
+    )
+    t0 = time.perf_counter()
+    fsm.apply(1, "node_register", {"node": mock.node()})
+    assert time.perf_counter() - t0 >= 0.05
+    # 'error' at this site is REJECTED at arm time (SITE_MODES): an
+    # injected per-replica error would diverge a deterministic FSM, and
+    # an armed-but-inert rule would fake its fire counts.
+    with pytest.raises(ValueError, match="does not honor"):
+        faults.get_registry().configure("fsm.apply", mode="error")
+    with pytest.raises(ValueError, match="does not honor"):
+        faults.get_registry().load(
+            {"sites": {"raft.append": {"mode": "error"}}}
+        )
+
+
+def test_faults_config_block_flows_to_agent_config():
+    """agent_config faults{} HCL block -> FileConfig -> AgentConfig spec
+    (the shape Agent.start arms the registry with)."""
+    from nomad_tpu.agent import AgentConfig
+    from nomad_tpu.agent_config import parse_config
+
+    fc = parse_config("""
+    faults {
+      seed = 7
+      sites {
+        "rpc.send" = {
+          mode = "drop"
+          probability = 0.25
+        }
+      }
+    }
+    """)
+    assert fc.faults.seed == 7
+    ac = AgentConfig.from_file_config(fc)
+    assert ac.faults == {
+        "seed": 7,
+        "sites": {"rpc.send": {"mode": "drop", "probability": 0.25}},
+    }
+    # The spec loads cleanly into a registry (what Agent.start does).
+    reg = faults.FaultRegistry()
+    reg.load(ac.faults)
+    assert reg.snapshot()["sites"]["rpc.send"][0]["probability"] == 0.25
+    # Merge: a later file overrides a site wholesale, keeps others.
+    fc2 = parse_config("""
+    faults {
+      sites {
+        "rpc.send" = { mode = "delay"
+                       delay = 0.01 }
+        "fsm.apply" = { mode = "delay"
+                        delay = 0.02 }
+      }
+    }
+    """)
+    merged = fc.merge(fc2)
+    assert merged.faults.seed == 7
+    assert merged.faults.sites["rpc.send"]["mode"] == "delay"
+    assert "fsm.apply" in merged.faults.sites
+
+
+# ---------------------------------------------------------------------------
+# /v1/agent/faults endpoint + metrics visibility
+# ---------------------------------------------------------------------------
+
+
+def test_agent_faults_endpoint_debug_gated():
+    import json
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    from nomad_tpu.api.http import HTTPServer
+    from nomad_tpu.telemetry import InmemSink
+
+    class FakeAgent:
+        server = None
+        inmem_sink = InmemSink()
+
+        def __init__(self):
+            self.debug = False
+
+        def debug_enabled(self):
+            return self.debug
+
+    agent = FakeAgent()
+    http = HTTPServer(agent, port=0)
+    http.start()
+    try:
+        base = http.addr
+        with pytest.raises(HTTPError) as exc:
+            urlopen(f"{base}/v1/agent/faults")
+        assert exc.value.code == 404  # gated off
+
+        agent.debug = True
+        spec = {"seed": 11, "sites": {
+            "rpc.send": {"mode": "drop", "probability": 0.5},
+        }}
+        req = Request(f"{base}/v1/agent/faults", method="PUT",
+                      data=json.dumps(spec).encode(),
+                      headers={"Content-Type": "application/json"})
+        body = json.loads(urlopen(req).read())
+        assert body["seed"] == 11 and "rpc.send" in body["sites"]
+
+        # A bad site 400s and arms nothing new.
+        bad = Request(f"{base}/v1/agent/faults", method="PUT",
+                      data=b'{"sites": {"bogus.site": {}}}')
+        with pytest.raises(HTTPError) as exc:
+            urlopen(bad)
+        assert exc.value.code == 400
+
+        body = json.loads(urlopen(f"{base}/v1/agent/faults").read())
+        assert list(body["sites"]) == ["rpc.send"]
+
+        # PUT is REPLACE, not merge: a second plan disarms unnamed sites
+        # (two sequential experiments must not contaminate each other).
+        plan_b = Request(f"{base}/v1/agent/faults", method="PUT",
+                         data=json.dumps({"sites": {
+                             "solver.execute": {"mode": "error"},
+                         }}).encode())
+        body = json.loads(urlopen(plan_b).read())
+        assert list(body["sites"]) == ["solver.execute"]
+
+        clear = Request(f"{base}/v1/agent/faults", method="DELETE")
+        body = json.loads(urlopen(clear).read())
+        assert body["sites"] == {} and body["active"] is False
+    finally:
+        http.shutdown()
+
+
+def test_injected_faults_and_breaker_visible_in_metrics():
+    """Acceptance: injected-fault counts and breaker transitions land in
+    the /v1/agent/metrics surface (the InmemSink exposition)."""
+    import json
+    from urllib.request import urlopen
+
+    from nomad_tpu.api.http import HTTPServer
+    from nomad_tpu.scheduler import DEVICE_BREAKER
+    from nomad_tpu.telemetry import InmemSink, Metrics, prometheus_text
+
+    sink = InmemSink()
+    old = telemetry.get_global()
+    telemetry.set_global(Metrics(sink, service="nomad"))
+    try:
+        faults.get_registry().configure(
+            "solver.execute", mode="error", count=3
+        )
+        for _ in range(3):
+            assert faults.fire("solver.execute") is not None
+        saved = DEVICE_BREAKER.threshold
+        DEVICE_BREAKER.threshold = 2
+        try:
+            DEVICE_BREAKER.record_failure()
+            DEVICE_BREAKER.record_failure()
+            assert DEVICE_BREAKER.state == "open"
+        finally:
+            DEVICE_BREAKER.threshold = saved
+
+        class FakeAgent:
+            server = None
+            inmem_sink = sink
+            debug_enabled = staticmethod(lambda: False)
+
+        http = HTTPServer(FakeAgent(), port=0)
+        http.start()
+        try:
+            doc = json.loads(urlopen(f"{http.addr}/v1/agent/metrics").read())
+            counters = {}
+            gauges = {}
+            for ivl in doc["intervals"]:
+                counters.update(ivl["counters"])
+                gauges.update(ivl["gauges"])
+            assert counters["nomad.faults.solver.execute.error"]["sum"] == 3
+            assert counters["nomad.solver.breaker.to_open"]["sum"] >= 1
+            assert gauges["nomad.solver.breaker.state"] == 2  # open
+        finally:
+            http.shutdown()
+        # And the Prometheus exposition carries the same series.
+        text = prometheus_text(sink)
+        assert "nomad_faults_solver_execute_error_total" in text
+        assert "nomad_solver_breaker_state" in text
+    finally:
+        DEVICE_BREAKER.reset()
+        telemetry.set_global(old)
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier
+# ---------------------------------------------------------------------------
+
+
+def _register_cluster_state(leader, n_nodes, n_jobs):
+    from cluster_util import retry_write
+
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for node in nodes:
+        retry_write(lambda n=node: leader.node_register(n))
+    jobs, eval_ids = [], []
+    for _ in range(n_jobs):
+        job = mock.job()
+        ev_id, _ = retry_write(lambda j=job: leader.job_register(j))
+        jobs.append(job)
+        eval_ids.append(ev_id)
+    return nodes, jobs, eval_ids
+
+
+def _assert_exactly_once(store, nodes, jobs, eval_ids, deadline_s=60.0):
+    """Every eval terminal; every job placed exactly count times (live);
+    no node overcommitted — the chaos invariants."""
+    deadline = time.monotonic() + deadline_s
+
+    def _terminal():
+        for ev_id in eval_ids:
+            ev = store.eval_by_id(ev_id)
+            if ev is None or not ev.terminal_status():
+                return False
+        return True
+
+    while time.monotonic() < deadline and not _terminal():
+        time.sleep(0.1)
+    assert _terminal(), [
+        (i[:8], getattr(store.eval_by_id(i), "status", None))
+        for i in eval_ids
+    ]
+
+    def _placed():
+        for job in jobs:
+            live = structs.filter_terminal_allocs(store.allocs_by_job(job.id))
+            if len(live) != job.task_groups[0].count:
+                return False
+        return True
+
+    while time.monotonic() < deadline and not _placed():
+        time.sleep(0.1)
+    for job in jobs:
+        live = structs.filter_terminal_allocs(store.allocs_by_job(job.id))
+        assert len(live) == job.task_groups[0].count, (
+            job.id, len(live), job.task_groups[0].count,
+        )
+
+    node_by_id = {n.id: n for n in nodes}
+    used = {}
+    for job in jobs:
+        for a in structs.filter_terminal_allocs(store.allocs_by_job(job.id)):
+            cpu, mem = used.get(a.node_id, (0, 0))
+            used[a.node_id] = (cpu + a.resources.cpu,
+                               mem + a.resources.memory_mb)
+    for nid, (cpu, mem) in used.items():
+        node = node_by_id[nid]
+        res, reserved = node.resources, node.reserved
+        assert cpu <= res.cpu - (reserved.cpu if reserved else 0), nid
+        assert mem <= res.memory_mb - (
+            reserved.memory_mb if reserved else 0
+        ), nid
+
+
+def test_chaos_rpc_drop_20pct_under_load():
+    """20% of ALL outbound RPC frames dropped (provably-undelivered) while
+    a burst of service jobs schedules across a 3-server cluster: raft
+    retries, forwarding retries, and broker redelivery must together
+    deliver exactly-once placement."""
+    from cluster_util import relaxed_cluster_cfg, retry_write
+    from nomad_tpu.server import ServerConfig
+    from nomad_tpu.server.cluster import form_cluster, wait_for_leader
+
+    servers = form_cluster(3, ServerConfig(
+        scheduler_backend="host", num_schedulers=1,
+        min_heartbeat_ttl=300.0,
+    ), base_cluster=relaxed_cluster_cfg())
+    try:
+        leader = wait_for_leader(servers)
+        nodes, jobs, eval_ids = _register_cluster_state(leader, 12, 4)
+
+        faults.get_registry().load({"seed": CHAOS_SEED, "sites": {
+            "rpc.send": {"mode": "drop", "probability": 0.2,
+                         "duration": 20.0},
+        }})
+        # More load lands WHILE frames are dropping.
+        for _ in range(2):
+            job = mock.job()
+            ev_id, _ = retry_write(lambda j=job: leader.job_register(j),
+                                   timeout=30.0)
+            jobs.append(job)
+            eval_ids.append(ev_id)
+
+        _assert_exactly_once(
+            leader.state_store, nodes, jobs, eval_ids, deadline_s=60.0,
+        )
+        snap = faults.get_registry().snapshot()
+        assert snap["sites"]["rpc.send"][0]["fired"] > 0  # it really dropped
+    finally:
+        faults.get_registry().clear()
+        for srv in servers:
+            srv.shutdown()
+
+
+def test_chaos_leader_partition_mid_plan():
+    """One-way partition of the leader's OUTBOUND raft traffic while its
+    brokered evals are mid-flight: it can no longer commit plans; the
+    survivors elect a new leader whose restored broker must finish every
+    eval exactly once. Heal, then the cluster serves new work."""
+    from cluster_util import relaxed_cluster_cfg, retry_write
+    from nomad_tpu.server import ServerConfig
+    from nomad_tpu.server.cluster import form_cluster, wait_for_leader
+
+    servers = form_cluster(3, ServerConfig(
+        scheduler_backend="host", num_schedulers=1,
+        min_heartbeat_ttl=300.0,
+    ), base_cluster=relaxed_cluster_cfg())
+    try:
+        leader = wait_for_leader(servers)
+        nodes, jobs, eval_ids = _register_cluster_state(leader, 12, 4)
+
+        # Partition mid-plan: evals just registered are being scheduled.
+        old_id = leader.cluster.node_id
+        faults.get_registry().load({"seed": CHAOS_SEED, "sites": {
+            "raft.append": {"mode": "partition", "match": f"{old_id}->"},
+            "raft.vote": {"mode": "partition", "match": f"{old_id}->"},
+        }})
+
+        survivors = [s for s in servers if s is not leader]
+        deadline = time.monotonic() + 30.0
+        new_leader = None
+        while time.monotonic() < deadline:
+            live = [s for s in survivors if s.raft.is_leader]
+            if live:
+                new_leader = live[0]
+                break
+            time.sleep(0.05)
+        assert new_leader is not None, "no survivor took leadership"
+
+        _assert_exactly_once(
+            new_leader.state_store, nodes, jobs, eval_ids, deadline_s=60.0,
+        )
+
+        # Heal the partition: the deposed leader rejoins as follower and
+        # the cluster serves new work end-to-end.
+        faults.get_registry().clear()
+        job2 = mock.job()
+        ev2_id, _ = retry_write(
+            lambda: new_leader.job_register(job2), timeout=30.0
+        )
+        ev2 = new_leader.wait_for_eval(ev2_id, timeout=30.0)
+        assert ev2.status == structs.EVAL_STATUS_COMPLETE
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and leader.raft.is_leader:
+            time.sleep(0.05)
+        assert not leader.raft.is_leader
+    finally:
+        faults.get_registry().clear()
+        for srv in servers:
+            srv.shutdown()
+
+
+def test_chaos_device_death_mid_solve_trips_breaker():
+    """Persistent device death at solver.execute: the first deliveries
+    fail and feed the breaker; once it trips, redeliveries route to the
+    host-oracle path and the eval completes — no eval lost to a dead
+    device. Clearing the fault and waiting out the cooldown, a half-open
+    probe closes the breaker on the next eval."""
+    from nomad_tpu.scheduler import DEVICE_BREAKER
+    from nomad_tpu.server import Server, ServerConfig
+
+    saved = (DEVICE_BREAKER.threshold, DEVICE_BREAKER.cooldown)
+    DEVICE_BREAKER.threshold, DEVICE_BREAKER.cooldown = 3, 0.5
+    DEVICE_BREAKER.reset()
+    srv = Server(ServerConfig(
+        scheduler_backend="tpu", num_schedulers=1, eval_batch_size=1,
+        eval_delivery_limit=6, prewarm_shapes=False,
+    ))
+    try:
+        srv.start()
+        nodes = [mock.node() for _ in range(6)]
+        for node in nodes:
+            srv.node_register(node)
+
+        faults.get_registry().load({"seed": CHAOS_SEED, "sites": {
+            "solver.execute": {"mode": "error"},
+        }})
+        job = mock.job()
+        ev_id, _ = srv.job_register(job)
+        ev = srv.wait_for_eval(ev_id, timeout=60.0)
+        assert ev.status == structs.EVAL_STATUS_COMPLETE
+        live = structs.filter_terminal_allocs(
+            srv.state_store.allocs_by_job(job.id)
+        )
+        assert len(live) == job.task_groups[0].count  # exactly once
+        assert DEVICE_BREAKER.state == "open"
+        snap = faults.get_registry().snapshot()
+        fired = snap["sites"]["solver.execute"][0]["fired"]
+        assert fired >= DEVICE_BREAKER.threshold
+
+        # Device "revives": after the cooldown the next eval is the
+        # half-open probe; its successful solve closes the breaker.
+        faults.get_registry().clear()
+        time.sleep(0.6)
+        job2 = mock.job()
+        ev2_id, _ = srv.job_register(job2)
+        ev2 = srv.wait_for_eval(ev2_id, timeout=60.0)
+        assert ev2.status == structs.EVAL_STATUS_COMPLETE
+        assert DEVICE_BREAKER.state == "closed"
+        live2 = structs.filter_terminal_allocs(
+            srv.state_store.allocs_by_job(job2.id)
+        )
+        assert len(live2) == job2.task_groups[0].count
+    finally:
+        faults.get_registry().clear()
+        DEVICE_BREAKER.threshold, DEVICE_BREAKER.cooldown = saved
+        DEVICE_BREAKER.reset()
+        srv.shutdown()
+        from nomad_tpu.ops.coalesce import quiesce_all
+
+        quiesce_all(timeout=15.0)

@@ -245,6 +245,108 @@ def run_capture(kind: str, argv: list, timeout: float,
 PIDFILE = os.path.join(REPO, ".bench_watch.pid")
 
 
+def _default_probe():
+    from nomad_tpu.scheduler import device_probe
+
+    # claim_timeout chosen deliberately: we only probe after the port
+    # scan saw listeners, so the relay stage will report reachable and
+    # the leash extends. Killing a queued claim at 150s is how the 07-31
+    # window was missed — a long single claimer beats fast kill/retry
+    # here (kills can orphan grants).
+    return device_probe.probe_once(
+        timeout=150,
+        claim_timeout=420,
+        env={"NOMAD_TPU_RELAY_PORTS": ",".join(map(str, SCAN_PORTS))},
+    )
+
+
+class CaptureWatcher:
+    """The capture state machine, one relay-scan cycle per ``cycle()``.
+
+    Separated from main() so the ordering/once-per-window invariants are
+    unit-testable with a stubbed prober and fake capture commands
+    (tests/test_bench_watch.py):
+
+    - staged capture order within a window: fast -> proof -> full — bank
+      the cheapest TPU number first, the window may die any minute;
+    - fast and proof each bank at most once per window, and only on
+      SUCCESS (a transient failure retries while the relay is still up);
+    - a failed fast stage does not gate the proof (the probe already
+      proved a live device);
+    - only a successful FULL bench closes the window (cooldown + commit
+      marker); and a dark scan resets the per-window stage markers.
+    """
+
+    def __init__(self, scan=scan_ports, probe=_default_probe,
+                 capture=run_capture, head=head_commit,
+                 proof_path=None, clock=time.monotonic, log=log):
+        self.scan = scan
+        self.probe = probe
+        self.capture = capture
+        self.head = head
+        self.proof_path = (
+            proof_path if proof_path is not None
+            else os.path.join(REPO, "tools", "pallas_proof.py")
+        )
+        self.clock = clock
+        self.log = log
+        self.last_capture_t = 0.0
+        self.last_capture_commit = ""
+        # Per-window stage markers: reset when the relay goes dark so the
+        # next window re-banks a fresh fast number, but within one window
+        # a retrying full bench never re-spends time on a banked stage.
+        self.window_fast_ok = False
+        self.window_proof_done = False
+
+    def cycle(self) -> None:
+        open_ports = self.scan()
+        self.log("scan", open_ports=open_ports)
+        if not open_ports:
+            self.window_fast_ok = False
+            self.window_proof_done = False
+            return
+        commit = self.head()
+        fresh_window = (
+            self.clock() - self.last_capture_t > RECAPTURE_COOLDOWN_S
+        )
+        if not fresh_window and commit == self.last_capture_commit:
+            return
+        report = self.probe()
+        self.log("probe", ok=report.ok, last_stage=report.last_stage,
+                 backend=report.backend, error=report.error)
+        if not (report.ok and report.backend != "cpu"):
+            return
+        # Relay answered with a real device. Staged capture: bank the
+        # cheapest TPU number FIRST (headline only, 3 runs, ~1 min), then
+        # the pallas proof, then the full suite — a window that dies
+        # mid-full-suite has still produced a driver-verifiable number.
+        if not self.window_fast_ok:
+            fast = self.capture(
+                "bench-fast", [sys.executable, "bench.py"],
+                FAST_TIMEOUT_S, extra_env=FAST_ENV,
+            )
+            self.window_fast_ok = fast["ok"]
+        # The probe already proved a live device, so the proof is NOT
+        # gated on the fast stage's outcome — a fast-stage timeout must
+        # not cost the window its only compiled-pallas evidence. Only a
+        # SUCCESSFUL proof banks the stage (mirroring window_fast_ok).
+        if not self.window_proof_done and os.path.exists(self.proof_path):
+            proof_cap = self.capture(
+                "pallas_proof", [sys.executable, self.proof_path],
+                PROOF_TIMEOUT_S,
+            )
+            self.window_proof_done = proof_cap["ok"]
+        bench = self.capture(
+            "bench", [sys.executable, "bench.py"], BENCH_TIMEOUT_S,
+        )
+        # Only a SUCCESSFUL full bench closes the window; a failed one
+        # must keep retrying while the relay is still up — that window is
+        # the whole point.
+        if bench["ok"]:
+            self.last_capture_t = self.clock()
+            self.last_capture_commit = commit
+
+
 def main() -> None:
     # Single-instance guard: two overlapping watchers would race the
     # capture file's read-modify-write and double-claim the device window.
@@ -264,83 +366,10 @@ def main() -> None:
     with open(PIDFILE, "w") as f:
         f.write(str(os.getpid()))
     log("start", pid=os.getpid(), ports=f"{SCAN_PORTS[0]}-{SCAN_PORTS[-1]}")
-    last_capture_t = 0.0
-    last_capture_commit = ""
-    # Per-window stage markers: reset when the relay goes dark so the next
-    # window re-banks a fresh fast number, but within one window a retrying
-    # full bench never re-spends time on an already-banked stage.
-    window_fast_ok = False
-    window_proof_done = False
+    watcher = CaptureWatcher()
     while True:
         try:
-            open_ports = scan_ports()
-            log("scan", open_ports=open_ports)
-            if not open_ports:
-                window_fast_ok = False
-                window_proof_done = False
-            if open_ports:
-                commit = head_commit()
-                fresh_window = (
-                    time.monotonic() - last_capture_t > RECAPTURE_COOLDOWN_S
-                )
-                if fresh_window or commit != last_capture_commit:
-                    from nomad_tpu.scheduler import device_probe
-
-                    # claim_timeout chosen deliberately: we only probe
-                    # after the port scan saw listeners, so the relay
-                    # stage will report reachable and the leash extends.
-                    # Killing a queued claim at 150s is how the 07-31
-                    # window was missed — a long single claimer beats
-                    # fast kill/retry here (kills can orphan grants).
-                    report = device_probe.probe_once(
-                        timeout=150,
-                        claim_timeout=420,
-                        env={"NOMAD_TPU_RELAY_PORTS":
-                             ",".join(map(str, SCAN_PORTS))},
-                    )
-                    log("probe", ok=report.ok, last_stage=report.last_stage,
-                        backend=report.backend, error=report.error)
-                    if report.ok and report.backend != "cpu":
-                        # Relay answered with a real device. Staged capture:
-                        # bank the cheapest TPU number FIRST (headline only,
-                        # 3 runs, ~1 min), then the pallas proof, then the
-                        # full suite — a window that dies mid-full-suite has
-                        # still produced a driver-verifiable device number.
-                        # Each stage runs at most once per window (markers
-                        # reset when the relay goes dark) so a retrying full
-                        # bench never re-spends window time on banked stages.
-                        if not window_fast_ok:
-                            fast = run_capture(
-                                "bench-fast", [sys.executable, "bench.py"],
-                                FAST_TIMEOUT_S, extra_env=FAST_ENV,
-                            )
-                            window_fast_ok = fast["ok"]
-                        proof = os.path.join(REPO, "tools", "pallas_proof.py")
-                        # The probe already proved a live device, so the
-                        # proof is NOT gated on the fast stage's outcome —
-                        # a fast-stage timeout must not cost the window its
-                        # only compiled-pallas evidence.
-                        if not window_proof_done and os.path.exists(proof):
-                            proof_cap = run_capture(
-                                "pallas_proof", [sys.executable, proof],
-                                PROOF_TIMEOUT_S,
-                            )
-                            # Only a SUCCESSFUL proof banks the stage
-                            # (mirroring window_fast_ok): a transient
-                            # failure retries while the relay is still up
-                            # instead of forfeiting the window's only
-                            # compiled-pallas evidence.
-                            window_proof_done = proof_cap["ok"]
-                        bench = run_capture(
-                            "bench", [sys.executable, "bench.py"],
-                            BENCH_TIMEOUT_S,
-                        )
-                        # Only a SUCCESSFUL full bench closes the window; a
-                        # failed one must keep retrying while the relay is
-                        # still up — that window is the whole point.
-                        if bench["ok"]:
-                            last_capture_t = time.monotonic()
-                            last_capture_commit = commit
+            watcher.cycle()
         except Exception as e:  # never let one bad cycle kill the watcher
             log("error", error=f"{type(e).__name__}: {e}")
         time.sleep(SCAN_INTERVAL_S)
