@@ -48,6 +48,9 @@ class AgentConfig:
     # traces (0 = default 256) and the master enable.
     trace_buffer_size: int = 0
     disable_tracing: bool = False
+    # Cluster event stream (nomad_tpu.events): ring size of retained
+    # events (0 = default 2048) — the /v1/event/stream resume window.
+    event_buffer_size: int = 0
     enable_syslog: bool = False
     syslog_facility: str = "LOCAL0"
     leave_on_interrupt: bool = False
@@ -114,6 +117,7 @@ class AgentConfig:
             disable_hostname_metrics=fc.telemetry.disable_hostname,
             trace_buffer_size=fc.telemetry.trace_buffer_size,
             disable_tracing=fc.telemetry.disable_tracing,
+            event_buffer_size=fc.telemetry.event_buffer_size,
             enable_syslog=fc.enable_syslog,
             syslog_facility=fc.syslog_facility,
             leave_on_interrupt=fc.leave_on_interrupt,
@@ -189,6 +193,8 @@ class Agent:
             scheduler_backend=self.config.scheduler_backend,
             tls=self.config.tls,
         )
+        if self.config.event_buffer_size:
+            server_config.event_buffer_size = self.config.event_buffer_size
         if self.config.num_schedulers:
             server_config.num_schedulers = self.config.num_schedulers
         if self.config.enabled_schedulers:
@@ -358,23 +364,15 @@ class Agent:
         tracing was started), device probe state, pallas kernel state,
         coalescer and mirror-cache stats."""
         import gc
-        import sys
-        import traceback
 
         query = query or {}
         out: Dict = {}
 
-        # Thread stacks — the goroutine-dump analog.
-        frames = sys._current_frames()
-        threads = {}
-        import threading as _threading
+        # Thread stacks — the goroutine-dump analog (shared with the
+        # debug bundle; one copy of the dump logic).
+        from nomad_tpu.bundle import thread_stacks
 
-        names = {t.ident: t.name for t in _threading.enumerate()}
-        for ident, frame in frames.items():
-            threads[names.get(ident, str(ident))] = traceback.format_stack(
-                frame
-            )[-8:]
-        out["threads"] = threads
+        out["threads"] = thread_stacks(depth=8)
 
         counts = gc.get_count()
         # The full-heap walk is expensive (multi-second on a big agent):
@@ -430,6 +428,19 @@ class Agent:
         except Exception as e:
             out["mirror_cache"] = {"error": str(e)}
         return out
+
+    def debug_bundle(self, query: Optional[Dict] = None) -> Dict:
+        """One-shot flight recorder (/v1/agent/debug/bundle): metrics,
+        traces, events, redacted config, fault plan, breaker state, and
+        thread stacks in a single JSON artifact (nomad_tpu.bundle)."""
+        from nomad_tpu.bundle import collect
+
+        query = query or {}
+        try:
+            last_events = int(query.get("events", "0"))
+        except ValueError:
+            last_events = 0
+        return collect(agent=self, last_events=last_events or 512)
 
     def self_info(self) -> Dict:
         info: Dict = {

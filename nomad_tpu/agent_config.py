@@ -80,13 +80,15 @@ class Telemetry:
     """config.go Telemetry block, extended with eval-trace knobs
     (nomad_tpu.trace): ``trace_buffer_size`` bounds the completed-trace
     ring (0 = the default of 256), ``disable_tracing`` turns span
-    recording off entirely."""
+    recording off entirely, and ``event_buffer_size`` bounds the cluster
+    event stream ring (nomad_tpu.events; 0 = the default of 2048)."""
 
     statsite_address: str = ""
     statsd_address: str = ""
     disable_hostname: bool = False
     trace_buffer_size: int = 0
     disable_tracing: bool = False
+    event_buffer_size: int = 0
 
 
 @dataclass
@@ -249,6 +251,10 @@ class FileConfig:
                 other.telemetry.disable_tracing
                 or self.telemetry.disable_tracing
             ),
+            event_buffer_size=(
+                other.telemetry.event_buffer_size
+                or self.telemetry.event_buffer_size
+            ),
         )
         out.atlas = Atlas(
             infrastructure=other.atlas.infrastructure or self.atlas.infrastructure,
@@ -350,7 +356,7 @@ def _from_mapping(data: dict) -> FileConfig:
                     setattr(cfg.server, k, v)
         elif key == "telemetry":
             for k, v in value.items():
-                if k == "trace_buffer_size":
+                if k in ("trace_buffer_size", "event_buffer_size"):
                     v = int(v)
                 setattr(cfg.telemetry, k, v)
         elif key == "atlas":

@@ -77,7 +77,9 @@ class ApiClient:
                 query["region"] = q.region
             if q.prefix:
                 query["prefix"] = q.prefix
-        qs = urllib.parse.urlencode(query)
+        # doseq: list-valued params (repeatable ?topic= filters) expand to
+        # repeated keys; scalars encode exactly as before.
+        qs = urllib.parse.urlencode(query, doseq=True)
         return f"{self.address}{path}" + (f"?{qs}" if qs else "")
 
     def _do(self, method: str, path: str, body: Any = None,
@@ -139,6 +141,9 @@ class ApiClient:
 
     def status(self) -> "Status":
         return Status(self)
+
+    def events(self) -> "Events":
+        return Events(self)
 
 
 class Jobs:
@@ -241,6 +246,50 @@ class Allocations:
         return from_dict(Allocation, out), meta
 
 
+class Events:
+    """Client for /v1/event/stream (reference: api/event.go — the Go
+    SDK's EventStream consumer)."""
+
+    def __init__(self, client: ApiClient):
+        self.client = client
+
+    def list(self, index: int = 0, topics: Optional[List[str]] = None,
+             wait: str = "") -> Tuple[int, List[Dict], bool]:
+        """One page of events with index > ``index`` (long-polls server-
+        side when index > 0). Returns (resume_index, events, truncated)."""
+        params: Dict[str, Any] = {"index": str(index)}
+        if topics:
+            params["topic"] = list(topics)
+        if wait:
+            params["wait"] = wait
+        out, _ = self.client.query("/v1/event/stream", params=params)
+        return out["index"], out["events"], out["truncated"]
+
+    def stream(self, index: int = 0, topics: Optional[List[str]] = None,
+               poll_wait: str = "60s"):
+        """Iterator over the event stream honoring ``?index=`` resume:
+        yields event dicts in order, long-polling between pages, forever
+        (callers break out). Whenever the resume cursor has fallen off
+        the server's bounded ring — at start OR mid-stream, when a burst
+        larger than the ring lands between pages — a synthetic
+        ``{"topic": "Truncated", ...}`` marker is yielded before that
+        page's events: the consumer's signal to re-list its world."""
+        cursor = index
+        while True:
+            cursor_out, events, truncated = self.list(
+                index=cursor, topics=topics, wait=poll_wait
+            )
+            if truncated:
+                yield {"topic": "Truncated", "type": "Truncated",
+                       "index": cursor, "key": "", "payload": {}}
+            for event in events:
+                yield event
+            # An empty page still advances the cursor (events of other
+            # topics moved the index) — resume from wherever the server
+            # got to, never re-read the same page.
+            cursor = max(cursor, cursor_out)
+
+
 class AgentApi:
     """api/agent.go"""
 
@@ -249,6 +298,19 @@ class AgentApi:
 
     def self_info(self) -> Dict:
         out, _ = self.client.query("/v1/agent/self")
+        return out
+
+    def metrics(self) -> Dict:
+        """Live InmemSink aggregates (/v1/agent/metrics JSON body)."""
+        out, _ = self.client.query("/v1/agent/metrics")
+        return out
+
+    def debug_bundle(self, events: int = 0) -> Dict:
+        """One-shot flight recorder (/v1/agent/debug/bundle; requires the
+        agent to run with enable_debug). ``events`` caps the included
+        event tail (0 = the server default)."""
+        params = {"events": str(events)} if events else None
+        out, _ = self.client.query("/v1/agent/debug/bundle", params=params)
         return out
 
     def members(self) -> List[Dict]:

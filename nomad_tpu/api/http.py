@@ -17,9 +17,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from nomad_tpu import events as events_mod
 from nomad_tpu import telemetry, trace
 from nomad_tpu.api.codec import from_dict, to_dict
 from nomad_tpu.jobspec import parse_duration
+from nomad_tpu.server.blocking import blocking_query
 from nomad_tpu.state.store import (
     item_table,
 )
@@ -51,6 +53,14 @@ class RawResponse:
     def __init__(self, body: bytes, content_type: str):
         self.body = body
         self.content_type = content_type
+
+
+class _Streamed:
+    """Sentinel handler result: the handler already wrote the response
+    itself (SSE tailing) — the dispatcher must not write anything."""
+
+
+STREAMED = _Streamed()
 
 
 class HTTPServer:
@@ -99,10 +109,12 @@ class HTTPServer:
             (r"^/v1/evaluation/(?P<eval_id>[^/]+)/allocations$",
              self.eval_allocations),
             (r"^/v1/evaluation/(?P<eval_id>[^/]+)/trace$", self.eval_trace),
+            (r"^/v1/event/stream$", self.event_stream),
             (r"^/v1/agent/self$", self.agent_self),
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
             (r"^/v1/agent/debug$", self.agent_debug),
+            (r"^/v1/agent/debug/bundle$", self.agent_debug_bundle),
             (r"^/v1/agent/faults$", self.agent_faults),
             (r"^/v1/agent/logs$", self.agent_logs),
             (r"^/v1/agent/members$", self.agent_members),
@@ -143,7 +155,9 @@ class HTTPServer:
                 self.logger.exception("http: request failed")
                 self._respond_error(req, 500, str(e))
             else:
-                if isinstance(out, RawResponse):
+                if out is STREAMED:
+                    pass  # handler streamed the body itself
+                elif isinstance(out, RawResponse):
                     self._respond_raw(req, out)
                 else:
                     self._respond_json(req, out, index)
@@ -381,6 +395,134 @@ class HTTPServer:
             raise HTTPCodedError(404, "no trace for evaluation")
         return {"eval_id": eval_id, "spans": spans}, None
 
+    # -- event stream (reference: nomad/stream, /v1/event/stream) ------------
+
+    def event_stream(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Cluster event stream (nomad_tpu.events).
+
+        Default: one JSON page of events with index > ``?index=N``
+        (0 returns the whole retained buffer immediately), blocking-query
+        semantics when N > 0 — the response long-polls until a newer
+        event lands or ``?wait=`` lapses. ``?topic=T`` / ``?topic=T:key``
+        filter (repeatable, OR-ed). Body carries ``index`` (the resume
+        cursor) and ``truncated`` (the cursor fell off the bounded ring —
+        re-list). ``?format=sse`` (or Accept: text/event-stream) switches
+        to live Server-Sent-Events tailing instead."""
+        srv = self._srv()
+        broker = srv.fsm.events
+        # Multi-valued params: the dispatch envelope collapses to first
+        # value, and topic filters are legitimately repeatable.
+        topics = parse_qs(urlparse(req.path).query).get("topic", [])
+        tfilter = events_mod.TopicFilter(topics)
+        try:
+            min_index = int(query.get("index", 0))
+        except ValueError:
+            raise HTTPCodedError(400, "invalid index")
+        accept = req.headers.get("Accept") or ""
+        if query.get("format") == "sse" or "text/event-stream" in accept:
+            self._stream_sse(req, broker, tfilter, min_index, query)
+            return STREAMED, None
+        wait = min(parse_duration(query.get("wait", "60s")), MAX_QUERY_TIME)
+
+        def run(b):
+            idx, evs, truncated = b.events_after(min_index, tfilter)
+            return idx, {
+                "index": idx,
+                "events": [e.to_dict() for e in evs],
+                "truncated": truncated,
+            }
+
+        if min_index <= 0:
+            # Non-blocking list (the _maybe_block convention): ?index=0
+            # returns the retained buffer immediately — on an empty
+            # broker too, where the index probe (0 > 0) would otherwise
+            # park the poll.
+            index, out = run(broker)
+            return out, index
+        index, out = blocking_query(
+            get_store=lambda: broker,
+            items=lambda b: tfilter.watch_items(),
+            run=run,
+            min_index=min_index,
+            timeout=wait,
+            max_timeout=MAX_QUERY_TIME,
+            # Filtered probe: wake/return only when a potentially
+            # matching event landed, not on every unrelated publish.
+            index_of=lambda b: b.index_for(tfilter),
+        )
+        return out, index
+
+    def _stream_sse(self, req, broker, tfilter, min_index, query) -> None:
+        """SSE framing for live tailing: one frame per event
+        (``event:`` = type, ``id:`` = index, ``data:`` = the JSON body),
+        a ``Truncated`` frame first when the resume cursor fell off the
+        ring, and ``: heartbeat`` comments while idle so proxies don't
+        reap the connection. Runs until the client disconnects or
+        ``?wait=`` (0 = tail forever) lapses."""
+        import time as _time
+
+        # Validate everything BEFORE the status line goes out: once the
+        # 200 + headers are written, an exception would make the
+        # dispatcher write a second response into the open SSE body.
+        raw_wait = query.get("wait", "")
+        try:
+            # "0" and absent both mean tail-forever (parse_duration needs
+            # a unit on non-empty strings, so map the bare zero itself).
+            wait = 0.0 if raw_wait in ("", "0") else parse_duration(raw_wait)
+        except Exception:
+            raise HTTPCodedError(400, "invalid wait duration")
+        req.send_response(200)
+        req.send_header("Content-Type", "text/event-stream")
+        req.send_header("Cache-Control", "no-cache")
+        req.send_header("Connection", "close")
+        req.end_headers()
+        deadline = _time.monotonic() + wait if wait > 0 else None
+        cursor = min_index
+        try:
+            while True:
+                idx, evs, truncated = broker.events_after(cursor, tfilter)
+                if truncated:
+                    # Every time the cursor falls off the ring — not just
+                    # on the first page: a tail that lags a burst larger
+                    # than the ring mid-stream has lost events too.
+                    req.wfile.write(
+                        b"event: Truncated\ndata: "
+                        + json.dumps({"resume_index": cursor,
+                                      "horizon": broker.horizon()}).encode()
+                        + b"\n\n"
+                    )
+                for e in evs:
+                    frame = (
+                        f"event: {e.type}\nid: {e.index}\n"
+                        f"data: {json.dumps(e.to_dict())}\n\n"
+                    )
+                    req.wfile.write(frame.encode())
+                req.wfile.flush()
+                cursor = idx
+                remaining = (
+                    deadline - _time.monotonic() if deadline is not None
+                    else 15.0
+                )
+                if deadline is not None and remaining <= 0:
+                    return
+                woke = threading.Event()
+                items = tfilter.watch_items()
+                broker.watch.watch(items, woke)
+                try:
+                    if broker.index_for(tfilter) <= cursor:
+                        fired = woke.wait(timeout=min(15.0, remaining))
+                    else:
+                        fired = True
+                finally:
+                    broker.watch.stop_watch(items, woke)
+                if not fired:
+                    # Keep-alive comment; also how a dead client is
+                    # detected while the stream is idle.
+                    req.wfile.write(b": heartbeat\n\n")
+                    req.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away — the normal end of a tail
+
     # -- agent + status endpoints --------------------------------------------
 
     def agent_self(self, req, query) -> Tuple[Any, Optional[int]]:
@@ -423,6 +565,17 @@ class HTTPServer:
             raise HTTPCodedError(404, "debug endpoints disabled "
                                       "(set enable_debug)")
         return self.agent.debug_info(query), None
+
+    def agent_debug_bundle(self, req, query) -> Tuple[Any, Optional[int]]:
+        """One-shot flight recorder (nomad_tpu.bundle): metrics + traces +
+        events + redacted config + fault plan + breaker state + thread
+        stacks in a single JSON artifact — what an operator attaches when
+        a bench or chaos run goes sideways. Debug-gated like the rest of
+        the introspection surface."""
+        if not getattr(self.agent, "debug_enabled", lambda: False)():
+            raise HTTPCodedError(404, "debug endpoints disabled "
+                                      "(set enable_debug)")
+        return self.agent.debug_bundle(query), None
 
     def agent_faults(self, req, query) -> Tuple[Any, Optional[int]]:
         """Deterministic fault injection (nomad_tpu.faults), gated by

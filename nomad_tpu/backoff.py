@@ -199,9 +199,20 @@ class CircuitBreaker:
         # lock-cheap and transitions are rare by construction.
         if state == self._state:
             return
+        prev = self._state
         self._state = state
         telemetry.incr_counter(self.name + (f"to_{state}",))
         telemetry.set_gauge(self.name + ("state",), _STATE_GAUGE[state])
+        # Event-stream visibility (nomad_tpu.events): a breaker flip is a
+        # cluster-behavior change (evals reroute to the host path) that
+        # polling individual metrics only shows after the fact. Broadcast:
+        # breakers are process-scoped, not owned by any one server.
+        from nomad_tpu import events
+
+        events.broadcast(
+            "Breaker", "BreakerStateChanged", key=".".join(self.name),
+            payload={"from": prev, "to": state, "trips": self._trips},
+        )
 
     def allow(self) -> bool:
         """Whether a call may take the guarded path right now. In open

@@ -1,0 +1,187 @@
+"""Operator debug bundle: the one-shot flight recorder.
+
+Upstream analog: ``nomad operator debug`` (Nomad 1.0), which captures an
+archive of API state, metrics, and pprof profiles from a live cluster so
+an operator can attach ONE artifact to a bug report instead of a
+transcript of curl commands. This module builds the single-JSON version:
+everything the observability stack retains at the moment of capture —
+
+- ``metrics``     InmemSink interval dump + process-lifetime cumulative
+                  counters/sample-summaries (with reservoir quantiles)
+- ``traces``      tracer summaries, plus full span trees for the most
+                  recently updated traces
+- ``events``      last-K events from the agent's cluster event stream
+                  (nomad_tpu.events) — or, with no agent, from every
+                  broker live in the process
+- ``config``      the effective agent config, secrets redacted
+- ``faults``      the armed fault plan + per-rule fire counts
+- ``breaker``     device circuit-breaker state (scheduler.DEVICE_BREAKER)
+- ``threads``     Python stacks of every live thread (sys._current_frames
+                  — the goroutine-dump analog)
+
+Served by ``/v1/agent/debug/bundle`` (debug-gated, like the rest of the
+introspection surface) and fetched by ``tools/debug_bundle.py``;
+``tools/tier1.py`` writes a process-local bundle next to the junitxml
+when a suite run goes red.
+
+Redaction rule: any config key whose name contains ``token``, ``secret``,
+or ``password`` (case-insensitive) is replaced with ``<redacted>`` when
+set. Paths (cert/key files) are locations, not credentials, and stay.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
+
+# Sections every bundle carries (tests assert this schema; a consumer can
+# rely on the keys existing even when a subsystem was not running — the
+# value is then None or an {"error": ...} stub, never absent).
+BUNDLE_SECTIONS = (
+    "format", "captured_at", "metrics", "traces", "events", "config",
+    "faults", "breaker", "threads",
+)
+
+_SECRET_MARKERS = ("token", "secret", "password")
+
+# Full span trees for at most this many most-recent traces: summaries are
+# cheap, span trees are the expensive part of the tracer dump.
+MAX_FULL_TRACES = 8
+
+
+def redact_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Redact credential-bearing values; coerce everything else to
+    JSON-able primitives (non-primitive objects stringify)."""
+    out: Dict[str, Any] = {}
+    for key, value in config.items():
+        lowered = key.lower()
+        if any(m in lowered for m in _SECRET_MARKERS) and value:
+            out[key] = "<redacted>"
+        elif value is None or isinstance(value, (bool, int, float, str)):
+            out[key] = value
+        elif isinstance(value, dict):
+            out[key] = redact_config(value)
+        elif isinstance(value, (list, tuple)):
+            out[key] = [v if isinstance(v, (bool, int, float, str))
+                        else str(v) for v in value]
+        else:
+            out[key] = str(value)
+    return out
+
+
+def thread_stacks(depth: int = 12) -> Dict[str, List[str]]:
+    """Formatted stacks of every live thread, keyed by thread name — the
+    first thing needed when an agent wedges. Duplicate names (an
+    in-process multi-server cluster runs several ``worker-0``s) get an
+    ``#ident`` suffix instead of silently shadowing each other."""
+    import threading
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, str(ident))
+        key = name if name not in out else f"{name}#{ident}"
+        out[key] = traceback.format_stack(frame)[-depth:]
+    return out
+
+
+def _metrics_section() -> Optional[Dict[str, Any]]:
+    from nomad_tpu import telemetry
+
+    sink = telemetry.get_global().sink
+    if not isinstance(sink, telemetry.InmemSink):
+        sink = next(
+            (s for s in getattr(sink, "sinks", [])
+             if isinstance(s, telemetry.InmemSink)),
+            None,
+        )
+    if sink is None:
+        return None
+    counters, samples = sink.cumulative()
+    return {
+        "intervals": sink.data(),
+        "cumulative": {"counters": counters, "samples": samples},
+    }
+
+
+def _traces_section() -> Dict[str, Any]:
+    from nomad_tpu import trace
+
+    tracer = trace.get_tracer()
+    summaries = tracer.traces()
+    return {
+        "summaries": summaries,
+        "spans": {
+            s["trace_id"]: tracer.get_trace(s["trace_id"])
+            for s in summaries[:MAX_FULL_TRACES]
+        },
+    }
+
+
+def _events_section(agent, last_events: int) -> List[Dict[str, Any]]:
+    from nomad_tpu import events as events_mod
+
+    brokers = []
+    server = getattr(agent, "server", None) if agent is not None else None
+    if server is not None and getattr(server, "fsm", None) is not None:
+        brokers = [server.fsm.events]
+    else:
+        # Process-local capture: whatever brokers are alive right now.
+        with events_mod._brokers_lock:
+            brokers = list(events_mod._BROKERS)
+    out: List[Dict[str, Any]] = []
+    for broker in brokers:
+        out.extend(e.to_dict() for e in broker.all_events())
+    out.sort(key=lambda e: (e["time"], e["index"]))
+    return out[-last_events:] if last_events else out
+
+
+def _breaker_section() -> Dict[str, Any]:
+    try:
+        from nomad_tpu.scheduler import DEVICE_BREAKER
+
+        return DEVICE_BREAKER.stats()
+    except Exception as e:  # pragma: no cover - import-time breakage only
+        return {"error": str(e)}
+
+
+def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
+    """Build the bundle. ``agent`` is a live nomad_tpu.agent.Agent for the
+    full capture; None collects the process-local subset (metrics/faults/
+    breaker/threads + any live event brokers) — the tier-1 red-run path."""
+    from nomad_tpu import faults
+
+    bundle: Dict[str, Any] = {
+        "format": BUNDLE_FORMAT,
+        "captured_at": time.time(),
+        "metrics": None,
+        "traces": None,
+        "events": [],
+        "config": None,
+        "faults": None,
+        "breaker": None,
+        "threads": None,
+    }
+    for section, build in (
+        ("metrics", _metrics_section),
+        ("traces", _traces_section),
+        ("events", lambda: _events_section(agent, last_events)),
+        ("faults", lambda: faults.get_registry().snapshot()),
+        ("breaker", _breaker_section),
+        ("threads", thread_stacks),
+    ):
+        # One wedged subsystem must not cost the whole flight recording.
+        try:
+            bundle[section] = build()
+        except Exception as e:
+            bundle[section] = {"error": str(e)}
+    if agent is not None:
+        try:
+            bundle["config"] = redact_config(vars(agent.config))
+        except Exception as e:
+            bundle["config"] = {"error": str(e)}
+    return bundle

@@ -343,6 +343,16 @@ def fire(site: str, target: str = "") -> Optional[FaultAction]:
     if action is None:
         return None
     telemetry.incr_counter(("faults", site, action.mode))
+    # Every injection lands in the cluster event stream too
+    # (nomad_tpu.events): a chaos replay from a seeded registry then
+    # produces an identical per-site event sequence, and the debug bundle
+    # of a failed run shows WHICH faults actually fired, interleaved with
+    # the state transitions they caused. Broadcast: the registry is
+    # process-global, not owned by any one server.
+    from nomad_tpu import events
+
+    events.broadcast("Fault", "FaultInjected", key=site,
+                     payload={"mode": action.mode, "target": target})
     span = trace.current_span()
     if span is not None:
         span.annotate(f"fault.{site}", action.mode)

@@ -199,6 +199,11 @@ class ClusterServer(Server):
     def _leadership_changed(self, is_leader: bool) -> None:
         """establishLeadership / revokeLeadership (leader.go:99-140,
         240-260)."""
+        self.fsm.events.publish(
+            "Leader", "LeaderAcquired" if is_leader else "LeaderLost",
+            key=self.cluster.node_id,
+            payload={"term": getattr(self.raft, "current_term", 0)},
+        )
         if is_leader:
             self.logger.info("cluster: %s gained leadership",
                              self.cluster.node_id)

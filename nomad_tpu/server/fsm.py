@@ -21,6 +21,7 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
 
 from nomad_tpu import faults, telemetry, trace
+from nomad_tpu.events import EventBroker
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import Allocation, Evaluation, Job, Node
 
@@ -33,9 +34,16 @@ class FSM:
         self,
         eval_broker=None,
         logger: Optional[logging.Logger] = None,
+        events: Optional[EventBroker] = None,
     ):
         self.state = StateStore()
         self.eval_broker = eval_broker
+        # Per-FSM event broker (nomad_tpu.events): every apply publishes
+        # the state transition it just made, stamped with its raft index.
+        # Per-replica ownership is what makes the log exactly-once: each
+        # server applies each committed entry exactly once, so each
+        # server's event stream records exactly one PlanApplied per plan.
+        self.events = events if events is not None else EventBroker()
         # Gate for broker enqueue on apply: in a cluster this is raft
         # leadership, checked synchronously at apply time. The broker's own
         # enabled flag lags leadership changes (they notify asynchronously),
@@ -86,26 +94,49 @@ class FSM:
     # -- handlers (fsm.go:146-297) ----------------------------------------
 
     def _apply_node_register(self, index: int, payload: dict) -> None:
-        self.state.upsert_node(index, payload["node"])
+        node = payload["node"]
+        self.state.upsert_node(index, node)
+        self.events.publish("Node", "NodeRegistered", key=node.id,
+                            raft_index=index,
+                            payload={"status": node.status})
 
     def _apply_node_deregister(self, index: int, payload: dict) -> None:
         self.state.delete_node(index, payload["node_id"])
+        self.events.publish("Node", "NodeDeregistered",
+                            key=payload["node_id"], raft_index=index)
 
     def _apply_node_status_update(self, index: int, payload: dict) -> None:
         self.state.update_node_status(index, payload["node_id"], payload["status"])
+        self.events.publish("Node", "NodeStatusUpdated",
+                            key=payload["node_id"], raft_index=index,
+                            payload={"status": payload["status"]})
 
     def _apply_node_drain_update(self, index: int, payload: dict) -> None:
         self.state.update_node_drain(index, payload["node_id"], payload["drain"])
+        self.events.publish("Node", "NodeDrainUpdated",
+                            key=payload["node_id"], raft_index=index,
+                            payload={"drain": bool(payload["drain"])})
 
     def _apply_job_register(self, index: int, payload: dict) -> None:
-        self.state.upsert_job(index, payload["job"])
+        job = payload["job"]
+        self.state.upsert_job(index, job)
+        self.events.publish("Job", "JobRegistered", key=job.id,
+                            raft_index=index, payload={"type": job.type})
 
     def _apply_job_deregister(self, index: int, payload: dict) -> None:
         self.state.delete_job(index, payload["job_id"])
+        self.events.publish("Job", "JobDeregistered",
+                            key=payload["job_id"], raft_index=index)
 
     def _apply_eval_update(self, index: int, payload: dict) -> None:
         evals = payload["evals"]
         self.state.upsert_evals(index, evals)
+        for ev in evals:
+            self.events.publish("Eval", "EvalUpdated", key=ev.id,
+                                raft_index=index,
+                                payload={"status": ev.status,
+                                         "job_id": ev.job_id,
+                                         "triggered_by": ev.triggered_by})
         # On the leader, hand pending evals to the broker (fsm.go:243-250).
         # wait_index = the eval's own apply index: the worker's snapshot
         # must contain at least the write that created the eval.
@@ -119,24 +150,59 @@ class FSM:
 
     def _apply_eval_delete(self, index: int, payload: dict) -> None:
         self.state.delete_eval(index, payload["evals"], payload["allocs"])
+        for ev_id in payload["evals"]:
+            self.events.publish("Eval", "EvalDeleted", key=ev_id,
+                                raft_index=index)
 
     def _apply_alloc_update(self, index: int, payload: dict) -> None:
         allocs = payload.get("allocs") or []
         if allocs:
             self.state.upsert_allocs(index, allocs)
+            # Per-alloc events only for object rows: bounded by plan size.
+            for a in allocs:
+                self.events.publish(
+                    "Alloc", "AllocUpserted", key=a.id, raft_index=index,
+                    payload={"node_id": a.node_id, "job_id": a.job_id,
+                             "desired_status": a.desired_status},
+                )
         # Columnar placements commit as stored blocks — O(node runs), no
         # per-Allocation expansion (state/blocks.py).
         batches = payload.get("alloc_batches") or []
         if batches:
             self.state.upsert_alloc_blocks(index, batches)
+            # One event per BLOCK, keyed by eval — per-member fan-out
+            # would cost O(placements) per commit (the state watch makes
+            # the same granularity cut for bulk columnar transitions).
+            for b in batches:
+                self.events.publish(
+                    "Alloc", "AllocUpserted", key=b.eval_id,
+                    raft_index=index,
+                    payload={"columnar": True,
+                             "count": int(sum(b.node_counts))},
+                )
         # Columnar in-place updates: whole-block field swaps where a batch
         # covers a stored block, row re-stamps elsewhere.
         ubatches = payload.get("update_batches") or []
         if ubatches:
             self.state.apply_update_batches(index, ubatches)
+        # The plan applier marks plan commits (plan_apply.py _apply): one
+        # PlanApplied per committed plan entry, after its alloc events.
+        plan_meta = payload.get("plan")
+        if plan_meta:
+            self.events.publish(
+                "Plan", "PlanApplied", key=plan_meta.get("eval_id", ""),
+                raft_index=index,
+                payload={k: v for k, v in plan_meta.items()
+                         if k != "eval_id"},
+            )
 
     def _apply_alloc_client_update(self, index: int, payload: dict) -> None:
         self.state.update_allocs_from_client(index, payload["allocs"])
+        for a in payload["allocs"]:
+            self.events.publish(
+                "Alloc", "AllocClientUpdated", key=a.id, raft_index=index,
+                payload={"client_status": a.client_status},
+            )
 
     # -- snapshot/restore (fsm.go:299-593) ---------------------------------
 

@@ -75,6 +75,12 @@ class HeartbeatManager:
         self.server.logger.warning(
             "heartbeat: node '%s' TTL expired, marking down", node_id
         )
+        # TTL expiry is a state transition the replicated log only shows
+        # as the resulting NodeStatusUpdated; the expiry itself is a
+        # leader-local decision, published from here (nomad_tpu.events).
+        self.server.fsm.events.publish(
+            "Node", "NodeHeartbeatExpired", key=node_id
+        )
         try:
             self.server.node_update_status(node_id, NODE_STATUS_DOWN)
         except Exception:

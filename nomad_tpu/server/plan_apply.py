@@ -1017,7 +1017,8 @@ class PlanApplier(threading.Thread):
             apply_span = tracer.start_span(
                 eval_id, "plan.apply", parent=plan_ctx
             )
-            future = self._apply(result, snap, span=apply_span)
+            future = self._apply(result, snap, span=apply_span,
+                                 plan=pending.plan)
             wait_event = threading.Event()
             t = threading.Thread(
                 target=self._async_plan_wait,
@@ -1026,7 +1027,7 @@ class PlanApplier(threading.Thread):
             )
             t.start()
 
-    def _apply(self, result: PlanResult, snap, span=None):
+    def _apply(self, result: PlanResult, snap, span=None, plan=None):
         """Dispatch the replicated alloc update + optimistic snapshot apply
         (plan_apply.go:119-144)."""
         t0 = time.perf_counter()
@@ -1036,6 +1037,17 @@ class PlanApplier(threading.Thread):
             payload["alloc_batches"] = result.alloc_batches
         if result.update_batches:
             payload["update_batches"] = result.update_batches
+        if plan is not None:
+            # Plan provenance rides the replicated entry so EVERY
+            # replica's FSM publishes exactly one PlanApplied per
+            # committed plan (nomad_tpu.events) — emitting here instead
+            # would tie the event to the leader that happened to submit.
+            payload["plan"] = {
+                "eval_id": plan.eval_id,
+                "allocs": len(allocs),
+                "alloc_batches": len(result.alloc_batches),
+                "update_batches": len(result.update_batches),
+            }
         # A synchronous replication layer (InProcRaft) applies on THIS
         # thread: the active-span install lets the FSM hang its fsm.apply
         # span under plan.apply. An async raft applies elsewhere and only

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu import structs
+from nomad_tpu.events import EventBroker
 from nomad_tpu.server.core_sched import CoreScheduler
 from nomad_tpu.server.eval_broker import EvalBroker
 from nomad_tpu.server.fsm import FSM, InProcRaft
@@ -81,6 +82,10 @@ class ServerConfig:
     # Optional TLS on the RPC tier (reference nomad/rpc.go:104-110 rpcTLS
     # + tlsutil): a nomad_tpu.tlsutil.TLSConfig; None runs plaintext.
     tls: object = None
+    # Ring size of the cluster event stream (nomad_tpu.events) — the
+    # /v1/event/stream resume window. Consumers further behind than this
+    # get a truncation marker and must re-list.
+    event_buffer_size: int = 2048
 
     def scheduler_factory(self, eval_type: str) -> str:
         if self.scheduler_backend == "tpu" and eval_type in (
@@ -104,7 +109,11 @@ class Server:
         self.eval_broker = EvalBroker(
             self.config.eval_nack_timeout, self.config.eval_delivery_limit
         )
-        self.fsm = FSM(eval_broker=self.eval_broker, logger=self.logger)
+        self.fsm = FSM(
+            eval_broker=self.eval_broker, logger=self.logger,
+            events=EventBroker(capacity=self.config.event_buffer_size,
+                               emitter=self.config.node_name),
+        )
         self.raft = InProcRaft(self.fsm)
         self.plan_queue = PlanQueue()
         self.time_table = TimeTable()

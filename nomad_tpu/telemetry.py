@@ -16,6 +16,7 @@ set_gauge / incr_counter / add_sample / measure_since, an interval-aggregated
 from __future__ import annotations
 
 import math
+import random as _rand
 import signal
 import socket
 import sys
@@ -42,11 +43,23 @@ def _flat(key: Key) -> str:
     return s
 
 
+# Bounded reservoir per sample series (Vitter's algorithm R): big enough
+# that p99 over a bench run is meaningful, small enough that a sink
+# retaining hundreds of series stays cheap. Mean/max alone cannot answer
+# "is the agent's own p50 consistent with bench.py's claim?" — quantiles
+# need (a sketch of) the distribution.
+RESERVOIR_SIZE = 256
+
+QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+
 class AggregateSample:
     """Streaming aggregate of one sample series within an interval
-    (go-metrics inmem.go AggregateSample)."""
+    (go-metrics inmem.go AggregateSample), extended with a bounded
+    uniform reservoir so retained intervals report p50/p95/p99."""
 
-    __slots__ = ("count", "sum", "sum_sq", "min", "max", "last", "last_time")
+    __slots__ = ("count", "sum", "sum_sq", "min", "max", "last", "last_time",
+                 "reservoir")
 
     def __init__(self):
         self.count = 0
@@ -56,6 +69,7 @@ class AggregateSample:
         self.max = 0.0
         self.last = 0.0
         self.last_time = 0.0
+        self.reservoir: List[float] = []
 
     def ingest(self, v: float) -> None:
         if self.count == 0 or v < self.min:
@@ -67,6 +81,14 @@ class AggregateSample:
         self.sum_sq += v * v
         self.last = v
         self.last_time = time.time()
+        # Algorithm R: after the reservoir fills, sample i survives with
+        # probability RESERVOIR_SIZE/i — a uniform sample of the series.
+        if len(self.reservoir) < RESERVOIR_SIZE:
+            self.reservoir.append(v)
+        else:
+            j = _rand.randrange(self.count)
+            if j < RESERVOIR_SIZE:
+                self.reservoir[j] = v
 
     @property
     def mean(self) -> float:
@@ -78,6 +100,17 @@ class AggregateSample:
             return 0.0
         var = (self.sum_sq - self.sum * self.sum / self.count) / (self.count - 1)
         return math.sqrt(var) if var > 0 else 0.0
+
+    def quantiles(self) -> Dict[str, float]:
+        """Nearest-rank p50/p95/p99 over the reservoir (0 when empty)."""
+        if not self.reservoir:
+            return {name: 0.0 for name, _ in QUANTILES}
+        ordered = sorted(self.reservoir)
+        n = len(ordered)
+        return {
+            name: ordered[max(0, min(n - 1, math.ceil(p * n) - 1))]
+            for name, p in QUANTILES
+        }
 
     def __repr__(self) -> str:
         return (
@@ -109,9 +142,11 @@ class InmemSink:
         # vocabulary is finite): the Prometheus exposition needs
         # monotonic counters — a rolling-window sum DECREASES as
         # intervals age out, which rate()/increase() reads as counter
-        # resets and turns into spurious rate spikes.
+        # resets and turns into spurious rate spikes. Samples keep a full
+        # AggregateSample so the exposition serves lifetime quantiles
+        # from its reservoir, not just sum/count/max.
         self._cum_counters: Dict[str, List[float]] = {}  # [sum, count]
-        self._cum_samples: Dict[str, List[float]] = {}   # [sum, count, max]
+        self._cum_samples: Dict[str, AggregateSample] = {}
         self._lock = threading.Lock()
 
     def _current(self) -> IntervalMetrics:
@@ -154,22 +189,23 @@ class InmemSink:
             agg.ingest(value)
             cum = self._cum_samples.get(name)
             if cum is None:
-                self._cum_samples[name] = [value, 1, value]
-            else:
-                cum[0] += value
-                cum[1] += 1
-                if value > cum[2]:
-                    cum[2] = value
+                cum = self._cum_samples[name] = AggregateSample()
+            cum.ingest(value)
 
     def cumulative(self) -> Tuple[Dict[str, List[float]],
-                                  Dict[str, List[float]]]:
-        """(counters {name: [sum, count]}, samples {name: [sum, count,
-        max]}) over the process lifetime — the monotonic series the
-        Prometheus exposition serves."""
+                                  Dict[str, Dict[str, float]]]:
+        """(counters {name: [sum, count]}, samples {name: {sum, count,
+        max, p50, p95, p99}}) over the process lifetime — the monotonic
+        series (plus reservoir quantiles) the Prometheus exposition
+        serves."""
         with self._lock:
             return (
                 {k: list(v) for k, v in self._cum_counters.items()},
-                {k: list(v) for k, v in self._cum_samples.items()},
+                {
+                    k: {"sum": a.sum, "count": a.count, "max": a.max,
+                        **a.quantiles()}
+                    for k, a in self._cum_samples.items()
+                },
             )
 
     def data(self) -> List[dict]:
@@ -185,6 +221,7 @@ class InmemSink:
                 "mean": agg.mean,
                 "stddev": agg.stddev,
                 "last": agg.last,
+                **agg.quantiles(),
             }
 
         out: List[dict] = []
@@ -425,12 +462,19 @@ def prometheus_text(inmem: InmemSink) -> str:
         lines.append(f"{name} {_fmt(counters[key][0])}")
     for key in sorted(samples):
         name = _prom_name(key) + "_ms"
-        total, count, peak = samples[key]
+        s = samples[key]
+        # Summary with quantile labels (the Prometheus summary type's
+        # native shape): reservoir-backed, so bench.py's p50 claims are
+        # cross-checkable against the agent's own exposition.
         lines.append(f"# TYPE {name} summary")
-        lines.append(f"{name}_sum {_fmt(total)}")
-        lines.append(f"{name}_count {int(count)}")
+        for qname, q in QUANTILES:
+            lines.append(
+                f'{name}{{quantile="{q}"}} {_fmt(s[qname])}'
+            )
+        lines.append(f"{name}_sum {_fmt(s['sum'])}")
+        lines.append(f"{name}_count {int(s['count'])}")
         lines.append(f"# TYPE {name}_max gauge")
-        lines.append(f"{name}_max {_fmt(peak)}")
+        lines.append(f"{name}_max {_fmt(s['max'])}")
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,511 @@
+"""Cluster event stream + operator debug bundle.
+
+Unit tier: the broker's ordering contract (strictly increasing gapless
+indices, even under concurrent FSM applies), bounded-buffer eviction with
+the truncation marker, topic filtering, and the blocking-consumption path
+(long-poll wake + timeout) over HTTP.
+
+Chaos tier: a PR-2 seeded scenario (one-way leader partition mid-plan)
+asserting the event log records exactly ONE PlanApplied per committed
+plan, and a determinism check — two runs with the same fault seed produce
+identical event-type sequences.
+
+Bundle tier: /v1/agent/debug/bundle schema, secret redaction, and the
+debug gate. Reference posture: nomad/stream/event_broker.go (Nomad 1.0
+/v1/event/stream) + `nomad operator debug`.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import events, faults, mock, structs
+from nomad_tpu.events import EventBroker, TopicFilter
+from nomad_tpu.server.fsm import FSM, InProcRaft
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.get_registry().clear()
+    yield
+    faults.get_registry().clear()
+
+
+# ---------------------------------------------------------------------------
+# Broker: ordering, eviction, filtering
+# ---------------------------------------------------------------------------
+
+
+def test_index_monotonic_under_concurrent_fsm_applies():
+    """Many threads racing raft applies: the per-FSM event log still has
+    strictly increasing indices with no gaps or duplicates, and every
+    event carries the raft index of the entry that produced it."""
+    fsm = FSM()
+    raft = InProcRaft(fsm)
+    n_threads, n_each = 8, 40
+
+    def pump():
+        for _ in range(n_each):
+            raft.apply("node_register", {"node": mock.node()})
+
+    threads = [threading.Thread(target=pump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    evs = fsm.events.all_events()
+    assert len(evs) == n_threads * n_each
+    assert [e.index for e in evs] == list(range(1, len(evs) + 1))
+    # raft indices are monotonic too (publish happens under the apply
+    # lock) and each event names the entry that produced it.
+    raft_indices = [e.raft_index for e in evs]
+    assert raft_indices == sorted(raft_indices)
+    assert all(e.type == "NodeRegistered" for e in evs)
+
+
+def test_bounded_eviction_and_truncation_marker():
+    broker = EventBroker(capacity=16, register=False)
+    for i in range(50):
+        broker.publish("Node", "NodeRegistered", key=f"n{i}")
+    assert broker.get_index() == 50
+    assert broker.horizon() == 35  # 50 - 16 + 1
+
+    # Resume from 0: events before the horizon were evicted — truncated.
+    idx, evs, truncated = broker.events_after(0)
+    assert truncated
+    assert idx == 50
+    assert [e.index for e in evs] == list(range(35, 51))
+
+    # Resume exactly at the horizon boundary: nothing was missed.
+    idx, evs, truncated = broker.events_after(34)
+    assert not truncated
+    assert [e.index for e in evs] == list(range(35, 51))
+
+    # Fully caught up: empty page, still not truncated.
+    idx, evs, truncated = broker.events_after(50)
+    assert not truncated and evs == []
+
+
+def test_topic_filtering():
+    broker = EventBroker(register=False)
+    broker.publish("Node", "NodeRegistered", key="node-7")
+    broker.publish("Node", "NodeRegistered", key="node-8")
+    broker.publish("Eval", "EvalUpdated", key="ev-1")
+    broker.publish("Job", "JobRegistered", key="job-1")
+
+    _, evs, _ = broker.events_after(0, TopicFilter(["Eval"]))
+    assert [e.type for e in evs] == ["EvalUpdated"]
+
+    _, evs, _ = broker.events_after(0, TopicFilter(["Node:node-7"]))
+    assert [(e.type, e.key) for e in evs] == [("NodeRegistered", "node-7")]
+
+    _, evs, _ = broker.events_after(0, TopicFilter(["Eval", "Node:node-7"]))
+    assert len(evs) == 2
+
+    # '*' and no selection both match everything.
+    _, evs, _ = broker.events_after(0, TopicFilter(["*"]))
+    assert len(evs) == 4
+    assert TopicFilter([]).matches(evs[0])
+
+    # Bare topic subsumes a keyed selection of the same topic.
+    tf = TopicFilter(["Node:node-7", "Node"])
+    _, evs, _ = broker.events_after(0, tf)
+    assert [e.key for e in evs] == ["node-7", "node-8"]
+
+    # Filtered waiters park on per-topic items only.
+    assert events.item_topic("Eval") in TopicFilter(["Eval"]).watch_items()
+    assert TopicFilter([]).watch_items() == [events.ITEM_ANY]
+
+
+def test_broadcast_reaches_live_brokers():
+    """Process-scoped emitters (faults, breaker) fan out to every live
+    broker; a garbage-collected broker drops out of the registry."""
+    b1 = EventBroker()
+    b2 = EventBroker()
+    events.broadcast("Fault", "FaultInjected", key="rpc.send",
+                     payload={"mode": "drop"})
+    for b in (b1, b2):
+        _, evs, _ = b.events_after(0, TopicFilter(["Fault"]))
+        assert [e.type for e in evs] == ["FaultInjected"]
+        assert evs[0].payload["mode"] == "drop"
+
+
+def test_fault_fire_and_breaker_transitions_publish_events():
+    broker = EventBroker()
+    faults.get_registry().configure("solver.execute", mode="error", count=1)
+    try:
+        faults.fire("solver.execute", target="probe")
+    finally:
+        faults.get_registry().clear()
+    _, evs, _ = broker.events_after(0, TopicFilter(["Fault"]))
+    assert [(e.type, e.key) for e in evs] == [("FaultInjected",
+                                               "solver.execute")]
+    assert evs[0].payload == {"mode": "error", "target": "probe"}
+
+    from nomad_tpu.backoff import CircuitBreaker
+
+    cb = CircuitBreaker(threshold=1, cooldown=60.0, name=("t", "breaker"))
+    cb.record_failure()  # closed -> open
+    _, evs, _ = broker.events_after(0, TopicFilter(["Breaker"]))
+    assert [(e.type, e.key) for e in evs] == [("BreakerStateChanged",
+                                               "t.breaker")]
+    assert evs[0].payload["to"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# HTTP tier: long-poll, SSE, client SDK, debug bundle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    config = AgentConfig(
+        server_enabled=True, dev_mode=True, node_name="events-dev",
+        enable_debug=True,
+    )
+    config.data_dir = str(tmp_path_factory.mktemp("events-agent"))
+    config.http_port = 0
+    config.scheduler_backend = "host"
+    a = Agent(config)
+    a.start()
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture()
+def client(agent):
+    from nomad_tpu.api.client import ApiClient
+
+    return ApiClient(address=agent.http.addr)
+
+
+def test_event_stream_end_to_end(client, agent):
+    """A job registration produces the canonical lifecycle sequence, in
+    index order, resumable mid-stream."""
+    job = mock.job()
+    ev_id, _ = client.jobs().register(job)
+    ev = agent.server.wait_for_eval(ev_id, timeout=15.0)
+    assert ev.status == structs.EVAL_STATUS_COMPLETE
+
+    idx, evs, truncated = client.events().list()
+    assert not truncated
+    indices = [e["index"] for e in evs]
+    assert indices == sorted(indices) and len(set(indices)) == len(indices)
+    types = [e["type"] for e in evs if e["key"] in (job.id, ev_id)
+             or e["payload"].get("job_id") == job.id]
+    assert types[0] == "JobRegistered"
+    assert "PlanApplied" in types
+    assert types.count("PlanApplied") == 1
+    # Terminal eval update comes after the plan applied.
+    assert types.index("PlanApplied") < len(types) - 1
+
+    # Resume: nothing new past the cursor.
+    idx2, evs2, _ = client.events().list(index=idx, wait="200ms")
+    assert evs2 == [] and idx2 == idx
+
+    # Topic + key filter straight off the query string.
+    _, only_job, _ = client.events().list(topics=[f"Job:{job.id}"])
+    assert [e["type"] for e in only_job] == ["JobRegistered"]
+
+
+def test_event_stream_long_poll_wake_and_timeout(client, agent):
+    idx, _, _ = client.events().list()
+
+    # Timeout: no new event arrives — the poll returns empty at ~wait.
+    t0 = time.monotonic()
+    idx2, evs, _ = client.events().list(index=idx, wait="300ms")
+    assert evs == [] and idx2 == idx
+    assert 0.2 <= time.monotonic() - t0 < 5.0
+
+    # Wake: a registration lands mid-poll and the poll returns early.
+    def register_later():
+        time.sleep(0.3)
+        client.jobs().register(mock.job())
+
+    t = threading.Thread(target=register_later)
+    t.start()
+    t0 = time.monotonic()
+    _, evs, _ = client.events().list(index=idx, wait="10s")
+    waited = time.monotonic() - t0
+    t.join()
+    assert evs, "long-poll returned empty despite a publish"
+    assert waited < 8.0
+
+
+def test_event_stream_filtered_long_poll_ignores_other_topics(client, agent):
+    """A topic-filtered long-poll must NOT return early on unrelated
+    publishes — probing the global index would turn a filtered tail on a
+    busy cluster into one empty page per event batch."""
+    idx, _, _ = client.events().list()
+
+    def unrelated_later():
+        time.sleep(0.2)
+        client.jobs().register(mock.job())  # Job/Eval/... events, no Fault
+
+    t = threading.Thread(target=unrelated_later)
+    t.start()
+    t0 = time.monotonic()
+    _, evs, _ = client.events().list(index=idx, topics=["Fault"],
+                                     wait="700ms")
+    waited = time.monotonic() - t0
+    t.join()
+    assert evs == []
+    assert waited >= 0.5, f"filtered poll woke early ({waited:.2f}s)"
+
+
+def test_event_stream_sse_framing(client, agent):
+    client.jobs().register(mock.job())
+    req = urllib.request.Request(
+        client.address + "/v1/event/stream?format=sse&wait=500ms"
+    )
+    with urllib.request.urlopen(req, timeout=15.0) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        body = resp.read().decode()
+    frames = [f for f in body.split("\n\n") if f.strip()
+              and not f.startswith(":")]
+    assert frames, body
+    for frame in frames:
+        lines = dict(
+            line.split(": ", 1) for line in frame.splitlines()
+            if ": " in line
+        )
+        payload = json.loads(lines["data"])
+        assert lines["event"] == payload["type"]
+        assert int(lines["id"]) == payload["index"]
+
+
+def test_events_stream_iterator_resumes(client, agent):
+    """The SDK iterator pages through ?index= resume without gaps or
+    repeats."""
+    job = mock.job()
+    client.jobs().register(job)
+    time.sleep(0.2)
+    seen = []
+    for event in client.events().stream(poll_wait="200ms"):
+        seen.append(event)
+        if any(e["type"] == "JobRegistered" and e["key"] == job.id
+               for e in seen):
+            break
+    indices = [e["index"] for e in seen]
+    assert indices == sorted(indices) and len(set(indices)) == len(indices)
+
+
+def test_events_stream_iterator_truncation_marker():
+    """A resume cursor that fell off the ring yields the synthetic
+    Truncated marker first."""
+    from nomad_tpu.api.client import Events
+
+    class _FakeClient:
+        def query(self, path, q=None, params=None):
+            return {"index": 60, "truncated": True,
+                    "events": [{"index": 60, "type": "EvalUpdated",
+                                "topic": "Eval", "key": "e",
+                                "payload": {}}]}, None
+
+    out = []
+    for event in Events(_FakeClient()).stream(index=3):
+        out.append(event)
+        if len(out) == 2:
+            break
+    assert out[0]["topic"] == "Truncated"
+    assert out[1]["type"] == "EvalUpdated"
+
+
+def test_debug_bundle_schema_and_redaction(client, agent):
+    from nomad_tpu.bundle import BUNDLE_FORMAT, BUNDLE_SECTIONS
+
+    # Make sure there is something in every section.
+    client.jobs().register(mock.job())
+    time.sleep(0.2)
+    agent.config.atlas_token = "hunter2"
+    try:
+        bundle = client.agent().debug_bundle()
+    finally:
+        agent.config.atlas_token = ""
+    for section in BUNDLE_SECTIONS:
+        assert section in bundle, f"bundle missing {section!r}"
+    assert bundle["format"] == BUNDLE_FORMAT
+    assert bundle["config"]["atlas_token"] == "<redacted>"
+    assert bundle["config"]["node_name"] == "events-dev"
+    assert bundle["events"], "bundle carries no events"
+    assert any("http" in name or "MainThread" in name
+               for name in bundle["threads"]), bundle["threads"].keys()
+    assert bundle["breaker"]["state"] in ("closed", "half_open", "open")
+    assert "sites" in bundle["faults"]
+    assert "intervals" in bundle["metrics"]
+    assert "cumulative" in bundle["metrics"]
+    json.dumps(bundle)  # the artifact is a single JSON document
+
+
+def test_debug_bundle_is_debug_gated(tmp_path):
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import ApiClient, ApiError
+
+    config = AgentConfig(server_enabled=True, dev_mode=True)
+    config.data_dir = str(tmp_path)
+    config.http_port = 0
+    config.scheduler_backend = "host"
+    a = Agent(config)
+    a.start()
+    try:
+        api = ApiClient(address=a.http.addr)
+        with pytest.raises(ApiError) as err:
+            api.agent().debug_bundle()
+        assert err.value.code == 404
+        # Piggyback on the untouched agent: ?index=0 against an EMPTY
+        # broker returns immediately (no event has ever been published,
+        # so the index probe alone would park the poll).
+        t0 = time.monotonic()
+        idx, evs, truncated = api.events().list()
+        assert evs == [] and idx == 0 and not truncated
+        assert time.monotonic() - t0 < 5.0
+        # SSE with no ?wait= (tail-forever mode) must not 400: the first
+        # retained bytes arrive once an event lands.
+        a.server.node_register(mock.node())
+        req = urllib.request.Request(a.http.addr + "/v1/event/stream",
+                                     headers={"Accept": "text/event-stream"})
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            assert resp.status == 200
+            first = resp.read(24).decode()
+        assert first.startswith("event: NodeRegistered")
+    finally:
+        a.shutdown()
+
+
+def test_process_local_bundle():
+    """The no-agent capture path tier1.py uses on a red run."""
+    from nomad_tpu.bundle import BUNDLE_SECTIONS, collect
+
+    broker = EventBroker()
+    broker.publish("Node", "NodeRegistered", key="n1")
+    bundle = collect(agent=None, last_events=10)
+    for section in BUNDLE_SECTIONS:
+        assert section in bundle
+    assert bundle["config"] is None  # no agent, no config
+    assert any(e["type"] == "NodeRegistered" for e in bundle["events"])
+    assert bundle["threads"]
+    json.dumps(bundle, default=str)
+
+
+# ---------------------------------------------------------------------------
+# Determinism + chaos tier
+# ---------------------------------------------------------------------------
+
+
+def _run_seeded_workload(seed: int):
+    """One dev server, a seeded fault plan, a serial workload; returns the
+    event-type sequence of the server's log."""
+    from nomad_tpu.server import Server, ServerConfig
+
+    srv = Server(ServerConfig(
+        scheduler_backend="host", num_schedulers=1,
+        min_heartbeat_ttl=300.0, prewarm_shapes=False,
+    ))
+    srv.start()
+    try:
+        faults.get_registry().load({"seed": seed, "sites": {
+            # fsm.apply fires once per applied entry on the applying
+            # thread: its decisions (and the FaultInjected events they
+            # publish) land at deterministic positions in the log.
+            "fsm.apply": {"mode": "delay", "delay": 0.001,
+                          "probability": 0.5},
+        }})
+        for _ in range(3):
+            srv.node_register(mock.node())
+        for _ in range(3):
+            ev_id, _ = srv.job_register(mock.job())
+            ev = srv.wait_for_eval(ev_id, timeout=15.0)
+            assert ev.status == structs.EVAL_STATUS_COMPLETE
+        return [e.type for e in srv.fsm.events.all_events()]
+    finally:
+        faults.get_registry().clear()
+        srv.shutdown()
+
+
+def test_same_seed_identical_event_type_sequences():
+    """Acceptance: two runs with the same fault seed produce identical
+    event-type sequences — the chaos replay contract."""
+    first = _run_seeded_workload(seed=42)
+    second = _run_seeded_workload(seed=42)
+    assert first == second
+    assert "FaultInjected" in first  # the plan really fired
+    assert first.count("PlanApplied") == 3  # one per job
+
+
+def test_chaos_leader_partition_one_plan_applied_per_placement():
+    """PR-2 chaos scenario: one-way partition of the leader's outbound
+    raft traffic mid-plan. After failover the surviving leader's event
+    log must record exactly one PlanApplied per committed plan, with
+    strictly increasing gapless broker indices."""
+    from cluster_util import relaxed_cluster_cfg, retry_write
+    from nomad_tpu.server import ServerConfig
+    from nomad_tpu.server.cluster import form_cluster, wait_for_leader
+
+    servers = form_cluster(3, ServerConfig(
+        scheduler_backend="host", num_schedulers=1,
+        min_heartbeat_ttl=300.0,
+    ), base_cluster=relaxed_cluster_cfg())
+    try:
+        leader = wait_for_leader(servers)
+        nodes = [mock.node() for _ in range(12)]
+        for node in nodes:
+            retry_write(lambda n=node: leader.node_register(n))
+        jobs, eval_ids = [], []
+        for _ in range(4):
+            job = mock.job()
+            ev_id, _ = retry_write(lambda j=job: leader.job_register(j))
+            jobs.append(job)
+            eval_ids.append(ev_id)
+
+        old_id = leader.cluster.node_id
+        faults.get_registry().load({"seed": 7, "sites": {
+            "raft.append": {"mode": "partition", "match": f"{old_id}->"},
+            "raft.vote": {"mode": "partition", "match": f"{old_id}->"},
+        }})
+
+        survivors = [s for s in servers if s is not leader]
+        deadline = time.monotonic() + 30.0
+        new_leader = None
+        while time.monotonic() < deadline:
+            live = [s for s in survivors if s.raft.is_leader]
+            if live:
+                new_leader = live[0]
+                break
+            time.sleep(0.05)
+        assert new_leader is not None, "no survivor took leadership"
+
+        store = new_leader.state_store
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            evs = [store.eval_by_id(i) for i in eval_ids]
+            if all(e is not None and e.terminal_status() for e in evs):
+                break
+            time.sleep(0.1)
+        placed_evals = set()
+        for job in jobs:
+            live = structs.filter_terminal_allocs(store.allocs_by_job(job.id))
+            assert len(live) == job.task_groups[0].count
+            placed_evals.update(a.eval_id for a in live)
+
+        log = new_leader.fsm.events.all_events()
+        indices = [e.index for e in log]
+        assert indices == list(range(indices[0], indices[0] + len(indices)))
+
+        plan_evals = [e.key for e in log if e.type == "PlanApplied"]
+        # Exactly once: no eval's plan committed twice despite the
+        # partition, redelivery, and failover — and every placement's
+        # eval shows exactly one committed plan.
+        assert len(plan_evals) == len(set(plan_evals)), plan_evals
+        assert placed_evals <= set(plan_evals)
+        # Failover is visible in the log too.
+        assert any(e.type == "LeaderAcquired" for e in log)
+    finally:
+        faults.get_registry().clear()
+        for srv in servers:
+            srv.shutdown()

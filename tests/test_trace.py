@@ -194,11 +194,15 @@ def test_prometheus_exposition_golden():
     assert f"{name}_sum 20" in lines
     assert f"{name}_count 2" in lines
     assert f"{name}_max 12.5" in lines
+    # Summary quantiles from the reservoir (both samples retained here).
+    assert f'{name}{{quantile="0.5"}} 7.5' in lines
+    assert f'{name}{{quantile="0.99"}} 12.5' in lines
     # every exposed series name is valid for the Prometheus data model
+    # (labels — {quantile="..."} — are not part of the name)
     for line in lines:
         if line.startswith("#"):
             continue
-        metric = line.split(" ")[0]
+        metric = line.split(" ")[0].split("{")[0]
         assert metric[0].isalpha() or metric[0] in "_:"
         assert all(c.isalnum() or c in "_:" for c in metric)
 
@@ -233,7 +237,7 @@ def test_inmem_sink_data_structure():
     assert ivl["counters"]["c"]["sum"] == 2.0
     assert ivl["samples"]["d"] == {
         "count": 1, "sum": 5.0, "min": 5.0, "max": 5.0, "mean": 5.0,
-        "stddev": 0.0, "last": 5.0,
+        "stddev": 0.0, "last": 5.0, "p50": 5.0, "p95": 5.0, "p99": 5.0,
     }
     json.dumps(data)  # JSON-able as served
 

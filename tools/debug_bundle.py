@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Capture an operator debug bundle from a live agent.
+
+The CLI face of the flight recorder (the ``nomad operator debug`` analog):
+hits ``/v1/agent/debug/bundle`` on a running agent and writes the single
+JSON artifact — metrics snapshot + cumulative series, recent traces,
+last-K events, redacted config, armed fault plan, breaker state, and
+thread stacks — that you attach when a bench or chaos run goes sideways.
+
+Usage::
+
+    python tools/debug_bundle.py [-a http://127.0.0.1:4646] [-o out.json]
+    python tools/debug_bundle.py --local   # no agent: process-local bundle
+
+The agent must run with ``enable_debug`` (the bundle rides the debug-gated
+introspection surface). ``--local`` skips the HTTP hop and collects the
+process-local subset — what tools/tier1.py does on a red run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="capture an operator debug bundle")
+    parser.add_argument(
+        "-a", "--address", default="http://127.0.0.1:4646",
+        help="agent HTTP address (default %(default)s)")
+    parser.add_argument(
+        "-o", "--output", default="-",
+        help="output path ('-' = stdout, the default)")
+    parser.add_argument(
+        "--events", type=int, default=512,
+        help="max events to include (default %(default)s)")
+    parser.add_argument(
+        "--local", action="store_true",
+        help="collect a process-local bundle instead of hitting an agent")
+    args = parser.parse_args(argv)
+
+    if args.local:
+        from nomad_tpu.bundle import collect
+
+        bundle = collect(agent=None, last_events=args.events)
+    else:
+        from nomad_tpu.api.client import ApiClient, ApiError
+
+        try:
+            bundle = ApiClient(address=args.address).agent().debug_bundle(
+                events=args.events
+            )
+        except ApiError as e:
+            hint = (
+                " (is the agent running with enable_debug?)"
+                if e.code == 404 else ""
+            )
+            print(f"debug_bundle: {e}{hint}", file=sys.stderr)
+            return 1
+
+    text = json.dumps(bundle, indent=2, default=str)
+    if args.output == "-":
+        print(text)
+    else:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        print(f"debug_bundle: wrote {args.output} "
+              f"({len(text)} bytes, {len(bundle.get('events') or [])} events)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
