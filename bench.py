@@ -395,6 +395,35 @@ def run_coalesced(nodes):
         srv.shutdown()
 
 
+def run_simload():
+    """Control-plane arm: placements/s and plan latency through the FULL
+    register→heartbeat→eval→broker→worker→solver→plan_apply→raft path —
+    a simcluster scenario against a real ClusterServer over real RPC
+    (nomad_tpu/simcluster). The headline above measures the solver in
+    isolation; this number is the same metric with the whole control
+    plane in the loop, so the two together bound where the pipeline (not
+    the kernel) is the ceiling. Scenario via NOMAD_TPU_BENCH_SIMLOAD
+    (default steady-1k: cheap enough to ride every capture; the 10k-node
+    artifacts are banked by tools/simload.py runs)."""
+    from nomad_tpu.simcluster import run_scenario
+
+    name = os.environ.get("NOMAD_TPU_BENCH_SIMLOAD", "steady-1k")
+    art = run_scenario(name, seed=42)
+    return {
+        "scenario": name,
+        "n_nodes": art["n_nodes"],
+        "placed": art["placements"]["placed"],
+        "placements_per_sec": art["placements"]["placements_per_sec"],
+        "plan_latency_ms_p50": art["plan_latency_ms"].get("p50_ms"),
+        "plan_latency_ms_p95": art["plan_latency_ms"].get("p95_ms"),
+        "device_dispatches": art["placements"]["device_dispatches"],
+        "broker_ready_peak": art["peaks"]["broker_ready"],
+        "plan_queue_depth_peak": art["peaks"]["plan_queue_depth"],
+        "heartbeat_timers": art["heartbeat"]["timers"],
+        "registration_nodes_per_sec": art["registration"]["nodes_per_sec"],
+    }
+
+
 def _wait_evals_complete(srv, eval_ids, timeout):
     from nomad_tpu import structs
 
@@ -964,7 +993,8 @@ def main():
             # Failures report per-config without sinking the headline.
             for name, fn in (("config2", run_config2),
                              ("config4", run_config4),
-                             ("config5", run_config5)):
+                             ("config5", run_config5),
+                             ("simload", run_simload)):
                 try:
                     aux[name] = fn()
                 except Exception as e:
@@ -1084,7 +1114,8 @@ def _cpu_fallback_headline():
     if not HEADLINE_ONLY:
         for name, fn in (("config2", run_config2),
                          ("config4", run_config4),
-                         ("config5", run_config5)):
+                         ("config5", run_config5),
+                         ("simload", run_simload)):
             try:
                 aux[name] = fn()
             except Exception as e:

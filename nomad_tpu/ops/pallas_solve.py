@@ -55,6 +55,33 @@ _NEG_INF = float("-inf")
 from nomad_tpu.ops.binpack import _monotone_u32  # noqa: E402
 
 
+def _int_sum(x):
+    """Exact i32 reduction via byte-split f32 sums.
+
+    Mosaic (jaxlib 0.4.36) does not implement integer reductions — the
+    deviceless TPU lowering of this kernel failed with
+    ``NotImplementedError: Reductions over integers not implemented`` at
+    every ``.sum()`` (tools/mosaic_lower.py, MOSAIC_LOWER_r06.json) —
+    but float reductions lower fine. A straight f32 sum would be inexact
+    past 2^24, so split each nonneg i32 into 4 bytes: each byte-plane sum
+    is <= N*255 < 2^24 for any node bucket this repo pads to (N <= 64k),
+    so every partial is exactly representable, and the recombined total
+    equals the integer sum bit-for-bit (each term <= the true total,
+    which fits i32 by construction — caps are clipped to ``count``).
+    """
+    total = jnp.int32(0)
+    for k in range(4):
+        plane = ((x >> (8 * k)) & 0xFF).astype(jnp.float32)
+        total = total + plane.sum().astype(jnp.int32) * jnp.int32(1 << (8 * k))
+    return total
+
+
+def _count_true(mask):
+    """Exact boolean population count via one f32 reduction (same Mosaic
+    integer-reduction gap as _int_sum; N < 2^24 keeps f32 exact)."""
+    return mask.astype(jnp.float32).sum().astype(jnp.int32)
+
+
 def _waterfill_kernel(
     # SMEM scalar blocks (per eval)
     ask_ref,       # (1, D) i32
@@ -111,14 +138,14 @@ def _waterfill_kernel(
     def bs_body(_, lohi):
         lo, hi = lohi
         mid = lo + (hi - lo + 1) // 2
-        ok = jnp.minimum(cap, mid).sum() <= count
+        ok = _int_sum(jnp.minimum(cap, mid)) <= count
         return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1))
 
     level, _ = jax.lax.fori_loop(
         0, 32, bs_body, (jnp.int32(0), count), unroll=False
     )
     base = jnp.minimum(cap, level)
-    remaining = count - base.sum()
+    remaining = count - _int_sum(base)
 
     # -- partial round: score nodes with headroom (binpack.py
     #    _greedy_step_state on the post-base utilization) --------------
@@ -155,7 +182,7 @@ def _waterfill_kernel(
     def kth_body(_, lohi):
         lo, hi = lohi
         mid = lo + (hi - lo + 1) // 2
-        cnt = (candidates & (u >= mid)).sum(dtype=jnp.int32)
+        cnt = _count_true(candidates & (u >= mid))
         ok = cnt >= remaining
         return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1))
 
@@ -168,14 +195,31 @@ def _waterfill_kernel(
     )
     above = candidates & (u > thresh)
     boundary = candidates & (u == thresh)
-    fill = remaining - above.sum(dtype=jnp.int32)
-    order = jnp.cumsum(boundary.astype(jnp.int32), axis=-1)
-    selected = above | (boundary & (order <= fill))
+    fill = remaining - _count_true(above)
+    # First-`fill` boundary lanes by ascending node index (the stable-
+    # argsort tie order of the jnp path). Formulated as a prefix-cut
+    # bisection — NOT a cumsum: Pallas TPU lowering implements neither
+    # integer reductions nor cumsum (MOSAIC_LOWER_r06.json), and
+    # count(boundary & idx < m) is monotone in m, so the largest prefix
+    # holding <= fill boundary lanes selects exactly min(fill, |boundary|)
+    # of them in index order.
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+
+    def tie_body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo + 1) // 2
+        ok = _count_true(boundary & (idx < mid)) <= fill
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1))
+
+    cut, _ = jax.lax.fori_loop(
+        0, 32, tie_body, (jnp.int32(0), jnp.int32(n)), unroll=False
+    )
+    selected = above | (boundary & (idx < cut))
     selected = selected & (remaining > 0)
 
     counts = base + selected.astype(jnp.int32)
     counts_ref[0, 0:1, :] = counts
-    remaining_ref[0, 0] = count - counts.sum()
+    remaining_ref[0, 0] = count - _int_sum(counts)
 
 
 @partial(

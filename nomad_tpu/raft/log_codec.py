@@ -23,6 +23,7 @@ from nomad_tpu.structs import (
 # msg_type -> {payload_field: element_dataclass or None for plain values}
 _SCHEMAS: Dict[str, Dict[str, Any]] = {
     "node_register": {"node": Node},
+    "node_batch_register": {"nodes": [Node]},
     "node_deregister": {"node_id": None},
     "node_status_update": {"node_id": None, "status": None},
     "node_drain_update": {"node_id": None, "drain": None},

@@ -386,6 +386,24 @@ class ClusterServer(Server):
             return super().node_register(node)
         return self._forward("Node.Register", {"node": to_dict(node)})
 
+    def node_batch_register(self, nodes: List[Node]):
+        if self.raft.is_leader:
+            return super().node_batch_register(nodes)
+        return self._forward(
+            "Node.BatchRegister", {"nodes": [to_dict(n) for n in nodes]},
+            # A whole tranche rides one frame; give the leader time to
+            # apply + arm before the caller's deadline fires.
+            timeout=30.0,
+        )
+
+    def node_batch_heartbeat(self, node_ids: List[str]):
+        if self.raft.is_leader:
+            return super().node_batch_heartbeat(node_ids)
+        # Same extended deadline as BatchRegister: a tranche of non-ready
+        # nodes costs the leader one raft apply + eval fan-out EACH.
+        return self._forward("Node.BatchHeartbeat", {"node_ids": node_ids},
+                             timeout=30.0)
+
     def node_update_status(self, node_id: str, status: str):
         if self.raft.is_leader:
             return super().node_update_status(node_id, status)
@@ -429,6 +447,12 @@ class ClusterServer(Server):
         r("Job.Register", self._rpc_job_register)
         r("Job.Deregister", self._rpc_job_deregister)
         r("Node.Register", lambda a: self.node_register(from_dict(Node, a["node"])))
+        r("Node.BatchRegister", lambda a: self.node_batch_register(
+            [from_dict(Node, n) for n in a["nodes"]]
+        ))
+        r("Node.BatchHeartbeat", lambda a: self.node_batch_heartbeat(
+            list(a["node_ids"])
+        ))
         r("Node.UpdateStatus", lambda a: self.node_update_status(
             a["node_id"], a["status"]
         ))

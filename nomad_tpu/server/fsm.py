@@ -53,6 +53,7 @@ class FSM:
         self.logger = logger or logging.getLogger("nomad_tpu.fsm")
         self._handlers: Dict[str, Callable[[int, dict], Any]] = {
             "node_register": self._apply_node_register,
+            "node_batch_register": self._apply_node_batch_register,
             "node_deregister": self._apply_node_deregister,
             "node_status_update": self._apply_node_status_update,
             "node_drain_update": self._apply_node_drain_update,
@@ -99,6 +100,19 @@ class FSM:
         self.events.publish("Node", "NodeRegistered", key=node.id,
                             raft_index=index,
                             payload={"status": node.status})
+
+    def _apply_node_batch_register(self, index: int, payload: dict) -> None:
+        """Bulk registration (one log entry for a whole fleet tranche —
+        the Node.BatchRegister path). ONE event per batch, not per node:
+        a 10k-node fleet bring-up must not evict the whole event ring
+        (the same granularity cut the columnar alloc commits make)."""
+        nodes = payload["nodes"]
+        self.state.upsert_nodes(index, nodes)
+        self.events.publish(
+            "Node", "NodeBatchRegistered",
+            key=nodes[0].id if nodes else "", raft_index=index,
+            payload={"count": len(nodes)},
+        )
 
     def _apply_node_deregister(self, index: int, payload: dict) -> None:
         self.state.delete_node(index, payload["node_id"])

@@ -387,8 +387,11 @@ class Server:
 
     # -- Node endpoint (node_endpoint.go) ------------------------------------
 
-    def node_register(self, node: Node) -> Dict:
-        """node_endpoint.go:18-80"""
+    @staticmethod
+    def _validate_registration(node: Node) -> None:
+        """Shared by the single and batch registration paths — a check
+        added to one must hold on both or invalid nodes reach the raft
+        log through whichever path drifted."""
         if not node.id:
             raise ValueError("missing node ID for client registration")
         if not node.datacenter:
@@ -400,6 +403,10 @@ class Server:
         if not structs.valid_node_status(node.status):
             raise ValueError("invalid status for node")
 
+    def node_register(self, node: Node) -> Dict:
+        """node_endpoint.go:18-80"""
+        self._validate_registration(node)
+
         index = self.raft.apply("node_register", {"node": node}).result()
 
         reply: Dict = {"node_modify_index": index, "index": index, "eval_ids": []}
@@ -410,6 +417,60 @@ class Server:
         if not node.terminal_status():
             reply["heartbeat_ttl"] = self.heartbeat.reset_heartbeat_timer(node.id)
         return reply
+
+    def node_batch_register(self, nodes: List[Node]) -> Dict:
+        """Bulk registration: one raft entry and one batched heartbeat arm
+        for a whole tranche of nodes. The RPC-tier enabler for a 10k-node
+        fleet (nomad_tpu/simcluster): per-node Node.Register would cost
+        10k raft applies and 10k timer-arm lock hops. Semantics per node
+        match node_register minus the drain-eval fan-out (batch
+        registration is for fresh, non-draining fleets; a draining node
+        must register individually)."""
+        if not nodes:
+            return {"index": 0, "heartbeat_ttls": {}}
+        for node in nodes:
+            self._validate_registration(node)
+            if structs.should_drain_node(node.status):
+                raise ValueError(
+                    "batch registration only accepts init/ready nodes"
+                )
+        index = self.raft.apply(
+            "node_batch_register", {"nodes": nodes}
+        ).result()
+        # Every node is init/ready here (validated above), so all get TTLs.
+        ttls = self.heartbeat.reset_many([n.id for n in nodes])
+        return {"index": index, "heartbeat_ttls": ttls}
+
+    def node_batch_heartbeat(self, node_ids: List[str]) -> Dict:
+        """Batched TTL renewal: equivalent to N node_heartbeat calls for
+        already-ready nodes, under one heartbeat-manager lock hold. Nodes
+        that are unknown get ttl 0.0 (the client re-registers); nodes in a
+        non-ready state fall back to the full node_update_status path so
+        the down->ready transition evals still fan out."""
+        snap = self.state_store.snapshot()
+        renew: List[str] = []
+        out: Dict[str, float] = {}
+        for node_id in node_ids:
+            node = snap.node_by_id(node_id)
+            if node is None:
+                out[node_id] = 0.0
+            elif node.status == structs.NODE_STATUS_READY:
+                renew.append(node_id)
+            else:
+                # Per-node isolation: the snapshot is stale, and a node
+                # deregistered since (KeyError from the live-store
+                # re-read) must cost THAT node its renewal, not the
+                # whole tranche — the batch path would otherwise amplify
+                # one racing failure to batch_size nodes' TTLs.
+                try:
+                    out[node_id] = self.node_update_status(
+                        node_id, structs.NODE_STATUS_READY
+                    ).get("heartbeat_ttl", 0.0)
+                except (KeyError, ValueError):
+                    out[node_id] = 0.0
+        if renew:
+            out.update(self.heartbeat.reset_many(renew))
+        return {"heartbeat_ttls": out}
 
     def node_deregister(self, node_id: str) -> Dict:
         """node_endpoint.go:82-117"""

@@ -1,0 +1,227 @@
+"""Seeded workload injectors: deterministic arrival processes.
+
+Each injector owns a ``random.Random`` seeded from ``(run seed, injector
+name)`` — the ``nomad_tpu/faults.py`` posture: streams are independent per
+injector (adding one injector never shifts another's decisions), and a
+fixed seed replays the same action schedule, job ids, counts and mutation
+choices run after run. Job shapes are the ``mock.py`` cluster shapes
+(exec-driver web tasks, service/batch/system types) with deterministic
+ids, so the event stream's per-entity lifecycles are seed-reproducible.
+
+An injector emits :class:`Action` records; the scenario runner executes
+them against the server at their offsets. Kinds:
+
+``register_job``   payload: the Job to register (built lazily so every
+                   run constructs fresh object graphs).
+``update_job``     payload: job key + mutation ("inplace" bumps cpu by 1
+                   — tasks_updated() false, the in-place path;
+                   "destructive" changes task env — evict+place).
+``fail_nodes``     payload: how many nodes to silence; the runner picks
+                   the tranche (preferring alloc-hosting nodes so the
+                   migration path is actually driven).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from random import Random
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu import structs
+from nomad_tpu.structs import (
+    Constraint,
+    Job,
+    Resources,
+    RestartPolicy,
+    Task,
+    TaskGroup,
+)
+
+
+@dataclass(order=True)
+class Action:
+    at: float
+    kind: str = field(compare=False)
+    payload: Dict = field(compare=False, default_factory=dict)
+
+
+def build_job(job_id: str, jtype: str, count: int,
+              cpu: int = 100, memory_mb: int = 128,
+              datacenters: Optional[List[str]] = None,
+              priority: int = 50) -> Job:
+    """A mock.job()-shaped job with a deterministic id; network-free so
+    scale runs stay on the columnar batch path (ports are a host-side
+    sequential post-pass that only adds runtime, not control-plane
+    signal)."""
+    return Job(
+        region="global",
+        id=job_id,
+        name=job_id,
+        type=jtype,
+        priority=priority,
+        datacenters=datacenters or ["dc1", "dc2"],
+        constraints=[Constraint(
+            l_target="$attr.kernel.name", r_target="linux", operand="=",
+        )],
+        task_groups=[TaskGroup(
+            name="web",
+            count=count,
+            restart_policy=RestartPolicy(
+                attempts=1, interval=600.0, delay=5.0,
+            ),
+            tasks=[Task(
+                name="web", driver="exec",
+                resources=Resources(cpu=cpu, memory_mb=memory_mb),
+            )],
+        )],
+    )
+
+
+class Injector:
+    """Base: a named, seeded action source."""
+
+    name = "injector"
+
+    def __init__(self, seed: int = 0):
+        # Name-salted stream, the faults.py FaultRule posture.
+        self.rng = Random(int(seed) ^ zlib.crc32(self.name.encode()))
+
+    def actions(self) -> List[Action]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class SteadyServiceInjector(Injector):
+    """Steady-state service arrivals: ``jobs`` service jobs spread over
+    ``over`` seconds with jittered inter-arrival gaps."""
+
+    name = "steady-service"
+
+    def __init__(self, seed: int, jobs: int, tasks_per_job: int,
+                 over: float, cpu: int = 100, memory_mb: int = 128):
+        super().__init__(seed)
+        self.jobs = jobs
+        self.tasks_per_job = tasks_per_job
+        self.over = over
+        self.cpu = cpu
+        self.memory_mb = memory_mb
+
+    def actions(self) -> List[Action]:
+        out = []
+        gap = self.over / max(self.jobs, 1)
+        t = 0.0
+        for k in range(self.jobs):
+            jid = f"sim-steady-{k:03d}"
+            out.append(Action(
+                at=t, kind="register_job",
+                payload={"job_key": jid, "build": self._builder(jid)},
+            ))
+            t += gap * (0.5 + self.rng.random())
+        return out
+
+    def _builder(self, jid: str) -> Callable[[], Job]:
+        count, cpu, mem = self.tasks_per_job, self.cpu, self.memory_mb
+        return lambda: build_job(jid, structs.JOB_TYPE_SERVICE, count,
+                                 cpu=cpu, memory_mb=mem)
+
+
+class BatchBurstInjector(Injector):
+    """Batch bursts: at each burst instant, ``jobs_per_burst`` batch jobs
+    land at once (one raft-entry-per-job arrival storm — the coalescing
+    dequeue's food)."""
+
+    name = "batch-burst"
+
+    def __init__(self, seed: int, bursts: int, jobs_per_burst: int,
+                 tasks_per_job: int, gap: float = 5.0,
+                 cpu: int = 100, memory_mb: int = 128):
+        super().__init__(seed)
+        self.bursts = bursts
+        self.jobs_per_burst = jobs_per_burst
+        self.tasks_per_job = tasks_per_job
+        self.gap = gap
+        self.cpu = cpu
+        self.memory_mb = memory_mb
+
+    def actions(self) -> List[Action]:
+        out = []
+        for b in range(self.bursts):
+            at = b * self.gap
+            for k in range(self.jobs_per_burst):
+                jid = f"sim-burst-{b:02d}-{k:03d}"
+                out.append(Action(
+                    at=at, kind="register_job",
+                    payload={"job_key": jid, "build": self._builder(jid)},
+                ))
+        return out
+
+    def _builder(self, jid: str) -> Callable[[], Job]:
+        count, cpu, mem = self.tasks_per_job, self.cpu, self.memory_mb
+        return lambda: build_job(jid, structs.JOB_TYPE_BATCH, count,
+                                 cpu=cpu, memory_mb=mem)
+
+
+class UpdateChurnInjector(Injector):
+    """Update churn over its own base jobs: registers ``base_jobs`` first,
+    then fires ``updates`` mutations — in-place resource bumps
+    (tasks_updated() false) or destructive env changes (evict+place),
+    chosen by the seeded stream."""
+
+    name = "update-churn"
+
+    def __init__(self, seed: int, base_jobs: int, tasks_per_job: int,
+                 updates: int, start: float = 1.0, over: float = 6.0,
+                 inplace_probability: float = 0.5):
+        super().__init__(seed)
+        self.base_jobs = base_jobs
+        self.tasks_per_job = tasks_per_job
+        self.updates = updates
+        self.start = start
+        self.over = over
+        self.inplace_probability = inplace_probability
+
+    def actions(self) -> List[Action]:
+        out = []
+        for k in range(self.base_jobs):
+            jid = f"sim-churnjob-{k:03d}"
+            out.append(Action(
+                at=0.0, kind="register_job",
+                payload={"job_key": jid, "build": self._builder(jid)},
+            ))
+        gap = self.over / max(self.updates, 1)
+        for u in range(self.updates):
+            target = f"sim-churnjob-{self.rng.randrange(self.base_jobs):03d}"
+            mutation = (
+                "inplace"
+                if self.rng.random() < self.inplace_probability
+                else "destructive"
+            )
+            out.append(Action(
+                at=self.start + u * gap, kind="update_job",
+                payload={"job_key": target, "mutation": mutation,
+                         "serial": u},
+            ))
+        return out
+
+    def _builder(self, jid: str) -> Callable[[], Job]:
+        count = self.tasks_per_job
+        return lambda: build_job(jid, structs.JOB_TYPE_SERVICE, count)
+
+
+class NodeChurnInjector(Injector):
+    """Node-failure churn: silence ``count`` nodes at ``at`` seconds. The
+    runner resolves the tranche (preferring alloc-hosting nodes with this
+    injector's stream) so TTL expiry drives real migrations."""
+
+    name = "node-churn"
+
+    def __init__(self, seed: int, count: int, at: float):
+        super().__init__(seed)
+        self.count = count
+        self.at = at
+
+    def actions(self) -> List[Action]:
+        return [Action(
+            at=self.at, kind="fail_nodes",
+            payload={"count": self.count, "rng": self.rng},
+        )]

@@ -662,18 +662,38 @@ class StateStore(_StateView):
 
     # -- nodes ------------------------------------------------------------
 
+    def _upsert_node_locked(self, index: int, node: Node) -> None:
+        """Index-stamp + insert (lock held) — the ONE definition of node
+        upsert semantics, shared by the single and batch paths."""
+        existing = self._t.nodes.get(node.id)
+        if existing is None:
+            node.create_index = index
+        else:
+            node.create_index = existing.create_index
+        node.modify_index = index
+        self._t.nodes[node.id] = node
+
     def upsert_node(self, index: int, node: Node) -> None:
         """reference: state_store.go UpsertNode"""
         with self._lock:
-            existing = self._t.nodes.get(node.id)
-            if existing is None:
-                node.create_index = index
-            else:
-                node.create_index = existing.create_index
-            node.modify_index = index
-            self._t.nodes[node.id] = node
+            self._upsert_node_locked(index, node)
             self._t.indexes["nodes"] = index
         self.watch.notify([item_table("nodes"), item_node(node.id)])
+
+    def upsert_nodes(self, index: int, nodes: List[Node]) -> None:
+        """Bulk node upsert: one lock hold and one table notification for a
+        whole registration batch (the Node.BatchRegister path — simcluster
+        registers 10k nodes in a few dozen raft entries). Per-node watch
+        items are built only when someone is parked on one, the same
+        granularity economy as the columnar alloc commits."""
+        with self._lock:
+            for node in nodes:
+                self._upsert_node_locked(index, node)
+            self._t.indexes["nodes"] = index
+        items = [item_table("nodes")]
+        if self.watch.has_waiters_for("node"):
+            items.extend(item_node(n.id) for n in nodes)
+        self.watch.notify(items)
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:

@@ -48,6 +48,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # Tier-1 runs with `-m "not slow"` (ROADMAP.md); heavy scale scenarios
+    # (10k-node simcluster runs) carry this marker so they only run when
+    # asked for explicitly: `pytest -m slow tests/test_simcluster.py`.
+    config.addinivalue_line(
+        "markers", "slow: heavy scale tests excluded from tier-1"
+    )
+
+
 @pytest.fixture(autouse=True, scope="session")
 def _drain_device_threads():
     """Interpreter teardown while a daemon thread (coalescer dispatcher,

@@ -654,6 +654,219 @@ def test_concurrent_coalesced_race_no_overcommit(seed):
         srv.shutdown()
 
 
+# ---------------------------------------------------------------------------
+# 2c. Anti-affinity penalty accounting parity (VERDICT r5 item 5b)
+# ---------------------------------------------------------------------------
+
+
+def _collision_penalty(h, nodes, job):
+    """Total anti-affinity penalty the committed placement incurred:
+    placing the k-th alloc of a job on a node already holding j of them
+    costs j*p (rank.go:240-302), so a node ending with k allocs paid
+    p * k*(k-1)/2."""
+    from nomad_tpu.scheduler.stack import (
+        BATCH_JOB_ANTI_AFFINITY_PENALTY,
+        SERVICE_JOB_ANTI_AFFINITY_PENALTY,
+    )
+
+    p = (BATCH_JOB_ANTI_AFFINITY_PENALTY
+         if job.type == structs.JOB_TYPE_BATCH
+         else SERVICE_JOB_ANTI_AFFINITY_PENALTY)
+    total = 0.0
+    for node in nodes:
+        k = sum(
+            1 for a in h.state.allocs_by_node(node.id)
+            if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+            and a.job_id == job.id
+        )
+        total += p * k * (k - 1) / 2.0
+    return total
+
+
+def _roomy_nodes(n):
+    """Identical, roomy nodes: collisions are capacity-feasible, so the
+    only force spreading placements is the anti-affinity penalty — a path
+    that ignored it would BestFit-stack onto few nodes."""
+    from nomad_tpu.structs import Node, Resources
+
+    return [
+        Node(
+            id=f"aff-{i:03d}", datacenter="dc1", name=f"n{i}",
+            attributes={"kernel.name": "linux", "driver.exec": "1"},
+            resources=Resources(cpu=16000, memory_mb=32768,
+                                disk_mb=500_000, iops=10_000),
+            status=structs.NODE_STATUS_READY,
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.mark.parametrize("seed", range(0, N_SCHED_SEEDS, 2))
+def test_scheduler_differential_anti_affinity_penalty(seed):
+    """Forced co-placement (count a multiple of the node count, ample
+    capacity): the device solve's in-kernel penalty term must account
+    collisions like the host's JobAntiAffinityIterator. Asserted on the
+    committed state: equal placement counts, TPU total collision penalty
+    <= host's (the dense solve scores every node; the host samples
+    ~log2(n)), and — on identical nodes, where even spread is the unique
+    penalty-optimal shape — a perfectly balanced per-node distribution."""
+    results = {}
+    for factory_kind in ("host", "tpu"):
+        rng = np.random.default_rng(90_000 + seed)
+        n = int(rng.integers(3, 12))
+        per_node = int(rng.integers(2, 5))
+        count = n * per_node
+        jtype = str(rng.choice(
+            [structs.JOB_TYPE_SERVICE, structs.JOB_TYPE_BATCH]
+        ))
+        nodes = _roomy_nodes(n)
+        job = Job(
+            region="global", id=generate_uuid(), name="fuzz-aff",
+            type=jtype, priority=50, datacenters=["dc1"],
+            task_groups=[TaskGroup(
+                name="tg", count=count,
+                restart_policy=RestartPolicy(
+                    attempts=1, interval=600.0, delay=5.0,
+                ),
+                tasks=[Task(name="t", driver="exec",
+                            resources=Resources(cpu=100, memory_mb=64))],
+            )],
+        )
+        factory = job.type if factory_kind == "host" else f"tpu-{job.type}"
+        h = _run_eval(factory, nodes, job)
+        placed, failed = _placed_and_failed(h)
+        assert placed == count and failed == 0, (seed, factory_kind, placed)
+        _check_capacity(h, nodes)
+        per_node_counts = sorted(
+            sum(1 for a in h.state.allocs_by_node(node.id)
+                if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN)
+            for node in nodes
+        )
+        results[factory_kind] = (
+            _collision_penalty(h, nodes, job), per_node_counts,
+        )
+
+    host_pen, host_dist = results["host"]
+    tpu_pen, tpu_dist = results["tpu"]
+    # Identical nodes: even spread is penalty-optimal and both greedy
+    # paths must find it — any stacking means the penalty was dropped.
+    assert tpu_dist[0] == tpu_dist[-1] == per_node, (seed, tpu_dist)
+    assert host_dist[0] == host_dist[-1] == per_node, (seed, host_dist)
+    assert tpu_pen <= host_pen + 1e-9, (seed, tpu_pen, host_pen)
+
+
+# ---------------------------------------------------------------------------
+# 2d. Rolling-update / in-place identity parity (VERDICT r5 item 5c)
+# ---------------------------------------------------------------------------
+
+
+def _run_ids(h, job):
+    return sorted(
+        a.id for a in h.state.allocs_by_job(job.id)
+        if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+    )
+
+
+def _identity_phases(factory_kind, seed, count):
+    """Place -> resource-only bump (in-place) -> env change (destructive);
+    returns the three RUN-alloc id sets plus the job modify indexes the
+    final allocs carry."""
+    import copy
+
+    rng = np.random.default_rng(95_000 + seed)
+    n = max(4, count // 2)
+    nodes = _roomy_nodes(n)
+    job = Job(
+        region="global", id=generate_uuid(), name="fuzz-ident",
+        type=structs.JOB_TYPE_SERVICE, priority=50, datacenters=["dc1"],
+        task_groups=[TaskGroup(
+            name="web", count=count,
+            restart_policy=RestartPolicy(
+                attempts=1, interval=600.0, delay=5.0,
+            ),
+            tasks=[Task(name="t", driver="exec",
+                        resources=Resources(
+                            cpu=int(rng.integers(50, 200)),
+                            memory_mb=64,
+                        ))],
+        )],
+    )
+    factory = job.type if factory_kind == "host" else f"tpu-{job.type}"
+    h = _run_eval(factory, nodes, job)
+    ids0 = _run_ids(h, job)
+    assert len(ids0) == count, (seed, factory_kind, len(ids0))
+
+    # Phase 2: cpu+1 — tasks_updated() false, every node has headroom:
+    # the in-place path MUST keep every alloc id (util.go:316-398; the
+    # block path commits a field swap, state/blocks.py with_update).
+    # Deep copy: existing allocs embed the job object, and mutating it in
+    # place would make the diff see no modify_index change at all.
+    job2 = copy.deepcopy(job)
+    job2.task_groups[0].tasks[0].resources.cpu += 1
+    h.state.upsert_job(h.next_index(), job2)
+    ev = Evaluation(
+        id=generate_uuid(), priority=job2.priority, type=job2.type,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job2.id,
+    )
+    h.process(factory, ev)
+    ids1 = _run_ids(h, job2)
+    inplace_mod = {
+        a.job.modify_index
+        for a in h.state.allocs_by_job(job2.id)
+        if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+    }
+
+    # Phase 3: env change — destructive; every alloc must be REPLACED.
+    job3 = copy.deepcopy(job2)
+    job3.task_groups[0].tasks[0].env = {"V": "2"}
+    h.state.upsert_job(h.next_index(), job3)
+    ev = Evaluation(
+        id=generate_uuid(), priority=job3.priority, type=job3.type,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job3.id,
+    )
+    h.process(factory, ev)
+    ids2 = _run_ids(h, job3)
+    _check_capacity(h, nodes)
+    return ids0, ids1, ids2, inplace_mod, job2.modify_index
+
+
+@pytest.mark.parametrize("seed", range(0, N_SCHED_SEEDS, 3))
+def test_scheduler_differential_inplace_identity(seed):
+    """Resource-only bump with guaranteed headroom: BOTH factories must
+    update the same allocs in place (identical id sets before/after, job
+    version advanced) — and an env change must replace every id. The
+    object-diff path (count < 256)."""
+    for factory_kind in ("host", "tpu"):
+        ids0, ids1, ids2, mods, job2_idx = _identity_phases(
+            factory_kind, seed, count=int(
+                np.random.default_rng(95_000 + seed).integers(5, 40)
+            ),
+        )
+        assert ids1 == ids0, (seed, factory_kind, "in-place changed ids")
+        assert mods == {job2_idx}, (seed, factory_kind, mods)
+        assert len(ids2) == len(ids0), (seed, factory_kind)
+        assert not set(ids2) & set(ids0), (
+            seed, factory_kind, "destructive update kept old ids"
+        )
+
+
+def test_scheduler_inplace_identity_block_native():
+    """Same contract at columnar scale (count >= 256): the TPU path's
+    block-native in-place machinery (whole-block field swap, no member
+    materialization) must preserve the seed-derived id column exactly,
+    and the host oracle agrees on every phase's cardinality."""
+    out = {}
+    for factory_kind in ("host", "tpu"):
+        ids0, ids1, ids2, mods, job2_idx = _identity_phases(
+            factory_kind, seed=1, count=300,
+        )
+        assert ids1 == ids0, (factory_kind, "in-place changed ids")
+        assert mods == {job2_idx}, (factory_kind, mods)
+        assert not set(ids2) & set(ids0), (factory_kind,)
+        out[factory_kind] = (len(ids0), len(ids1), len(ids2))
+    assert out["tpu"] == out["host"] == (300, 300, 300)
+
+
 @pytest.mark.parametrize(
     "seed", range(int(os.environ.get("NOMAD_TPU_BURST_SEEDS", "6")))
 )

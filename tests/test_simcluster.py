@@ -1,0 +1,463 @@
+"""simcluster: scale simulation & load harness tests.
+
+Tier-1 scope: injector determinism, the batched Node.BatchRegister/
+BatchHeartbeat RPC tier, the timer-wheel heartbeat manager, and the
+steady-1k smoke scenario (the whole register→heartbeat→eval→broker→
+worker→solver→plan_apply→raft path at 1k nodes) plus its same-seed
+canonical-event replay contract.
+
+Slow scope (`pytest -m slow`): the 10k-node heartbeat churn proof
+(VERDICT r5 item 7) — rate_scaled_interval keeps leader-side timer resets
+bounded at 10k nodes, a silenced tranche expires through the real TTL
+wheel, and the resulting node-down evals coalesce into bounded device
+dispatches — and the mixed churn scenario.
+"""
+
+import logging
+import time
+
+import pytest
+
+from nomad_tpu import structs
+from nomad_tpu.server import ServerConfig
+from nomad_tpu.server.cluster import ClusterConfig, ClusterServer, wait_for_leader
+from nomad_tpu.server.heartbeat import rate_scaled_interval
+from nomad_tpu.simcluster import run_scenario
+from nomad_tpu.simcluster.scenario import (
+    SCENARIOS,
+    ScenarioRunner,
+    ScenarioSpec,
+    canonical_events,
+)
+from nomad_tpu.simcluster.simnode import SimFleet, sim_node
+from nomad_tpu.simcluster.workload import (
+    BatchBurstInjector,
+    NodeChurnInjector,
+    SteadyServiceInjector,
+    UpdateChurnInjector,
+)
+
+log = logging.getLogger("test_simcluster")
+
+
+# ---------------------------------------------------------------------------
+# Injector determinism (the faults.py seeded-stream posture)
+# ---------------------------------------------------------------------------
+
+
+def _schedule(injector):
+    return [(round(a.at, 9), a.kind,
+             a.payload.get("job_key"), a.payload.get("mutation"))
+            for a in injector.actions()]
+
+
+def test_injectors_are_seed_deterministic():
+    for mk in (
+        lambda s: SteadyServiceInjector(s, jobs=5, tasks_per_job=50, over=4.0),
+        lambda s: BatchBurstInjector(s, bursts=2, jobs_per_burst=3,
+                                     tasks_per_job=300),
+        lambda s: UpdateChurnInjector(s, base_jobs=3, tasks_per_job=40,
+                                      updates=6),
+    ):
+        assert _schedule(mk(42)) == _schedule(mk(42))
+    # Seeds must actually matter where the stream is consumed (arrival
+    # jitter / mutation choice).
+    a = _schedule(SteadyServiceInjector(1, jobs=5, tasks_per_job=50, over=4.0))
+    b = _schedule(SteadyServiceInjector(2, jobs=5, tasks_per_job=50, over=4.0))
+    assert a != b
+    u1 = _schedule(UpdateChurnInjector(1, base_jobs=5, tasks_per_job=10,
+                                       updates=10))
+    u2 = _schedule(UpdateChurnInjector(9, base_jobs=5, tasks_per_job=10,
+                                       updates=10))
+    assert u1 != u2
+
+
+def test_injector_streams_are_independent():
+    """Adding one injector never shifts another's decisions — each is
+    salted by its own name (the FaultRule seeding contract)."""
+    alone = _schedule(UpdateChurnInjector(7, base_jobs=4, tasks_per_job=10,
+                                          updates=8))
+    _ = SteadyServiceInjector(7, jobs=9, tasks_per_job=10, over=1.0).actions()
+    again = _schedule(UpdateChurnInjector(7, base_jobs=4, tasks_per_job=10,
+                                          updates=8))
+    assert alone == again
+
+
+# ---------------------------------------------------------------------------
+# Batched registration/heartbeat RPC tier + fleet
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sim_server():
+    srv = ClusterServer(
+        ServerConfig(scheduler_backend="host", num_schedulers=1,
+                     min_heartbeat_ttl=2.0,
+                     max_heartbeats_per_second=2000.0,
+                     prewarm_shapes=False),
+        ClusterConfig(bootstrap_expect=1),
+    )
+    srv.start()
+    wait_for_leader([srv])
+    yield srv
+    srv.shutdown()
+
+
+def test_fleet_batch_register_and_beat(sim_server):
+    srv = sim_server
+    fleet = SimFleet(srv.rpc_addr, batch_size=50, tick=0.1)
+    try:
+        nodes = [sim_node(i) for i in range(120)]
+        reg = fleet.register(nodes)
+        assert reg["n"] == 120 and reg["batches"] == 3
+        assert srv.heartbeat.num_timers() == 120
+        assert len(srv.state_store.nodes()) == 120
+        # One raft entry per tranche, not per node.
+        evt = [e for e in srv.fsm.events.all_events()
+               if e.type == "NodeBatchRegistered"]
+        assert len(evt) == 3
+        assert sum(e.payload["count"] for e in evt) == 120
+
+        fleet.start_heartbeats()
+        # TTLs are 1-2s (jittered); beats land at 0.8*ttl through
+        # Node.BatchHeartbeat and renew the server-side wheel.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if srv.heartbeat.stats()["renewals"] >= 120:
+                break
+            time.sleep(0.05)
+        assert srv.heartbeat.stats()["renewals"] >= 120
+        assert fleet.beats_sent >= 120
+        # Nothing expired while the fleet was beating.
+        assert srv.heartbeat.num_timers() == 120
+        assert all(n.status == structs.NODE_STATUS_READY
+                   for n in srv.state_store.nodes())
+
+        # Silence a tranche: their TTLs run out through the REAL wheel
+        # and the server marks them down.
+        tranche = [f"sim-{i:05d}" for i in range(10)]
+        fleet.fail(tranche)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            down = [nid for nid in tranche
+                    if srv.state_store.node_by_id(nid).status
+                    == structs.NODE_STATUS_DOWN]
+            if len(down) == 10:
+                break
+            time.sleep(0.1)
+        assert len(down) == 10, f"only {len(down)} of tranche went down"
+        # The survivors are still being renewed.
+        assert all(srv.state_store.node_by_id(f"sim-{i:05d}").status
+                   == structs.NODE_STATUS_READY for i in range(20, 30))
+    finally:
+        fleet.stop()
+
+
+def test_batch_heartbeat_semantics(sim_server):
+    """Node.BatchHeartbeat == N node_heartbeat calls: unknown nodes get
+    ttl 0.0, down nodes ride the full status-update path back to ready
+    (transition evals fan out), ready nodes get a renewal."""
+    srv = sim_server
+    fleet = SimFleet(srv.rpc_addr, batch_size=50)
+    try:
+        nodes = [sim_node(i) for i in range(10)]
+        fleet.register(nodes)
+        out = fleet._pool().call(
+            srv.rpc_addr, "Node.BatchHeartbeat",
+            {"node_ids": ["sim-00000", "no-such-node"]},
+        )
+        ttls = out["heartbeat_ttls"]
+        assert ttls["sim-00000"] > 0
+        assert ttls["no-such-node"] == 0.0
+        # Down -> batch beat -> ready again (the transition path).
+        srv.node_update_status("sim-00001", structs.NODE_STATUS_DOWN)
+        out = fleet._pool().call(
+            srv.rpc_addr, "Node.BatchHeartbeat",
+            {"node_ids": ["sim-00001"]},
+        )
+        assert out["heartbeat_ttls"]["sim-00001"] > 0
+        assert (srv.state_store.node_by_id("sim-00001").status
+                == structs.NODE_STATUS_READY)
+    finally:
+        fleet.stop()
+
+
+def test_heartbeat_wheel_counters(sim_server):
+    srv = sim_server
+    ttls = srv.heartbeat.reset_many([f"w{i}" for i in range(30)])
+    assert len(ttls) == 30 and all(v >= 1.0 for v in ttls.values())
+    st = srv.heartbeat.stats()
+    assert st["arms"] >= 30 and st["active"] >= 30
+    srv.heartbeat.reset_many([f"w{i}" for i in range(10)])
+    assert srv.heartbeat.stats()["renewals"] >= 10
+    for i in range(30):
+        srv.heartbeat.clear_heartbeat_timer(f"w{i}")
+    assert srv.heartbeat.num_timers() == 0
+
+
+# ---------------------------------------------------------------------------
+# The smoke scenario: the whole pipeline at 1k nodes (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_steady_1k_smoke(tmp_path):
+    out = tmp_path / "SIMLOAD_steady-1k_smoke.json"
+    art = run_scenario("steady-1k", seed=7, out_path=str(out))
+    assert out.exists()
+    # 6 jobs x 260 tasks, all placed through broker→worker→solver→
+    # plan_apply→raft.
+    assert art["placements"]["placed"] == 6 * 260
+    assert art["placements"]["evals_injected"] == 6
+    assert art["placements"]["plans_applied"] == 6
+    assert art["placements"]["placements_per_sec"] > 0
+    assert art["placements"]["device_dispatches"] >= 1
+    assert art["plan_latency_ms"]["n"] == 6
+    assert art["plan_latency_ms"]["p50_ms"] > 0
+    assert art["eval_latency_ms"]["n"] == 6
+    assert art["heartbeat"]["timers"] == 1000
+    assert art["registration"]["n"] == 1000
+    assert art["alloc_ack"]["acked"] == 150
+    assert art["events"]["truncated"] is False
+    assert art["events"]["by_type"]["PlanApplied"] == 6
+    assert art["events"]["by_type"]["AllocClientUpdated"] == 150
+    # Columnar path: one AllocUpserted per eval, not per placement
+    # (client-ack promotions publish AllocClientUpdated, counted above).
+    assert art["events"]["by_type"]["AllocUpserted"] == 6
+    # The converged renewal load respects the configured cap (production
+    # 50/s posture at 1k nodes: TTL >= 20s at full count, beat at
+    # 0.8*ttl). The transient scheduled rate right after a rolling
+    # bring-up legitimately overshoots (short first grants at small
+    # count) and is reported unasserted.
+    assert (art["heartbeat"]["equilibrium_renewals_per_sec"]
+            <= art["heartbeat"]["rate_cap_per_sec"])
+    assert art["heartbeat"]["scheduled_renewals_per_sec"] > 0
+
+
+def test_same_seed_reproduces_canonical_event_sequence():
+    """The simload replay contract at smoke scale: same seed → same
+    canonical event digest (sorted multiset of per-key event-type
+    sequences), the reduction the SIMLOAD artifacts bank."""
+    spec = ScenarioSpec(
+        name="steady-mini", n_nodes=300,
+        injectors=lambda seed: [SteadyServiceInjector(
+            seed, jobs=3, tasks_per_job=260, over=1.0,
+        )],
+        quiesce_timeout=60.0, ack_cap=40,
+    )
+    a = ScenarioRunner(spec, seed=33).run()
+    b = ScenarioRunner(spec, seed=33).run()
+    assert a["events"]["digest"] == b["events"]["digest"]
+    assert a["events"]["by_type"] == b["events"]["by_type"]
+    assert a["placements"]["placed"] == b["placements"]["placed"] == 3 * 260
+
+
+def test_canonical_events_reduction():
+    class E:
+        def __init__(self, topic, etype, key):
+            self.topic, self.type, self.key = topic, etype, key
+
+    seq1 = [E("Eval", "EvalUpdated", "e1"), E("Eval", "EvalUpdated", "e2"),
+            E("Plan", "PlanApplied", "e1"), E("Plan", "PlanApplied", "e2")]
+    # Same per-key lifecycles, different global interleaving, different
+    # uuids: canonically EQUAL.
+    seq2 = [E("Eval", "EvalUpdated", "x9"), E("Plan", "PlanApplied", "x9"),
+            E("Eval", "EvalUpdated", "x7"), E("Plan", "PlanApplied", "x7")]
+    assert canonical_events(seq1)["digest"] == canonical_events(seq2)["digest"]
+    # A changed per-key ORDER is a different canonical history.
+    seq3 = [E("Plan", "PlanApplied", "e1"), E("Eval", "EvalUpdated", "e1"),
+            E("Eval", "EvalUpdated", "e2"), E("Plan", "PlanApplied", "e2")]
+    assert canonical_events(seq1)["digest"] != canonical_events(seq3)["digest"]
+
+
+# ---------------------------------------------------------------------------
+# Slow scale proofs (excluded from tier-1 by the `slow` marker)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_heartbeat_churn_10k():
+    """VERDICT r5 item 7: the 10k-node control-plane failure-detection
+    proof. (1) rate_scaled_interval keeps leader-side timer resets
+    bounded: at the production cap (50/s) the granted TTLs schedule
+    <= 50 renewals/s — asserted from the grants because the 200s+ TTLs
+    cannot be waited out; at this test's compressed cap (2000/s) the
+    MEASURED renewal rate over a real beat window also respects the cap.
+    (2) A silenced tranche expires through the real TTL wheel and its
+    node-down evals coalesce into bounded device dispatches
+    (ref nomad/heartbeat.go:52-54)."""
+    from nomad_tpu.ops.coalesce import GLOBAL_SOLVER
+    from nomad_tpu.simcluster.workload import build_job
+    from nomad_tpu.api.codec import to_dict
+
+    # The production-posture half is pure arithmetic on the grant law:
+    # 10k nodes at the 50/s cap get 200s base TTLs (+ up to 100% jitter),
+    # and a fleet beating at 0.8*ttl schedules sum(1/(0.8*ttl_i)) <= 50/s.
+    assert rate_scaled_interval(50.0, 10.0, 10_000) == 200.0
+    import random as _random
+
+    rng = _random.Random(42)
+    ttls = [200.0 + rng.uniform(0, 200.0) for _ in range(10_000)]
+    scheduled = sum(1.0 / (0.8 * t) for t in ttls)
+    log.warning("production posture: 10k nodes schedule %.1f renewals/s "
+                "(cap 50/s)", scheduled)
+    assert scheduled <= 50.0
+
+    srv = ClusterServer(
+        ServerConfig(scheduler_backend="tpu", num_schedulers=2,
+                     eval_batch_size=4,
+                     min_heartbeat_ttl=4.0,
+                     max_heartbeats_per_second=2000.0,
+                     prewarm_shapes=False),
+        ClusterConfig(bootstrap_expect=1),
+    )
+    fleet = SimFleet(srv.rpc_addr, tick=0.25)
+    try:
+        srv.start()
+        wait_for_leader([srv])
+        nodes = [sim_node(i, "dc1" if i % 2 == 0 else "dc2")
+                 for i in range(10_000)]
+        reg = fleet.register(nodes)
+        log.warning("registered 10k nodes in %.2fs (%.0f nodes/s)",
+                    reg["seconds"], reg["nodes_per_sec"])
+        assert srv.heartbeat.num_timers() == 10_000
+
+        # Measured half: TTLs here are 5-10s (count/rate = 5s base), so a
+        # real beat window fits in-test. The first grant cycle is a
+        # transient (rolling bring-up granted early tranches short TTLs
+        # at small count — the reference's grant law does the same), so
+        # let every node renew once at full count, THEN measure: the
+        # leader-side renewal rate must sit at the equilibrium, under the
+        # configured cap.
+        fleet.start_heartbeats()
+        time.sleep(12.0)  # one full grant cycle (max granted ttl ~10s)
+        hb0 = srv.heartbeat.stats()
+        t0 = time.monotonic()
+        time.sleep(10.0)
+        window = time.monotonic() - t0
+        renewals = srv.heartbeat.stats()["renewals"] - hb0["renewals"]
+        measured = renewals / window
+        scheduled_now = fleet.scheduled_renewals_per_sec()
+        log.warning(
+            "compressed posture: measured %.1f renewals/s over %.1fs "
+            "(scheduled %.1f, cap %.0f, timers %d)",
+            measured, window, scheduled_now, 2000.0,
+            srv.heartbeat.num_timers(),
+        )
+        assert measured <= 2000.0
+        assert measured > 0, "no renewals landed — the fleet isn't beating"
+        assert srv.heartbeat.num_timers() == 10_000  # none expired
+
+        # Place a job so the tranche's expiry has allocs to migrate.
+        job = build_job("churn-svc", structs.JOB_TYPE_SERVICE, 300)
+        out = fleet._pool().call(
+            srv.rpc_addr, "Job.Register", {"job": to_dict(job)},
+            timeout=30.0,
+        )
+        srv.wait_for_eval(out["eval_id"], timeout=180.0)
+        snap = srv.state_store.snapshot()
+        hosting = sorted({
+            a.node_id for a in snap.allocs_by_job(job.id)
+            if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+        })
+        assert hosting, "job placed nowhere"
+        tranche = hosting[:100]
+
+        # Count every device-solve invocation (exact AND columnar paths)
+        # during the churn window: GLOBAL_SOLVER.dispatches only counts
+        # coalesced water-fill dispatches, and small migration re-solves
+        # ride the exact path.
+        from nomad_tpu.tpu.solver import TPUStack
+
+        solve_calls = {"n": 0}
+        orig_sg, orig_sgc = TPUStack.solve_group, TPUStack.solve_group_counts
+
+        def _count(orig):
+            def wrapped(self, *a, **k):
+                solve_calls["n"] += 1
+                return orig(self, *a, **k)
+            return wrapped
+
+        TPUStack.solve_group = _count(orig_sg)
+        TPUStack.solve_group_counts = _count(orig_sgc)
+
+        dispatches0 = GLOBAL_SOLVER.dispatches
+        expirations0 = srv.heartbeat.stats()["expirations"]
+        fleet.fail(tranche)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            snap = srv.state_store.snapshot()
+            down = [nid for nid in tranche
+                    if snap.node_by_id(nid).status
+                    == structs.NODE_STATUS_DOWN]
+            if len(down) == len(tranche):
+                break
+            time.sleep(0.2)
+        assert len(down) == len(tranche), (
+            f"only {len(down)}/{len(tranche)} expired"
+        )
+        # Let the node-down evals settle.
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            stats = srv.eval_broker.snapshot_stats()
+            if (stats.total_ready + stats.total_unacked
+                    + stats.total_blocked) == 0:
+                pend = [e for e in srv.state_store.evals()
+                        if not e.terminal_status()]
+                if not pend:
+                    break
+            time.sleep(0.2)
+        TPUStack.solve_group, TPUStack.solve_group_counts = orig_sg, orig_sgc
+        dispatches = GLOBAL_SOLVER.dispatches - dispatches0
+        expired = srv.heartbeat.stats()["expirations"] - expirations0
+        log.warning(
+            "expired %d nodes -> %d solve invocations, %d coalesced "
+            "water-fill dispatches",
+            expired, solve_calls["n"], dispatches,
+        )
+        assert expired >= len(tranche)
+        # Bounded device work: the broker's per-job blocked queue merges
+        # node-down evals — while one eval is mid-flight, every further
+        # expiry coalesces into the NEXT eval, which re-places all
+        # missing allocs in one solve. The solve count is therefore
+        # bounded by the expiry spread over the eval-processing rate, and
+        # must never amplify past one solve per expired node.
+        assert solve_calls["n"] <= len(tranche), (
+            f"{solve_calls['n']} solves for {len(tranche)} node expiries"
+        )
+        assert dispatches <= 24, (
+            f"{dispatches} coalesced dispatches for {len(tranche)} expiries"
+        )
+        # Migrated allocs were re-placed on live nodes.
+        snap = srv.state_store.snapshot()
+        live = [a for a in snap.allocs_by_job(job.id)
+                if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN]
+        assert len(live) == 300, f"{len(live)} live allocs after churn"
+        down_set = set(tranche)
+        assert all(a.node_id not in down_set for a in live)
+    finally:
+        fleet.stop()
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_churn_scenario_runs():
+    """The mixed churn scenario end to end: update churn + a 40-node
+    failure tranche expiring through real TTLs, with migrations."""
+    art = run_scenario("churn", seed=5)
+    assert art["heartbeat"]["expirations"] >= 40
+    assert art["events"]["by_type"].get("NodeHeartbeatExpired", 0) >= 40
+    assert art["placements"]["placed"] > 0
+    assert art["events"]["truncated"] is False
+
+
+@pytest.mark.slow
+def test_steady_10k_scenario():
+    """The seeded 10k-node artifact scenario (the committed SIMLOAD_*
+    runs use tools/simload.py; this keeps it executable in-suite)."""
+    art = run_scenario("steady-10k", seed=42)
+    assert art["placements"]["placed"] == 24 * 420
+    assert art["heartbeat"]["timers"] == 10_000
+    assert (art["heartbeat"]["equilibrium_renewals_per_sec"]
+            <= art["heartbeat"]["rate_cap_per_sec"])
+    assert art["plan_latency_ms"]["n"] == 24
+    assert art["events"]["truncated"] is False

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Timeboxed deviceless Mosaic lowering attempt for the pallas water-fill.
+
+VERDICT r5 item 2: the flagship kernel (ops/pallas_solve.py
+solve_waterfill_pallas_batched) has only ever run in interpret mode —
+the suite pins the cpu backend and the device relay has been dark since
+2026-07-30. This tool attempts the one validation path that does not
+need the relay: ahead-of-time lowering/compilation against a TPU target
+with NO attached device, in a killable child process (the
+scheduler/device_probe.py pattern — a wedged backend import can never
+take the session down; default leash 120s, NOMAD_TPU_MOSAIC_TIMEOUT).
+
+Stages the child reports (JSON lines on stdout):
+
+  import      jax + jaxlib versions
+  args        tiny batched solve inputs built (B=1, N=8)
+  topology    jax.experimental.topologies.get_topology_desc('tpu', ...)
+              across several topology spellings — requires libtpu; each
+              failure is recorded with its exception head
+  export      jax.export.export(..., platforms=['tpu']) — cross-platform
+              StableHLO lowering; for a pallas_call this is where Mosaic
+              runs (the kernel serializes into a tpu_custom_call) and it
+              needs no device
+  artifact    the lowered module's text: size, sha256, whether
+              tpu_custom_call/mosaic markers are present; head saved
+  compile     lowered.compile() against the topology (needs the TPU
+              compiler => expected to fail deviceless; the failure stage
+              IS the finding)
+
+Output: MOSAIC_LOWER_<ts>.json (or --out) with every stage, plus the
+lowered-module head alongside when export succeeded. Exit 0 if the
+export stage succeeded (the kernel LOWERED for TPU), 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TIMEOUT = float(os.environ.get("NOMAD_TPU_MOSAIC_TIMEOUT", "120"))
+
+_CHILD_SRC = r'''
+import hashlib, json, os, sys, time
+
+sys.path.insert(0, os.environ["NOMAD_TPU_REPO"])
+t0 = time.monotonic()
+
+def emit(**kw):
+    kw.setdefault("elapsed_s", round(time.monotonic() - t0, 2))
+    print(json.dumps(kw), flush=True)
+
+def err_head(e, n=400):
+    return f"{type(e).__name__}: {str(e)[:n]}"
+
+import jax, jaxlib
+emit(stage="import", jax=jax.__version__, jaxlib=jaxlib.__version__,
+     default_backend_env=os.environ.get("JAX_PLATFORMS"))
+
+import jax.numpy as jnp
+from functools import partial
+from nomad_tpu.ops.pallas_solve import solve_waterfill_pallas_batched
+
+B, N, D = 1, 8, 4
+args = (
+    jnp.ones((B, N, D), jnp.int32) * 1000,        # total
+    jnp.ones((B, N, 2), jnp.float32) * 1000.0,    # sched_cap
+    jnp.zeros((B, N, D), jnp.int32),              # used0
+    jnp.zeros((B, N), jnp.int32),                 # job_count0
+    jnp.zeros((B, N), jnp.int32),                 # tg_count0
+    jnp.ones((B, N), jnp.int32) * 100,            # bw_avail
+    jnp.zeros((B, N), jnp.int32),                 # bw_used0
+    jnp.ones((B, N), bool),                       # eligible
+    jnp.ones((B, D), jnp.int32),                  # ask
+    jnp.zeros((B,), jnp.int32),                   # bw_ask
+    jnp.ones((B,), jnp.int32) * 4,                # count
+    jnp.zeros((B,), jnp.float32),                 # penalty
+)
+emit(stage="args", shapes=[list(a.shape) for a in args])
+
+# --- deviceless cross-platform lowering FIRST: Mosaic runs HERE, and it
+# --- must not be robbed by a wedging topology probe (observed: the
+# --- image's tpu platform plugin blocks inside get_topology_desc when
+# --- the device relay is dark — the same single-shot backend-init hang
+# --- scheduler/device_probe.py isolates).
+fn = partial(solve_waterfill_pallas_batched,
+             job_distinct=False, tg_distinct=False)
+exported = None
+try:
+    from jax import export as jax_export
+
+    exported = jax_export.export(jax.jit(fn), platforms=("tpu",))(*args)
+    emit(stage="export", ok=True)
+except Exception as e:
+    emit(stage="export", ok=False, error=err_head(e, 1200))
+
+if exported is not None:
+    try:
+        text = exported.mlir_module()
+        digest = hashlib.sha256(text.encode()).hexdigest()
+        emit(stage="artifact", ok=True, bytes=len(text), sha256=digest,
+             has_tpu_custom_call="tpu_custom_call" in text,
+             has_mosaic="mosaic" in text.lower(),
+             head=text[:1500])
+        out = os.environ.get("NOMAD_TPU_MOSAIC_MLIR_OUT")
+        if out:
+            with open(out, "w") as f:
+                f.write(text)
+    except Exception as e:
+        emit(stage="artifact", ok=False, error=err_head(e))
+
+# --- topology: needs libtpu; every spelling's failure is the record.
+# --- Runs LAST because a dark relay wedges the plugin's topology init
+# --- (the parent's leash then kills the child with the export already
+# --- banked, and "stopped at topology" is the pinned failure stage).
+topo = None
+topo_tried = []
+if os.environ.get("NOMAD_TPU_MOSAIC_SKIP_TOPOLOGY") != "1":
+    try:
+        from jax.experimental import topologies
+        for name, kwargs in (
+            ("v5e:1x1", {}),
+            ("v5litepod-1", {}),
+            ("v4:2x2x1", {}),
+        ):
+            emit(stage="topology_attempt", name=name)
+            try:
+                topo = topologies.get_topology_desc(name, "tpu", **kwargs)
+                topo_tried.append({"name": name, "ok": True})
+                break
+            except Exception as e:
+                topo_tried.append({"name": name, "ok": False,
+                                   "error": err_head(e)})
+    except Exception as e:
+        topo_tried.append({"name": "<module>", "ok": False,
+                           "error": err_head(e)})
+    emit(stage="topology", ok=topo is not None, tried=topo_tried)
+
+# --- AOT compile: needs the TPU compiler (libtpu) --------------------
+if exported is not None:
+    try:
+        if topo is not None:
+            lowered = jax.jit(fn).lower(*args)
+            compiled = lowered.compile()
+            emit(stage="compile", ok=True, via="topology")
+        else:
+            emit(stage="compile", ok=False, skipped=True,
+                 reason="no topology description (libtpu absent or "
+                        "topology init wedged); AOT compile has no TPU "
+                        "compiler to target")
+    except Exception as e:
+        emit(stage="compile", ok=False, error=err_head(e, 1200))
+
+emit(stage="done")
+'''
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--timeout", type=float, default=TIMEOUT)
+    args = ap.parse_args()
+    out_path = args.out or os.path.join(
+        REPO, "MOSAIC_LOWER_r06.json"
+    )
+    mlir_out = os.path.splitext(out_path)[0] + ".stablehlo.mlir"
+
+    env = {**os.environ,
+           "NOMAD_TPU_REPO": REPO,
+           "NOMAD_TPU_MOSAIC_MLIR_OUT": mlir_out,
+           # The lowering target is named explicitly (platforms=('tpu',));
+           # the process backend stays cpu so nothing touches a (dead)
+           # relay during jax init.
+           "JAX_PLATFORMS": "cpu"}
+    stages, stderr_tail = [], []
+    start = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_SRC],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+
+    def pump_out():
+        for line in proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                stages.append(json.loads(line))
+            except ValueError:
+                stderr_tail.append(line)
+
+    def pump_err():
+        for line in proc.stderr:
+            stderr_tail.append(line.rstrip())
+
+    t1 = threading.Thread(target=pump_out, daemon=True)
+    t2 = threading.Thread(target=pump_err, daemon=True)
+    t1.start()
+    t2.start()
+    killed = False
+    try:
+        rc = proc.wait(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        killed = True
+        proc.kill()
+        rc = -1
+    t1.join(timeout=2)
+    t2.join(timeout=2)
+
+    export_stage = next(
+        (s for s in stages if s.get("stage") == "export"), None)
+    ok = bool(export_stage and export_stage.get("ok"))
+    report = {
+        "tool": "mosaic_lower",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "timeout_s": args.timeout,
+        "killed": killed,
+        "rc": rc,
+        "elapsed_s": round(time.monotonic() - start, 2),
+        "lowered_for_tpu": ok,
+        "stages": stages,
+        "stderr_tail": stderr_tail[-8:],
+        "mlir_path": mlir_out if ok and os.path.exists(mlir_out) else None,
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "lowered_for_tpu": ok,
+        "last_stage": stages[-1].get("stage") if stages else "spawn",
+        "killed": killed,
+        "artifact": out_path,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Simcluster load-generation CLI: run a named scale scenario and bank
+the artifact.
+
+    python tools/simload.py --scenario steady-10k --seed 42
+    python tools/simload.py --scenario steady-1k --verify-determinism
+    python tools/simload.py --list
+
+Writes ``SIMLOAD_<scenario>_s<seed>.json`` (override with --out) and
+prints one JSON summary line (the bench.py one-line contract) so drivers
+that keep only stdout still capture the headline numbers.
+
+``--verify-determinism`` runs the scenario TWICE with the same seed and
+asserts the canonical event digests (sorted multiset of per-key
+event-type sequences, nomad_tpu/simcluster/scenario.py:canonical_events)
+match; the artifact records both digests and the verdict. Scenarios whose
+spec sets ``deterministic=False`` (node-failure churn: which nodes host
+allocs is not pinned by the seed) refuse verification instead of
+reporting a vacuous pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenario", default="steady-1k")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--nodes", type=int, default=None,
+                    help="override the scenario's fleet size")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default SIMLOAD_<name>_s<seed>.json)")
+    ap.add_argument("--verify-determinism", action="store_true",
+                    help="run twice with the same seed and assert the "
+                         "canonical event digests match")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    from nomad_tpu.simcluster import SCENARIOS, run_scenario
+
+    if args.list:
+        for name, spec in sorted(SCENARIOS.items()):
+            print(f"{name:12s} n_nodes={spec.n_nodes:<6d} {spec.description}")
+        return 0
+
+    spec = SCENARIOS.get(args.scenario)
+    if spec is None:
+        print(f"unknown scenario {args.scenario!r}; "
+              f"have {sorted(SCENARIOS)}", file=sys.stderr)
+        return 2
+
+    out_path = args.out or os.path.join(
+        REPO, f"SIMLOAD_{args.scenario}_s{args.seed}.json"
+    )
+    artifact = run_scenario(args.scenario, seed=args.seed,
+                            n_nodes=args.nodes)
+
+    if args.verify_determinism:
+        if not spec.deterministic:
+            print(f"scenario {args.scenario!r} does not carry the "
+                  "per-entity determinism contract "
+                  "(spec.deterministic=False); refusing a vacuous verify",
+                  file=sys.stderr)
+            return 2
+        second = run_scenario(args.scenario, seed=args.seed,
+                              n_nodes=args.nodes)
+        match = (artifact["events"]["digest"] == second["events"]["digest"]
+                 and artifact["events"]["by_type"]
+                 == second["events"]["by_type"])
+        artifact["determinism"] = {
+            "verified": bool(match),
+            "runs": 2,
+            "digests": [artifact["events"]["digest"],
+                        second["events"]["digest"]],
+        }
+        if not match:
+            with open(out_path, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+            print(json.dumps({"error": "determinism check FAILED",
+                              "artifact": out_path}))
+            return 1
+
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    print(json.dumps({
+        "metric": f"simload.{args.scenario}",
+        "seed": args.seed,
+        "n_nodes": artifact["n_nodes"],
+        "placed": artifact["placements"]["placed"],
+        "placements_per_sec": artifact["placements"]["placements_per_sec"],
+        "plan_latency_ms_p50": artifact["plan_latency_ms"].get("p50_ms"),
+        "plan_latency_ms_p95": artifact["plan_latency_ms"].get("p95_ms"),
+        "device_dispatches": artifact["placements"]["device_dispatches"],
+        "determinism_verified": artifact.get("determinism", {}).get(
+            "verified"),
+        "backend": artifact["backend"],
+        "artifact": out_path,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
