@@ -44,6 +44,37 @@ class HTTPCodedError(Exception):
         self.code = code
 
 
+def _mirror_cache_stats() -> Dict[str, Any]:
+    """The process-wide device-mirror cache's stats — hits/misses plus
+    the delta-roll economy (delta_rolls vs full_rebuilds, rows_restaged).
+    Late import: the metrics endpoint must answer even if the solver
+    stack never initialized."""
+    try:
+        from nomad_tpu.tpu.mirror import GLOBAL_MIRROR_CACHE
+
+        return GLOBAL_MIRROR_CACHE.stats()
+    except Exception as e:  # pragma: no cover - import-time breakage only
+        return {"error": str(e)}
+
+
+def _mirror_prometheus_text() -> str:
+    """Mirror-cache stats as Prometheus lines appended to the sink
+    exposition: monotonic counters for the roll economy, a gauge for
+    residency."""
+    stats = _mirror_cache_stats()
+    if "error" in stats:
+        return ""
+    lines = []
+    for k in ("hits", "misses", "delta_rolls", "full_rebuilds",
+              "rows_restaged"):
+        name = f"nomad_mirror_cache_{k}_total"
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {stats[k]}")
+    lines.append("# TYPE nomad_mirror_cache_entries gauge")
+    lines.append(f"nomad_mirror_cache_entries {stats['entries']}")
+    return "\n".join(lines) + "\n"
+
+
 class RawResponse:
     """Non-JSON handler result (e.g. Prometheus text exposition): the
     dispatcher writes the body verbatim with the given content type."""
@@ -530,18 +561,21 @@ class HTTPServer:
 
     def agent_metrics(self, req, query) -> Tuple[Any, Optional[int]]:
         """Live InmemSink aggregates. Default JSON (all retained
-        intervals); ``?format=prometheus`` serves text exposition for a
-        Prometheus scrape (pull model — the reference only had the
-        SIGUSR1 dump and push sinks)."""
+        intervals, plus the device-mirror cache's delta economy);
+        ``?format=prometheus`` serves text exposition for a Prometheus
+        scrape (pull model — the reference only had the SIGUSR1 dump and
+        push sinks)."""
         sink = getattr(self.agent, "inmem_sink", None)
         if sink is None:
             raise HTTPCodedError(404, "telemetry sink not initialized")
         if query.get("format") == "prometheus":
             return RawResponse(
-                telemetry.prometheus_text(sink).encode(),
+                (telemetry.prometheus_text(sink)
+                 + _mirror_prometheus_text()).encode(),
                 "text/plain; version=0.0.4",
             ), None
-        return {"timestamp": trace.now(), "intervals": sink.data()}, None
+        return {"timestamp": trace.now(), "intervals": sink.data(),
+                "mirror_cache": _mirror_cache_stats()}, None
 
     def agent_traces(self, req, query) -> Tuple[Any, Optional[int]]:
         """Summaries of the tracer's retained traces, newest first

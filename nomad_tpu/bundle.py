@@ -16,6 +16,9 @@ everything the observability stack retains at the moment of capture —
 - ``config``      the effective agent config, secrets redacted
 - ``faults``      the armed fault plan + per-rule fire counts
 - ``breaker``     device circuit-breaker state (scheduler.DEVICE_BREAKER)
+- ``mirror``      device-mirror cache stats (hits/misses, delta_rolls vs
+                  full_rebuilds, rows_restaged) — whether the solver's
+                  staging is riding the delta path or rebuilding
 - ``threads``     Python stacks of every live thread (sys._current_frames
                   — the goroutine-dump analog)
 
@@ -43,7 +46,7 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 # value is then None or an {"error": ...} stub, never absent).
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
-    "faults", "breaker", "threads",
+    "faults", "breaker", "mirror", "threads",
 )
 
 _SECRET_MARKERS = ("token", "secret", "password")
@@ -149,6 +152,12 @@ def _breaker_section() -> Dict[str, Any]:
         return {"error": str(e)}
 
 
+def _mirror_section() -> Dict[str, Any]:
+    from nomad_tpu.tpu.mirror import GLOBAL_MIRROR_CACHE
+
+    return GLOBAL_MIRROR_CACHE.stats()
+
+
 def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
     """Build the bundle. ``agent`` is a live nomad_tpu.agent.Agent for the
     full capture; None collects the process-local subset (metrics/faults/
@@ -164,6 +173,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "config": None,
         "faults": None,
         "breaker": None,
+        "mirror": None,
         "threads": None,
     }
     for section, build in (
@@ -172,6 +182,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("events", lambda: _events_section(agent, last_events)),
         ("faults", lambda: faults.get_registry().snapshot()),
         ("breaker", _breaker_section),
+        ("mirror", _mirror_section),
         ("threads", thread_stacks),
     ):
         # One wedged subsystem must not cost the whole flight recording.

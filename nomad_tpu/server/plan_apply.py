@@ -71,6 +71,24 @@ def _res_vec(res) -> "np.ndarray":
     return np.array(res.as_vector(), dtype=np.int64)
 
 
+_ZERO4 = (0, 0, 0, 0)
+
+
+def _table_row_vals(node):
+    """(totals4, reserved4, dead, scalar_only) for one node row — the ONE
+    definition of _NodeTable's per-row column semantics, shared by the
+    bulk build and the delta roll so a rolled table can never drift from
+    a fresh one."""
+    return (
+        _ZERO4 if node.resources is None
+        else tuple(node.resources.as_vector()),
+        _ZERO4 if node.reserved is None
+        else tuple(node.reserved.as_vector()),
+        node.status != "ready" or bool(node.drain),
+        node.reserved is not None and bool(node.reserved.networks),
+    )
+
+
 class _NodeTable:
     """Columnar view of the node set for vectorized plan verification:
     id -> row, plus per-row totals/reserved/liveness. Cached per
@@ -103,29 +121,98 @@ class _NodeTable:
         import collections
         self._mirror_maps = collections.OrderedDict()
         self.rows = {node.id: i for i, node in enumerate(nodes)}
-        # Bulk conversions, not 50k scalar-row assignments: one
-        # list-comprehension pass per column feeds a single np.array
-        # (the same posture as NodeMirror row building).
-        zero4 = (0, 0, 0, 0)
+        # Bulk conversions, not 50k scalar-row assignments: one python
+        # pass computing row tuples (_table_row_vals, shared with the
+        # delta roll) feeds one np.array per column.
         if nodes:
-            self.totals = np.array(
-                [zero4 if n.resources is None else n.resources.as_vector()
-                 for n in nodes], dtype=np.int32)
-            self.reserved = np.array(
-                [zero4 if n.reserved is None else n.reserved.as_vector()
-                 for n in nodes], dtype=np.int64)
+            vals = [_table_row_vals(n) for n in nodes]
+            self.totals = np.array([v[0] for v in vals], dtype=np.int32)
+            self.reserved = np.array([v[1] for v in vals], dtype=np.int64)
             self.dead = np.fromiter(
-                (n.status != "ready" or bool(n.drain) for n in nodes),
-                dtype=bool, count=self.n)
+                (v[2] for v in vals), dtype=bool, count=self.n)
             # reserved networks need the sequential port index: scalar path.
             self.scalar_only = np.fromiter(
-                (n.reserved is not None and bool(n.reserved.networks)
-                 for n in nodes), dtype=bool, count=self.n)
+                (v[3] for v in vals), dtype=bool, count=self.n)
         else:
             self.totals = np.zeros((0, 4), dtype=np.int32)
             self.reserved = np.zeros((0, 4), dtype=np.int64)
             self.dead = np.zeros(0, dtype=bool)
             self.scalar_only = np.zeros(0, dtype=bool)
+
+    def apply_delta(self, changes, snap) -> "Optional[_NodeTable]":
+        """Roll this table forward through node-table ``changes`` (the
+        store's change log, same feed as NodeMirror.apply_delta): dirty
+        rows patch on column copies, brand-new nodes append at the dict
+        tail. Returns None when a delta can't express the change — a
+        node deleted (row shift) or a removed key re-inserted (dict
+        order moved) — and the caller rebuilds. Node writes no longer
+        cost the plan applier an O(N) table rebuild per verify."""
+        import numpy as np
+
+        from nomad_tpu.state.store import partition_node_changes
+
+        # This table's set is ALL nodes (liveness is the dead column,
+        # not membership): resolve is a plain row lookup.
+        parts = partition_node_changes(changes, self.rows.get,
+                                       snap.node_by_id)
+        if parts is None:
+            return None
+        patches, appends = parts
+        if not patches and not appends:
+            return self
+
+        new = _NodeTable.__new__(_NodeTable)
+        new.n = self.n + len(appends)
+        row_vals = _table_row_vals
+        totals = self.totals
+        reserved = self.reserved
+        dead = self.dead
+        scalar_only = self.scalar_only
+        if patches:
+            totals = totals.copy()
+            reserved = reserved.copy()
+            dead = dead.copy()
+            scalar_only = scalar_only.copy()
+            for row, node in patches:
+                t, r, d, s = row_vals(node)
+                totals[row] = t
+                reserved[row] = r
+                dead[row] = d
+                scalar_only[row] = s
+        if appends:
+            app_vals = [row_vals(node) for _pos, node in appends]
+            totals = np.concatenate([totals, np.array(
+                [v[0] for v in app_vals], dtype=np.int32)])
+            reserved = np.concatenate([reserved, np.array(
+                [v[1] for v in app_vals], dtype=np.int64)])
+            dead = np.concatenate([dead, np.array(
+                [v[2] for v in app_vals], dtype=bool)])
+            scalar_only = np.concatenate([scalar_only, np.array(
+                [v[3] for v in app_vals], dtype=bool)])
+            rows = dict(self.rows)
+            for i, (_pos, node) in enumerate(appends):
+                rows[node.id] = self.n + i
+            new.rows = rows
+            # Row numbering of existing nodes didn't move, but cached
+            # resolutions may hold -1 for the appended ids and the usage
+            # accumulator is row-aligned: rebuild those lazily.
+            new.block_rows_cache = {}
+            import collections
+            new._mirror_maps = collections.OrderedDict()
+            new.block_usage_cache = None
+        else:
+            new.rows = self.rows
+            # Pure row patches leave row numbering AND block usage
+            # (a function of blocks, not node fields) intact: share the
+            # warm caches with the ancestor.
+            new.block_rows_cache = self.block_rows_cache
+            new._mirror_maps = self._mirror_maps
+            new.block_usage_cache = self.block_usage_cache
+        new.totals = totals
+        new.reserved = reserved
+        new.dead = dead
+        new.scalar_only = scalar_only
+        return new
 
     def mirror_rows(self, ids_ref) -> "np.ndarray":
         """Table rows aligned with a solver mirror's id array (-1 for ids
@@ -156,14 +243,19 @@ _NODE_TABLE_CACHE: "OrderedDict" = None  # type: ignore[assignment]
 
 def _node_table(snap):
     """Cached _NodeTable for a snapshot, or None for states without the
-    store internals (protocol-only fakes)."""
+    store internals (protocol-only fakes). A key miss delta-rolls the
+    newest cached table of the same store through the node change log
+    (NodeTable.apply_delta) before falling back to a full build — the
+    MirrorCache posture, applied to the plan applier's staging."""
     import collections
 
     global _NODE_TABLE_CACHE
     uid = getattr(snap, "store_uid", "")
     if not uid or not hasattr(snap, "alloc_blocks"):
         return None
-    key = (uid, snap.get_index("nodes"))
+    nodes_index = snap.get_index("nodes")
+    key = (uid, nodes_index)
+    ancestor = None
     with _NODE_TABLE_LOCK:
         if _NODE_TABLE_CACHE is None:
             _NODE_TABLE_CACHE = collections.OrderedDict()
@@ -171,8 +263,28 @@ def _node_table(snap):
         if table is not None:
             _NODE_TABLE_CACHE.move_to_end(key)
             return table
-    table = _NodeTable(snap)
+        best = None
+        for k in _NODE_TABLE_CACHE:
+            if (k[0] == uid and k[1] < nodes_index
+                    and (best is None or k[1] > best[1])):
+                best = k
+        if best is not None:
+            ancestor = (best, _NODE_TABLE_CACHE[best])
+    table = None
+    if ancestor is not None and hasattr(snap, "node_changes_since"):
+        changes = snap.node_changes_since(ancestor[0][1])
+        if changes is not None:
+            table = ancestor[1].apply_delta(changes, snap)
+            if table is not None:
+                telemetry.incr_counter(("plan", "node_table_rolls"))
+    if table is None:
+        table = _NodeTable(snap)
+        telemetry.incr_counter(("plan", "node_table_rebuilds"))
     with _NODE_TABLE_LOCK:
+        existing = _NODE_TABLE_CACHE.get(key)
+        if existing is not None:
+            _NODE_TABLE_CACHE.move_to_end(key)
+            return existing
         _NODE_TABLE_CACHE[key] = table
         while len(_NODE_TABLE_CACHE) > 4:
             _NODE_TABLE_CACHE.popitem(last=False)

@@ -706,6 +706,9 @@ class Server:
                 cache = mirror.GLOBAL_MIRROR_CACHE
                 out["mirror_cache_hits"] = cache.hits
                 out["mirror_cache_misses"] = cache.misses
+                out["mirror_delta_rolls"] = cache.delta_rolls
+                out["mirror_full_rebuilds"] = cache.full_rebuilds
+                out["mirror_rows_restaged"] = cache.rows_restaged
         except Exception:  # stats must never break agent-info
             pass
         return out

@@ -46,6 +46,7 @@ from nomad_tpu.simcluster.workload import (
     Action,
     BatchBurstInjector,
     NodeChurnInjector,
+    NodeRefreshInjector,
     SteadyServiceInjector,
     UpdateChurnInjector,
     build_job,
@@ -92,13 +93,23 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
         ),
         "steady-10k": ScenarioSpec(
             name="steady-10k", n_nodes=10_000,
-            injectors=lambda seed: [SteadyServiceInjector(
-                seed, jobs=24, tasks_per_job=420, over=18.0,
-            )],
+            injectors=lambda seed: [
+                SteadyServiceInjector(
+                    seed, jobs=24, tasks_per_job=420, over=18.0,
+                ),
+                # Steady node-write load riding the placement window: the
+                # fingerprint-refresh posture whose single-node upserts
+                # the delta mirror must absorb without full rebuilds
+                # (the artifact's "mirror" section proves it).
+                NodeRefreshInjector(
+                    seed, count=12, every=0.9, start=0.7, until=17.5,
+                ),
+            ],
             quiesce_timeout=300.0, ack_cap=300,
             description="the north-star control-plane scale: 10k live "
                         "nodes, 24 service jobs x420 tasks over ~18s "
-                        "(10,080 placements)",
+                        "(10,080 placements) under steady node-refresh "
+                        "writes (12 re-registrations every ~0.9s)",
         ),
         "burst-100k": ScenarioSpec(
             name="burst-100k", n_nodes=10_000,
@@ -257,6 +268,27 @@ class ScenarioRunner:
         )
         return out["eval_id"]
 
+    def _refresh_nodes(self, fleet: SimFleet, payload: Dict) -> None:
+        """Re-register ``count`` live nodes with identical fingerprints:
+        one batched node upsert through raft — the steady node-write load
+        the delta-maintained device mirror absorbs (membership and mask
+        surface unchanged, placements unaffected). Seeded pick over the
+        sorted live set keeps the event digest deterministic."""
+        rng = payload["rng"]
+        live = sorted(fleet.live_nodes())
+        if not live:
+            return
+        pick = rng.sample(live, min(int(payload["count"]), len(live)))
+        nodes = []
+        for nid in pick:
+            i = int(nid.rsplit("-", 1)[1])
+            nodes.append(sim_node(i, "dc1" if i % 2 == 0 else "dc2"))
+        fleet._pool().call(
+            self._srv.rpc_addr, "Node.BatchRegister",
+            {"nodes": [to_dict(n) for n in nodes]},
+            timeout=fleet.rpc_timeout,
+        )
+
     def _fail_nodes(self, fleet: SimFleet, payload: Dict) -> List[str]:
         rng = payload["rng"]
         count = int(payload["count"])
@@ -281,6 +313,7 @@ class ScenarioRunner:
 
     def run(self) -> Dict:
         from nomad_tpu.ops.coalesce import GLOBAL_SOLVER
+        from nomad_tpu.tpu.mirror import GLOBAL_MIRROR_CACHE
 
         spec = self.spec
         cfg = ServerConfig(
@@ -338,6 +371,7 @@ class ScenarioRunner:
             hb0 = srv.heartbeat.stats()
             t_measure0 = time.perf_counter()
             dispatches0 = GLOBAL_SOLVER.dispatches
+            mirror0 = GLOBAL_MIRROR_CACHE.stats()
             watcher = threading.Thread(
                 target=self._watch_events, args=(broker, cursor),
                 daemon=True, name="sim-events")
@@ -366,6 +400,8 @@ class ScenarioRunner:
                     ev_id = self._update_job(fleet, action.payload)
                     if ev_id:
                         expected_evals.append(ev_id)
+                elif action.kind == "refresh_nodes":
+                    self._refresh_nodes(fleet, action.payload)
                 elif action.kind == "fail_nodes":
                     failed_tranche = self._fail_nodes(fleet, action.payload)
 
@@ -375,6 +411,15 @@ class ScenarioRunner:
             measured = time.perf_counter() - t_measure0
             hb1 = srv.heartbeat.stats()
             dispatches = GLOBAL_SOLVER.dispatches - dispatches0
+            mirror1 = GLOBAL_MIRROR_CACHE.stats()
+            # The delta economy over the MEASURED window: under steady
+            # heartbeat/refresh churn, delta_rolls must dominate and
+            # full_rebuilds stay the exception.
+            mirror = {
+                k: mirror1[k] - mirror0[k]
+                for k in ("hits", "misses", "delta_rolls",
+                          "full_rebuilds", "rows_restaged")
+            }
 
             # Phase 4: alloc acknowledgement (bounded client posture).
             acked = 0
@@ -394,7 +439,7 @@ class ScenarioRunner:
                 t.join(timeout=5.0)
             return self._artifact(
                 srv, fleet, reg, hb0, hb1, dispatches, acked, wall,
-                measured, len(expected_evals),
+                measured, len(expected_evals), mirror,
             )
         finally:
             self._stop.set()
@@ -437,7 +482,7 @@ class ScenarioRunner:
         )
 
     def _artifact(self, srv, fleet, reg, hb0, hb1, dispatches, acked,
-                  wall, measured, n_injected_evals) -> Dict:
+                  wall, measured, n_injected_evals, mirror) -> Dict:
         with self._events_lock:
             events = list(self._events)
         pending_at: Dict[str, float] = {}
@@ -531,6 +576,10 @@ class ScenarioRunner:
                 "expirations": expired_nodes,
             },
             "alloc_ack": {"acked": acked},
+            # Device-mirror delta economy over the measured window (the
+            # perf_opt acceptance gauge: delta_rolls >> full_rebuilds
+            # under steady node-write load).
+            "mirror": mirror,
             "events": {
                 "observed": len(events),
                 "truncated": self._truncated,

@@ -208,6 +208,37 @@ class UpdateChurnInjector(Injector):
         return lambda: build_job(jid, structs.JOB_TYPE_SERVICE, count)
 
 
+class NodeRefreshInjector(Injector):
+    """Steady node-table write load: every ``every`` seconds, ``count``
+    live nodes re-register with unchanged fingerprints (the periodic
+    client re-registration/fingerprint-refresh posture) — one batched
+    node upsert through raft per tick. This is the single-node-write
+    pattern the delta-maintained device mirror absorbs: membership and
+    mask surface don't move, so each tick should cost one delta roll,
+    never a full 10k-row rebuild, and placements are unaffected."""
+
+    name = "node-refresh"
+
+    def __init__(self, seed: int, count: int, every: float,
+                 start: float = 0.5, until: float = 10.0):
+        super().__init__(seed)
+        self.count = count
+        self.every = every
+        self.start = start
+        self.until = until
+
+    def actions(self) -> List[Action]:
+        out = []
+        t = self.start
+        while t < self.until:
+            out.append(Action(
+                at=t, kind="refresh_nodes",
+                payload={"count": self.count, "rng": self.rng},
+            ))
+            t += self.every
+        return out
+
+
 class NodeChurnInjector(Injector):
     """Node-failure churn: silence ``count`` nodes at ``at`` seconds. The
     runner resolves the tranche (preferring alloc-hosting nodes with this

@@ -37,7 +37,7 @@ class StoredAllocBlock(AllocBatch):
 
     __slots__ = (
         "block_id", "job_id", "create_index", "modify_index", "excluded",
-        "_id_pos", "_node_run", "_materialized",
+        "_id_pos", "_node_run", "_live_counts", "_materialized",
     )
 
     def __init__(self, *args, **kwargs):
@@ -49,6 +49,7 @@ class StoredAllocBlock(AllocBatch):
         self.excluded: FrozenSet[int] = frozenset()
         self._id_pos: Optional[Dict[str, int]] = None
         self._node_run: Optional[Dict[str, Tuple[int, int]]] = None
+        self._live_counts: Optional[Dict[str, int]] = None
         self._materialized: Optional[List[Allocation]] = None
 
     @classmethod
@@ -95,6 +96,20 @@ class StoredAllocBlock(AllocBatch):
                 return nid
             scan += cnt
         return ""
+
+    def live_counts_map(self) -> Dict[str, int]:
+        """node_id → total live member count, duplicate runs summed
+        (``node_runs`` keeps only a node's LAST run). Cached — blocks are
+        immutable, exclusion replaces the object — so per-node usage
+        recomputes (the mirror's base-usage roll forward) pay one O(runs)
+        build per block, then dict hits."""
+        counts = self._live_counts
+        if counts is None:
+            counts = {}
+            for nid, cnt in self.live_node_counts():
+                counts[nid] = counts.get(nid, 0) + cnt
+            self._live_counts = counts
+        return counts
 
     def live_node_counts(self) -> Iterator[Tuple[str, int]]:
         """(node_id, live placement count) per run — the columnar usage
@@ -209,6 +224,7 @@ class StoredAllocBlock(AllocBatch):
         blk.excluded = self.excluded
         blk._id_pos = self._id_pos
         blk._node_run = self._node_run
+        blk._live_counts = self._live_counts  # same members, same counts
         return blk
 
     # -- copy-on-write exclusion ------------------------------------------
@@ -261,6 +277,7 @@ class StoredAllocBlock(AllocBatch):
             self._ids_hex = ""
         self._id_pos = None
         self._node_run = None
+        self._live_counts = None
         self._materialized = None
 
     def to_wire(self) -> dict:

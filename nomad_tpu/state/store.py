@@ -38,6 +38,90 @@ from nomad_tpu.structs import (
 # ("alloc_node", node_id). Mirrors nomad/watch/watch.go:11-37.
 WatchItem = Tuple[str, str]
 
+# Bounded change-log horizons (entries retained after a trim; trims fire at
+# twice this length). Consumers holding tensors built at index N ask "what
+# changed since N" and delta-patch instead of rebuilding (the device mirror,
+# nomad_tpu/tpu/mirror.py); a log that no longer reaches back to N returns
+# None and the consumer falls back to a full rebuild. The node horizon is
+# sized for steady heartbeat/registration churn at 10k nodes; the alloc log
+# holds one entry PER WRITE (a plan commit is one entry carrying its touched
+# node ids), so a smaller entry count covers many plans.
+NODE_LOG_HORIZON = 4096
+ALLOC_LOG_HORIZON = 1024
+
+
+def _log_node_change(t: "_Tables", index: int, node_id: str,
+                     kind: str) -> None:
+    """Append one node-table delta (lock held by the caller). ``kind`` is
+    "insert" (new key), "update" (existing key re-written in place, dict
+    order preserved) or "remove" — the distinction the mirror's roll
+    forward needs to prove dict-iteration order didn't move. Trims rebind
+    the list so snapshots sharing the old reference stay consistent."""
+    log = t.node_log
+    log.append((index, node_id, kind))
+    if len(log) > 2 * NODE_LOG_HORIZON:
+        t.node_log_floor = log[-NODE_LOG_HORIZON - 1][0]
+        t.node_log = log[-NODE_LOG_HORIZON:]
+
+
+def _log_alloc_nodes(t: "_Tables", index: int, node_ids) -> None:
+    """Append one allocs-table delta: the node ids whose usage this write
+    may have changed (lock held by the caller). One entry per write — a
+    100k-placement plan commit is a single entry sharing the batch's id
+    list, not 10k appends."""
+    if not node_ids:
+        return
+    log = t.alloc_log
+    log.append((index, tuple(node_ids)))
+    if len(log) > 2 * ALLOC_LOG_HORIZON:
+        t.alloc_log_floor = log[-ALLOC_LOG_HORIZON - 1][0]
+        t.alloc_log = log[-ALLOC_LOG_HORIZON:]
+
+
+def partition_node_changes(changes, rows_get, resolve):
+    """Interpret a node change-log slice for a delta consumer holding
+    rows keyed by ``rows_get`` (node_id → row or None). ``resolve``
+    returns a node's current form, or None when it left the consumer's
+    set. THE one interpreter of the log's (index, node_id, kind)
+    semantics, shared by the device mirror and the plan applier's node
+    table so the two can never diverge on the same feed.
+
+    Returns ``(patches, appends)`` — in-place row rewrites and dict-tail
+    appends (sorted in re-insertion order, which IS the store's
+    iteration order for new keys) — or None when the slice can't be
+    expressed as a delta: a resident node left the set or had its dict
+    key re-inserted (its row, or iteration order, moves), or a
+    pre-existing key entered the set mid-order."""
+    last_insert: Dict[str, int] = {}
+    removed: Set[str] = set()
+    order: List[str] = []
+    seen: Set[str] = set()
+    for pos, (_idx, node_id, kind) in enumerate(changes):
+        if node_id not in seen:
+            seen.add(node_id)
+            order.append(node_id)
+        if kind == "remove":
+            removed.add(node_id)
+        elif kind == "insert":
+            last_insert[node_id] = pos
+    patches: List[Tuple[int, Node]] = []
+    appends: List[Tuple[int, Node]] = []
+    for node_id in order:
+        node = resolve(node_id)
+        row = rows_get(node_id)
+        if row is not None:
+            if node is None or node_id in removed:
+                return None
+            patches.append((row, node))
+        elif node is not None:
+            pos = last_insert.get(node_id)
+            if pos is None:
+                return None
+            appends.append((pos, node))
+        # else: irrelevant to this consumer's set.
+    appends.sort()
+    return patches, appends
+
 
 def item_table(name: str) -> WatchItem:
     return ("table", name)
@@ -171,6 +255,13 @@ class _Tables:
         # a scan-based gate would re-walk on every eval). Maintained by
         # _insert_alloc_row/_replace_alloc_row/the GC pop.
         self.live_objs_by_job: Dict[str, int] = {}
+        # Bounded change logs (index-ascending). ``*_floor`` is the highest
+        # index whose entries may have been trimmed away: a consumer
+        # rolling forward from N has complete coverage iff N >= floor.
+        self.node_log: List[Tuple[int, str, str]] = []
+        self.node_log_floor: int = 0
+        self.alloc_log: List[Tuple[int, Tuple[str, ...]]] = []
+        self.alloc_log_floor: int = 0
 
     def copy(self) -> "_Tables":
         new = _Tables()
@@ -187,6 +278,15 @@ class _Tables:
         new.blocks_by_job = {k: set(v) for k, v in self.blocks_by_job.items()}
         new.blocks_by_eval = {k: set(v) for k, v in self.blocks_by_eval.items()}
         new.live_objs_by_job = dict(self.live_objs_by_job)
+        # Logs are SHARED by reference: between trims they're append-only
+        # (list.append is atomic under the GIL, and readers filter by
+        # index, so post-snapshot appends are invisible to them); a trim
+        # rebinds the LIVE tables' attribute, leaving this copy's
+        # reference — and its matching floor — intact.
+        new.node_log = self.node_log
+        new.node_log_floor = self.node_log_floor
+        new.alloc_log = self.alloc_log
+        new.alloc_log_floor = self.alloc_log_floor
         return new
 
 
@@ -318,6 +418,65 @@ class _StateView:
             out.extend(self._t.blocks[bid].materialize())
         return out
 
+    # -- change logs (delta consumers: the device mirror) -----------------
+
+    def node_changes_since(self, index: int) -> Optional[
+            List[Tuple[int, str, str]]]:
+        """Node-table deltas ``(index, node_id, kind)`` with index in
+        ``(index, this view's nodes index]``, oldest first — the feed for
+        NodeMirror.apply_delta. Returns None when the bounded log no
+        longer reaches back to ``index`` (the consumer must rebuild)."""
+        t = self._t
+        # Read the list BEFORE the floor: the trim writes floor first,
+        # then rebinds the list, so this order can pessimize (old list,
+        # new floor → spurious None) but never read a trimmed list
+        # against a stale floor.
+        log = t.node_log
+        if index < t.node_log_floor:
+            return None
+        my = self.get_index("nodes")
+        out: List[Tuple[int, str, str]] = []
+        for i in range(len(log) - 1, -1, -1):
+            e = log[i]
+            if e[0] <= index:
+                break
+            if e[0] <= my:
+                out.append(e)
+        out.reverse()
+        return out
+
+    def alloc_node_changes_since(self, index: int) -> Optional[Set[str]]:
+        """Node ids whose allocation usage may have changed after
+        ``index`` (up to this view's allocs index), or None past the log
+        horizon. Feeds the mirror's base-usage roll forward."""
+        t = self._t
+        # List-before-floor read order: see node_changes_since.
+        log = t.alloc_log
+        if index < t.alloc_log_floor:
+            return None
+        my = self.get_index("allocs")
+        out: Set[str] = set()
+        for i in range(len(log) - 1, -1, -1):
+            e = log[i]
+            if e[0] <= index:
+                break
+            if e[0] <= my:
+                out.update(e[1])
+        return out
+
+    def alloc_object_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        """Object-table row only (no block materialization) — the cheap
+        'was this id counted as an object row' probe the mirror's usage
+        plan-delta needs."""
+        return self._t.allocs.get(alloc_id)
+
+    def allocs_by_job_objects(self, job_id: str) -> List[Allocation]:
+        """Object-table rows of one job (complement of
+        job_alloc_blocks()) — lets per-eval job/tg counting walk the
+        job's own allocs instead of the whole cluster."""
+        ids = self._t.allocs_by_job.get(job_id, ())
+        return [self._t.allocs[i] for i in ids]
+
     # -- indexes ----------------------------------------------------------
 
     def get_index(self, table: str) -> int:
@@ -345,17 +504,25 @@ class StateSnapshot(_StateView):
         # tensors while distinct stores never collide (SURVEY.md §7
         # "state mirror keyed by a state-store generation").
         self.store_uid = store_uid
+        # Set once this snapshot diverges from its store via optimistic
+        # writes: its index-stamps then name content the shared change
+        # logs don't describe, so generation-keyed caches (the mirror's
+        # base usage) must neither trust deltas from it nor cache it.
+        self.optimistic = False
 
     # The plan applier attaches allocs optimistically; reuse the same
     # write-side helpers against the snapshot's private tables.
     def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
+        self.optimistic = True
         _upsert_allocs(self._t, index, allocs)
 
     def upsert_alloc_blocks(self, index: int, batches) -> None:
         # Optimistic snapshot writes never notify: skip item building.
+        self.optimistic = True
         _upsert_alloc_blocks(self._t, index, batches)
 
     def apply_update_batches(self, index: int, batches) -> None:
+        self.optimistic = True
         _apply_update_batches(self._t, index, batches)
 
 
@@ -464,7 +631,17 @@ def _exclude_block_members(t: _Tables, members: Dict[str, Set[int]]) -> None:
             t.blocks[bid] = blk
 
 
-def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
+def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation],
+                   touched: Optional[Set[str]] = None) -> None:
+    # ``touched`` (when given) collects the node ids whose usage this
+    # write may change — the live store's alloc change-log feed. Optimistic
+    # snapshot writes pass None and stay out of the shared log.
+    if touched is not None:
+        for alloc in allocs:
+            touched.add(alloc.node_id)
+            existing = t.allocs.get(alloc.id)
+            if existing is not None and existing.node_id != alloc.node_id:
+                touched.add(existing.node_id)
     # An object row superseding a block member (eviction, re-placement,
     # client-side restamp) promotes it out of the block.
     if t.blocks:
@@ -476,6 +653,10 @@ def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
             if found is not None:
                 bid, pos = found
                 members.setdefault(bid, set()).add(pos)
+                if touched is not None:
+                    # A superseded member's OLD node loses its block
+                    # usage — a cross-node restamp must dirty both ends.
+                    touched.add(t.blocks[bid].node_of_pos(pos))
                 if alloc.create_index == 0:
                     alloc.create_index = t.blocks[bid].create_index
         if members:
@@ -500,7 +681,8 @@ def _upsert_allocs(t: _Tables, index: int, allocs: List[Allocation]) -> None:
 
 
 def _apply_update_batches(t: _Tables, index: int, batches,
-                          watch: "_Watch" = None) -> List[WatchItem]:
+                          watch: "_Watch" = None,
+                          touched: Optional[Set[str]] = None) -> List[WatchItem]:
     """Columnar in-place updates: whole-block field swap when a batch
     covers all live members of a stored block; promotion for partial
     coverage; row re-stamp for object allocs. Returns watch items.
@@ -582,6 +764,10 @@ def _apply_update_batches(t: _Tables, index: int, batches,
             _insert_alloc_row(t, new)
             stamped_rows.append(new)
     t.indexes["allocs"] = index
+    if touched is not None:
+        for blk in swapped_blks:
+            touched.update(blk.node_ids)
+        touched.update(r.node_id for r in stamped_rows)
     if stamped_rows:
         # Container (job/eval) items fire unconditionally, deduped
         # batch-wide: every row of a batch shares its eval id, and job
@@ -603,7 +789,8 @@ def _apply_update_batches(t: _Tables, index: int, batches,
 
 
 def _upsert_alloc_blocks(t: _Tables, index: int, batches,
-                         watch: "_Watch" = None) -> List[WatchItem]:
+                         watch: "_Watch" = None,
+                         touched: Optional[Set[str]] = None) -> List[WatchItem]:
     """Commit columnar batches as stored blocks — O(runs), no object
     expansion. Returns the watch items to notify. Per-node items (a block
     touches thousands of nodes) are built only when ``watch`` has
@@ -622,6 +809,8 @@ def _upsert_alloc_blocks(t: _Tables, index: int, batches,
         items.append(item_alloc_job(blk.job_id))
         items.append(item_alloc_eval(blk.eval_id))
         committed.append(blk)
+        if touched is not None:
+            touched.update(blk.node_ids)
     t.indexes["allocs"] = index
     if watch is not None and watch.has_waiters_for("alloc_node"):
         for blk in committed:
@@ -650,6 +839,11 @@ class StateStore(_StateView):
 
     def _install(self, tables: _Tables) -> None:
         with self._lock:
+            # A wholesale install (restore) carries no change history:
+            # floors at the installed indexes force every delta consumer
+            # through one full rebuild instead of a bogus empty delta.
+            tables.node_log_floor = tables.indexes.get("nodes", 0)
+            tables.alloc_log_floor = tables.indexes.get("allocs", 0)
             self._t = tables
         self.watch.notify(
             [
@@ -662,9 +856,11 @@ class StateStore(_StateView):
 
     # -- nodes ------------------------------------------------------------
 
-    def _upsert_node_locked(self, index: int, node: Node) -> None:
+    def _upsert_node_locked(self, index: int, node: Node) -> str:
         """Index-stamp + insert (lock held) — the ONE definition of node
-        upsert semantics, shared by the single and batch paths."""
+        upsert semantics, shared by the single and batch paths. Returns
+        the change-log kind ("insert" for a new key, "update" for an
+        in-place rewrite)."""
         existing = self._t.nodes.get(node.id)
         if existing is None:
             node.create_index = index
@@ -672,11 +868,13 @@ class StateStore(_StateView):
             node.create_index = existing.create_index
         node.modify_index = index
         self._t.nodes[node.id] = node
+        return "insert" if existing is None else "update"
 
     def upsert_node(self, index: int, node: Node) -> None:
         """reference: state_store.go UpsertNode"""
         with self._lock:
-            self._upsert_node_locked(index, node)
+            kind = self._upsert_node_locked(index, node)
+            _log_node_change(self._t, index, node.id, kind)
             self._t.indexes["nodes"] = index
         self.watch.notify([item_table("nodes"), item_node(node.id)])
 
@@ -688,7 +886,8 @@ class StateStore(_StateView):
         granularity economy as the columnar alloc commits."""
         with self._lock:
             for node in nodes:
-                self._upsert_node_locked(index, node)
+                kind = self._upsert_node_locked(index, node)
+                _log_node_change(self._t, index, node.id, kind)
             self._t.indexes["nodes"] = index
         items = [item_table("nodes")]
         if self.watch.has_waiters_for("node"):
@@ -700,6 +899,7 @@ class StateStore(_StateView):
             if node_id not in self._t.nodes:
                 raise KeyError(f"node not found: {node_id}")
             del self._t.nodes[node_id]
+            _log_node_change(self._t, index, node_id, "remove")
             self._t.indexes["nodes"] = index
         self.watch.notify([item_table("nodes"), item_node(node_id)])
 
@@ -712,6 +912,7 @@ class StateStore(_StateView):
             node.status = status
             node.modify_index = index
             self._t.nodes[node_id] = node
+            _log_node_change(self._t, index, node_id, "update")
             self._t.indexes["nodes"] = index
         self.watch.notify([item_table("nodes"), item_node(node_id)])
 
@@ -724,6 +925,7 @@ class StateStore(_StateView):
             node.drain = drain
             node.modify_index = index
             self._t.nodes[node_id] = node
+            _log_node_change(self._t, index, node_id, "update")
             self._t.indexes["nodes"] = index
         self.watch.notify([item_table("nodes"), item_node(node_id)])
 
@@ -772,6 +974,7 @@ class StateStore(_StateView):
         (reference: state_store.go DeleteEval)."""
         items: List[WatchItem] = [item_table("evals"), item_table("allocs")]
         reaped_blocks: List[StoredAllocBlock] = []
+        touched: Set[str] = set()
         with self._lock:
             t = self._t
             for eval_id in eval_ids:
@@ -811,6 +1014,7 @@ class StateStore(_StateView):
                             # Watchers see block-member deletions exactly
                             # like object-row deletions.
                             blk = t.blocks[bid]
+                            touched.add(blk.node_of_pos(pos))
                             items.extend(
                                 [
                                     item_alloc(alloc_id),
@@ -830,6 +1034,7 @@ class StateStore(_StateView):
                         ids.discard(alloc_id)
                         if not ids:
                             del idx_map[key]
+                touched.add(alloc.node_id)
                 items.extend(
                     [
                         item_alloc(alloc_id),
@@ -840,6 +1045,9 @@ class StateStore(_StateView):
                 )
             if block_members:
                 _exclude_block_members(t, block_members)
+            for blk in reaped_blocks:
+                touched.update(blk.node_ids)
+            _log_alloc_nodes(t, index, touched)
             t.indexes["evals"] = index
             t.indexes["allocs"] = index
             # Gated member items, sampled AFTER the index stamps (the
@@ -854,8 +1062,10 @@ class StateStore(_StateView):
 
     def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
         items: List[WatchItem] = [item_table("allocs")]
+        touched: Set[str] = set()
         with self._lock:
-            _upsert_allocs(self._t, index, allocs)
+            _upsert_allocs(self._t, index, allocs, touched=touched)
+            _log_alloc_nodes(self._t, index, touched)
             for alloc in allocs:
                 items.extend(
                     [
@@ -870,10 +1080,12 @@ class StateStore(_StateView):
     def upsert_alloc_blocks(self, index: int, batches: List[AllocBatch]) -> None:
         """Commit columnar placement batches natively (no per-Allocation
         expansion); blocking queries on the touched nodes/job/eval fire."""
+        touched: Set[str] = set()
         with self._lock:
             items = _upsert_alloc_blocks(
-                self._t, index, batches, watch=self.watch,
+                self._t, index, batches, watch=self.watch, touched=touched,
             )
+            _log_alloc_nodes(self._t, index, touched)
         self.watch.notify(items)
 
     def apply_update_batches(self, index: int, batches) -> None:
@@ -883,10 +1095,12 @@ class StateStore(_StateView):
         promotes the touched members; object rows re-stamp in place. The
         observable result is exactly the batch's materialize() expansion
         upserted row-wise."""
+        touched: Set[str] = set()
         with self._lock:
             items = _apply_update_batches(
-                self._t, index, batches, watch=self.watch,
+                self._t, index, batches, watch=self.watch, touched=touched,
             )
+            _log_alloc_nodes(self._t, index, touched)
         self.watch.notify(items)
 
     def update_alloc_from_client(self, index: int, alloc: Allocation) -> None:

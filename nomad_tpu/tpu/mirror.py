@@ -14,11 +14,14 @@ caches stay warm across varying cluster sizes.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nomad_tpu import telemetry
 from nomad_tpu.ops.binpack import bucket
 from nomad_tpu.parallel.mesh import put_node_sharded
 from nomad_tpu.scheduler.feasible import (
@@ -26,7 +29,7 @@ from nomad_tpu.scheduler.feasible import (
     check_constraint,
     resolve_constraint_target,
 )
-from nomad_tpu.structs import Constraint, Node, Resources
+from nomad_tpu.structs import NODE_STATUS_READY, Constraint, Node, Resources
 
 # Sentinel distinguishing "target didn't resolve" (fails the node, any
 # operand) from a present-but-None value (a real value; '!=' may pass).
@@ -45,6 +48,82 @@ def _task_bw(task_resources: Dict[str, Resources]) -> int:
         if res.networks:
             total += res.networks[0].mbits
     return total
+
+
+def _node_row_vals(node: Node) -> Tuple[Tuple, Tuple, int, int]:
+    """(total4, reserved4, bw_avail, bw_reserved) row values — the exact
+    per-row arithmetic of the bulk build in ``NodeMirror.__init__``,
+    shared by ``apply_delta`` so a patched row can never drift from a
+    freshly built one (the fuzz differential's bit-identity contract)."""
+    total = (tuple(node.resources.as_vector())
+             if node.resources is not None else (0, 0, 0, 0))
+    reserved = (tuple(node.reserved.as_vector())
+                if node.reserved is not None else (0, 0, 0, 0))
+    bw_avail = 0
+    if node.resources is not None and node.resources.networks:
+        bw_avail = sum(
+            net.mbits for net in node.resources.networks if net.device
+        )
+    bw_reserved = 0
+    if node.reserved is not None and node.reserved.networks:
+        bw_reserved = sum(net.mbits for net in node.reserved.networks)
+    return total, reserved, bw_avail, bw_reserved
+
+
+@jax.jit
+def _rows_update(total, sched_cap, bw_avail, rows, tot, sched, bwa):
+    """One fused dispatch for the mirror's row-sliced device restage:
+    three separate .at[].set calls cost ~2ms of un-jitted dispatch EACH
+    on a warm CPU backend — more than the entire roll saves."""
+    return (
+        total.at[rows].set(tot),
+        sched_cap.at[rows].set(sched),
+        bw_avail.at[rows].set(bwa),
+    )
+
+
+@jax.jit
+def _usage_rows_update(used, bw, rows, res, bwr):
+    """Fused row restage of the clean-usage pair (reserved deltas)."""
+    return used.at[rows].set(res), bw.at[rows].set(bwr)
+
+
+def _pad_rows(rows_arr: np.ndarray, *vals: np.ndarray):
+    """Pad a row-update batch to a power-of-two bucket by repeating the
+    first (row, value) pair, so the jitted scatter compiles per bucket
+    instead of per exact dirty-row count. Duplicate identical updates
+    are value-deterministic."""
+    k = len(rows_arr)
+    pk = bucket(k)
+    if pk == k:
+        return (rows_arr,) + vals
+    reps = pk - k
+    out = [np.concatenate([rows_arr, np.full(reps, rows_arr[0],
+                                             dtype=rows_arr.dtype)])]
+    for v in vals:
+        out.append(np.concatenate([v, np.repeat(v[:1], reps, axis=0)]))
+    return tuple(out)
+
+
+def _surface_targets(old: Node, new: Node, out: Set[str]) -> None:
+    """Constraint-target strings whose cached columns a node rewrite
+    invalidates. The target grammar reads only id/name/datacenter/
+    attributes/meta (feasible.resolve_constraint_target:209-230), so
+    those fields ARE the whole mask surface; a resource-only rewrite
+    (the heartbeat/re-registration steady state) adds nothing and every
+    mask cache survives the roll."""
+    if old.name != new.name:
+        out.add("$node.name")
+    if old.datacenter != new.datacenter:
+        out.add("$node.datacenter")
+    if old.attributes != new.attributes:
+        for k in set(old.attributes) | set(new.attributes):
+            if old.attributes.get(k) != new.attributes.get(k):
+                out.add(f"$attr.{k}")
+    if old.meta != new.meta:
+        for k in set(old.meta) | set(new.meta):
+            if old.meta.get(k) != new.meta.get(k):
+                out.add(f"$meta.{k}")
 
 
 class NodeMirror:
@@ -117,6 +196,210 @@ class NodeMirror:
         # generation stays on device.
         self._device_mask_cache: Dict[Tuple, "jnp.ndarray"] = {}
         self._clean_usage_dev = None
+        # Job-independent base usage (reserved + every existing alloc),
+        # cached per (store_uid, allocs index) and rolled forward through
+        # the store's alloc change log — per-eval usage is a copy of this
+        # plus the plan's in-flight rows, never a cluster walk.
+        self._usage_lock = threading.Lock()
+        self._base_usage: Optional[Tuple[str, int, np.ndarray, np.ndarray]] = None
+
+    # -- delta maintenance -------------------------------------------------
+
+    def apply_delta(self, changes, state, datacenters: List[str]):
+        """Roll this mirror forward through node-table ``changes``
+        (``(index, node_id, kind)`` from ``state.node_changes_since``).
+
+        Returns ``(mirror, rows_restaged)`` — a new mirror sharing every
+        unchanged buffer/cache with this one, with only the dirty rows
+        patched host-side and re-staged to device via row-sliced updates
+        of the padded sharded buffers — or None when the change set
+        forces a full rebuild: a node LEFT the ready set (its row shifts
+        every later row), a pre-existing node re-entered it mid-order, or
+        appends cross the power-of-two padding bucket. In-place rewrites
+        of resident nodes (heartbeat flips, re-registrations, resource
+        drift) and tail appends of brand-new nodes stay on the delta
+        path; writes to nodes outside this mirror's datacenter/ready set
+        are free no-ops."""
+        from nomad_tpu.state.store import partition_node_changes
+
+        dc_set = set(datacenters)
+
+        def resolve(node_id):
+            # This mirror's set: the ready, non-draining nodes of its
+            # datacenters (ready_nodes_in_dcs). Writes outside it are
+            # free no-ops for the roll.
+            node = state.node_by_id(node_id)
+            if (node is None or node.status != NODE_STATUS_READY
+                    or node.drain or node.datacenter not in dc_set):
+                return None
+            return node
+
+        parts = partition_node_changes(changes, self.index.get, resolve)
+        if parts is None:
+            return None
+        patches, appends = parts
+        if not patches and not appends:
+            return self, 0
+        new_n = self.n + len(appends)
+        if bucket(max(new_n, 1)) != self.padded:
+            return None  # repadding boundary
+
+        nodes = list(self.nodes)
+        rows: List[int] = []
+        tot_rows: List[Tuple] = []
+        res_rows: List[Tuple] = []
+        bwa_rows: List[int] = []
+        bwr_rows: List[int] = []
+        affected: Set[str] = set()
+        reserved_changed = False
+        for row, node in patches:
+            old = nodes[row]
+            nodes[row] = node
+            o_vals = _node_row_vals(old)
+            n_vals = _node_row_vals(node)
+            if n_vals != o_vals:
+                rows.append(row)
+                tot_rows.append(n_vals[0])
+                res_rows.append(n_vals[1])
+                bwa_rows.append(n_vals[2])
+                bwr_rows.append(n_vals[3])
+                if n_vals[1] != o_vals[1] or n_vals[3] != o_vals[3]:
+                    reserved_changed = True
+            _surface_targets(old, node, affected)
+        for (_pos, node), row in zip(appends, range(self.n, new_n)):
+            nodes.append(node)
+            n_vals = _node_row_vals(node)
+            rows.append(row)
+            tot_rows.append(n_vals[0])
+            res_rows.append(n_vals[1])
+            bwa_rows.append(n_vals[2])
+            bwr_rows.append(n_vals[3])
+            if any(n_vals[1]) or n_vals[3]:
+                reserved_changed = True
+
+        new = NodeMirror.__new__(NodeMirror)
+        new.nodes = nodes
+        new.n = new_n
+        new.padded = self.padded
+        new._usage_lock = threading.Lock()
+        if appends:
+            idx = dict(self.index)
+            for (_pos, node), row in zip(appends, range(self.n, new_n)):
+                idx[node.id] = row
+            new.index = idx
+            mask = self.base_mask.copy()
+            mask[self.n:new_n] = True
+            new.base_mask = mask
+            new._id_array = None
+        else:
+            new.index = self.index
+            new.base_mask = self.base_mask
+            new._id_array = self._id_array
+
+        if rows:
+            rows_arr = np.asarray(rows, dtype=np.int32)
+            tot_arr = np.asarray(tot_rows, dtype=np.int32)
+            res_arr = np.asarray(res_rows, dtype=np.int32)
+            sched_arr = (tot_arr - res_arr)[:, :2].astype(np.float32)
+            bwa_arr = np.asarray(bwa_rows, dtype=np.int32)
+            bwr_arr = np.asarray(bwr_rows, dtype=np.int32)
+            reserved_np = self.reserved_np.copy()
+            reserved_np[rows_arr] = res_arr
+            new.reserved_np = reserved_np
+            bw_reserved = self.bw_reserved.copy()
+            bw_reserved[rows_arr] = bwr_arr
+            new.bw_reserved = bw_reserved
+            # Row-sliced device update: only the dirty rows travel the
+            # wire; the padded (sharded) buffers update functionally on
+            # device instead of a fresh put_node_sharded of everything.
+            p_rows, p_tot, p_sched, p_bwa = _pad_rows(
+                rows_arr, tot_arr, sched_arr, bwa_arr
+            )
+            new.total, new.sched_cap, new.bw_avail = _rows_update(
+                self.total, self.sched_cap, self.bw_avail,
+                p_rows, p_tot, p_sched, p_bwa,
+            )
+        else:
+            new.reserved_np = self.reserved_np
+            new.bw_reserved = self.bw_reserved
+            new.total = self.total
+            new.sched_cap = self.sched_cap
+            new.bw_avail = self.bw_avail
+
+        if appends:
+            # Cached masks/columns are length-n views of the old node
+            # axis; appends rebuild them lazily.
+            new._driver_mask_cache = {}
+            new._constraint_mask_cache = {}
+            new._target_col_cache = {}
+            new._target_code_cache = {}
+            new._device_mask_cache = {}
+        elif affected:
+            # Targeted invalidation: only columns/masks reading a changed
+            # target drop; everything else survives the roll.
+            def _ctuple_clean(cs) -> bool:
+                return not any(
+                    c[0] in affected or c[2] in affected for c in cs
+                )
+
+            new._target_col_cache = {
+                t: v for t, v in self._target_col_cache.items()
+                if t not in affected
+            }
+            new._target_code_cache = {
+                t: v for t, v in self._target_code_cache.items()
+                if t not in affected
+            }
+            new._driver_mask_cache = {
+                k: v for k, v in self._driver_mask_cache.items()
+                if not any(f"$attr.driver.{d}" in affected for d in k)
+            }
+            new._constraint_mask_cache = {
+                k: v for k, v in self._constraint_mask_cache.items()
+                if _ctuple_clean(k)
+            }
+            new._device_mask_cache = {
+                k: v for k, v in self._device_mask_cache.items()
+                if not any(f"$attr.driver.{d}" in affected for d in k[0])
+                and _ctuple_clean(k[1]) and _ctuple_clean(k[2])
+            }
+        else:
+            # Surface untouched: SHARE the cache dicts — both mirrors
+            # describe the same mask world and lazy additions are valid
+            # for either.
+            new._driver_mask_cache = self._driver_mask_cache
+            new._constraint_mask_cache = self._constraint_mask_cache
+            new._target_col_cache = self._target_col_cache
+            new._target_code_cache = self._target_code_cache
+            new._device_mask_cache = self._device_mask_cache
+
+        if self._clean_usage_dev is None:
+            new._clean_usage_dev = None
+        elif reserved_changed:
+            used_dev, z1, z2, bw_dev = self._clean_usage_dev
+            p_rows, p_res, p_bwr = _pad_rows(rows_arr, res_arr, bwr_arr)
+            u_dev, b_dev = _usage_rows_update(
+                used_dev, bw_dev, p_rows, p_res, p_bwr
+            )
+            new._clean_usage_dev = (u_dev, z1, z2, b_dev)
+        else:
+            new._clean_usage_dev = self._clean_usage_dev
+
+        # Node writes never move the allocs index, so the cached base
+        # usage survives modulo the reserved deltas of the patched rows.
+        base = self._base_usage
+        if base is None or appends:
+            new._base_usage = None
+        elif reserved_changed:
+            uid, aidx, b_used, b_bw = base
+            b_used = b_used.copy()
+            b_bw = b_bw.copy()
+            b_used[rows_arr] += res_arr - self.reserved_np[rows_arr]
+            b_bw[rows_arr] += bwr_arr - self.bw_reserved[rows_arr]
+            new._base_usage = (uid, aidx, b_used, b_bw)
+        else:
+            new._base_usage = base
+        return new, len(rows)
 
     def id_array(self) -> np.ndarray:
         """Node ids as a numpy string array (lazy, cached): fancy-indexed
@@ -330,11 +613,202 @@ class NodeMirror:
     def build_usage(self, ctx, job_id: str, tg_name: str):
         """Build (used, job_count, tg_count, bw_used) from the eval context's
         optimistic proposed-alloc view (reference: context.go:103-126 feeding
-        rank.go:170-221)."""
+        rank.go:170-221).
+
+        Delta-maintained: the job-independent base (reserved + every
+        existing allocation, object rows and columnar blocks alike) is
+        cached per mirror and rolled forward through the store's alloc
+        change log; each eval then copies the base and touches ONLY the
+        plan's in-flight rows plus the job's own allocations — never the
+        whole cluster. States without the split columnar/change-log
+        surface take the original full walk (``_build_usage_walk``)."""
         plan = ctx.plan
-        if (ctx.state.alloc_count() == 0 and not plan.alloc_batches
+        state = ctx.state
+        if (state.alloc_count() == 0 and not plan.alloc_batches
                 and not plan.node_allocation and not plan.node_update):
             return self.clean_usage()
+        if not (hasattr(state, "allocs_objects")
+                and hasattr(state, "alloc_blocks")
+                and hasattr(state, "allocs_by_job_objects")
+                and hasattr(state, "alloc_object_by_id")
+                and hasattr(state, "job_alloc_blocks")):
+            return self._build_usage_walk(ctx, job_id, tg_name)
+        base_used, base_bw = self._base_usage_for(state)
+        used = base_used.copy()
+        bw_used = base_bw.copy()
+        job_count = np.zeros(self.padded, dtype=np.int32)
+        tg_count = np.zeros(self.padded, dtype=np.int32)
+        index_get = self.index.get
+        # Job/tg occupancy from the job's OWN allocations (by-job
+        # indexes: O(job size), not O(cluster)).
+        for a in state.allocs_by_job_objects(job_id):
+            if a.terminal_status():
+                continue
+            i = index_get(a.node_id)
+            if i is None:
+                continue
+            job_count[i] += 1
+            if a.task_group == tg_name:
+                tg_count[i] += 1
+        for blk in state.job_alloc_blocks(job_id):
+            tg_match = blk.tg_name == tg_name
+            for nid, cnt in blk.live_node_counts():
+                i = index_get(nid)
+                if i is None:
+                    continue
+                job_count[i] += cnt
+                if tg_match:
+                    tg_count[i] += cnt
+        # Plan deltas: only the in-flight rows. Members this plan evicts
+        # were counted in the base, so subtract them; stale eviction ids
+        # (member already gone) subtract nothing.
+        blocks = None
+        obj_by_id = state.alloc_object_by_id
+        for nid, evs in plan.node_update.items():
+            i = index_get(nid)
+            if i is None:
+                continue
+            for a in evs:
+                row = obj_by_id(a.id)
+                if row is not None:
+                    if row.terminal_status() or row.node_id != nid:
+                        continue  # never counted in the base at this row
+                    used[i] -= _res_vec(row.resources)
+                    bw_used[i] -= _task_bw(row.task_resources)
+                    if row.job_id == job_id:
+                        job_count[i] -= 1
+                        if row.task_group == tg_name:
+                            tg_count[i] -= 1
+                    continue
+                if blocks is None:
+                    blocks = state.alloc_blocks()
+                for blk in blocks:
+                    if blk.find(a.id) is not None:
+                        used[i] -= _res_vec(a.resources)
+                        bw_used[i] -= _task_bw(a.task_resources)
+                        if a.job_id == job_id:
+                            job_count[i] -= 1
+                            if a.task_group == tg_name:
+                                tg_count[i] -= 1
+                        break
+        for nid, adds in plan.node_allocation.items():
+            i = index_get(nid)
+            if i is None:
+                continue
+            for a in adds:
+                used[i] += _res_vec(a.resources)
+                bw_used[i] += _task_bw(a.task_resources)
+                if a.job_id == job_id:
+                    job_count[i] += 1
+                    if a.task_group == tg_name:
+                        tg_count[i] += 1
+        self._plan_batch_usage(plan, job_id, tg_name, used, job_count,
+                               tg_count)
+        return (
+            put_node_sharded(used, 1),
+            put_node_sharded(job_count),
+            put_node_sharded(tg_count),
+            put_node_sharded(bw_used),
+        )
+
+    def _base_usage_for(self, state) -> Tuple[np.ndarray, np.ndarray]:
+        """The cached job-independent (used, bw_used) base for ``state``'s
+        alloc generation: reserved + every existing allocation. On a
+        generation mismatch the base rolls forward through the store's
+        alloc change log (recomputing only the dirty rows); a dirty set
+        past the log horizon — or large enough that per-row python beats
+        nothing — falls back to one full recompute. Returned arrays are
+        shared and must be copied before mutation."""
+        uid = getattr(state, "store_uid", "")
+        aidx = state.get_index("allocs")
+        if not uid or getattr(state, "optimistic", False):
+            # Anonymous states and optimistically-mutated snapshots name
+            # content the shared change logs don't describe: never roll
+            # from them, never cache them.
+            return self._compute_base_usage(state)
+        with self._usage_lock:
+            cached = self._base_usage
+        if cached is not None and cached[0] == uid and cached[1] == aidx:
+            return cached[2], cached[3]
+        used = bw = None
+        if (cached is not None and cached[0] == uid and aidx > cached[1]
+                and hasattr(state, "alloc_node_changes_since")):
+            dirty = state.alloc_node_changes_since(cached[1])
+            if dirty is not None and len(dirty) <= max(64, self.n // 8):
+                if dirty:
+                    used = cached[2].copy()
+                    bw = cached[3].copy()
+                    blocks = state.alloc_blocks()
+                    index_get = self.index.get
+                    for nid in dirty:
+                        i = index_get(nid)
+                        if i is None:
+                            continue
+                        used[i], bw[i] = self._usage_row(
+                            state, nid, i, blocks
+                        )
+                    telemetry.incr_counter(("mirror", "usage_rolls"))
+                else:
+                    used, bw = cached[2], cached[3]
+        if used is None:
+            used, bw = self._compute_base_usage(state)
+            telemetry.incr_counter(("mirror", "usage_rebuilds"))
+        with self._usage_lock:
+            prev = self._base_usage
+            if prev is None or prev[0] != uid or prev[1] <= aidx:
+                self._base_usage = (uid, aidx, used, bw)
+        return used, bw
+
+    def _usage_row(self, state, node_id: str, row: int, blocks):
+        """One node's (used4, bw_used) recomputed from scratch: reserved
+        base + its object rows + its runs in every live block — the roll
+        forward's per-dirty-row unit."""
+        used = self.reserved_np[row].copy()
+        bw = int(self.bw_reserved[row])
+        for a in state.allocs_by_node_objects(node_id):
+            if a.terminal_status():
+                continue
+            used += _res_vec(a.resources)
+            bw += _task_bw(a.task_resources)
+        for blk in blocks:
+            cnt = blk.live_counts_map().get(node_id, 0)
+            if cnt <= 0:
+                continue
+            used += _res_vec(blk.resources) * cnt
+            bw += _task_bw(blk.task_resources) * cnt
+        return used, bw
+
+    def _compute_base_usage(self, state) -> Tuple[np.ndarray, np.ndarray]:
+        """Full base recompute: reserved + all object rows + all block
+        runs. The delta path's fallback (and first fill)."""
+        used = self.reserved_np.copy()
+        bw = self.bw_reserved.copy()
+        index_get = self.index.get
+        for a in state.allocs_objects():
+            if a.terminal_status():
+                continue
+            i = index_get(a.node_id)
+            if i is None:
+                continue
+            used[i] += _res_vec(a.resources)
+            bw[i] += _task_bw(a.task_resources)
+        for blk in state.alloc_blocks():
+            vec = _res_vec(blk.resources)
+            tbw = _task_bw(blk.task_resources)
+            for nid, cnt in blk.live_node_counts():
+                i = index_get(nid)
+                if i is None:
+                    continue
+                used[i] += vec * cnt
+                if tbw:
+                    bw[i] += tbw * cnt
+        return used, bw
+
+    def _build_usage_walk(self, ctx, job_id: str, tg_name: str):
+        """The original full proposed-alloc walk, kept for states without
+        the columnar/change-log surface (and as the fuzz differential's
+        reference implementation for the delta path above)."""
+        plan = ctx.plan
         used = self.reserved_np.copy()
         bw_used = self.bw_reserved.copy()
         job_count = np.zeros(self.padded, dtype=np.int32)
@@ -405,9 +879,26 @@ class NodeMirror:
                         job_count[i] -= 1
                         if a.task_group == tg_name:
                             tg_count[i] -= 1
-        # Columnar placements from earlier task groups of this plan
-        # (AllocBatch bypasses proposed_allocs' per-object view).
-        for b in ctx.plan.alloc_batches:
+        self._plan_batch_usage(ctx.plan, job_id, tg_name, used, job_count,
+                               tg_count)
+        return (
+            put_node_sharded(used, 1),
+            put_node_sharded(job_count),
+            put_node_sharded(tg_count),
+            put_node_sharded(bw_used),
+        )
+
+    def _plan_batch_usage(self, plan, job_id: str, tg_name: str,
+                          used, job_count, tg_count) -> None:
+        """Columnar plan contributions, shared by the delta path and the
+        full walk so the two can never drift.
+
+        Placements from earlier task groups of this plan (AllocBatch
+        bypasses proposed_allocs' per-object view) add whole runs; in-place
+        update batches contribute their (new - old) resource delta — the
+        existing allocs were already counted at their old size.
+        Identity-counted per (node, old resources)."""
+        for b in plan.alloc_batches:
             vec = np.asarray(b.resource_vector(), dtype=np.int32)
             b_job = b.job.id if b.job is not None else ""
             for nid, cnt in zip(b.node_ids, b.node_counts):
@@ -419,10 +910,7 @@ class NodeMirror:
                     job_count[i] += cnt
                     if b.tg_name == tg_name:
                         tg_count[i] += cnt
-        # Columnar in-place updates contribute their (new - old) resource
-        # delta — the existing allocs were already counted at their old
-        # size above. Identity-counted per (node, old resources).
-        for b in ctx.plan.update_batches:
+        for b in plan.update_batches:
             new_vec = np.asarray(b.resource_vector(), dtype=np.int64)
             if b.src_node_ids:
                 # Block-columnar form: one shared old vector, node runs as
@@ -460,12 +948,6 @@ class NodeMirror:
                 delta = (new_vec - vecs[rid]) * cnt
                 if delta.any():
                     used[i] += delta.astype(np.int32)
-        return (
-            put_node_sharded(used, 1),
-            put_node_sharded(job_count),
-            put_node_sharded(tg_count),
-            put_node_sharded(bw_used),
-        )
 
 
 class MirrorCache:
@@ -475,23 +957,37 @@ class MirrorCache:
     generation". A snapshot's (store_uid, nodes-table index) names one
     immutable node set; all evals scheduled against it (across workers and
     retries) share a single NodeMirror — node tensors stay resident on the
-    device and host-side driver/constraint masks stay warm. Any node write
-    bumps the table index and naturally invalidates.
-    """
+    device and host-side driver/constraint masks stay warm.
+
+    Node writes bump the table index; instead of rebuilding, a key miss
+    ROLLS the newest resident mirror of the same (store, dc-set) lineage
+    forward through the store's node change log (NodeMirror.apply_delta):
+    only the dirty rows re-stage to device and only the affected mask
+    columns invalidate. Full rebuild remains for the cases a delta cannot
+    express — log horizon exceeded, a node leaving the ready set (row
+    shift), or appends crossing the padding bucket — and is counted so
+    the steady state ("delta rolls dominate") is observable."""
 
     def __init__(self, capacity: int = 8):
         import collections
-        import threading
 
         self.capacity = capacity
         self._lock = threading.Lock()
         self._entries = collections.OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.delta_rolls = 0
+        self.full_rebuilds = 0
+        self.rows_restaged = 0
 
     def get(self, state, datacenters: List[str]):
         """Return (nodes, mirror) for the ready nodes of ``state`` in
-        ``datacenters``; builds and caches on miss."""
+        ``datacenters``; rolls a resident ancestor forward on a key miss,
+        builds fresh only when no delta path exists.
+
+        ``misses`` counts every key miss; a miss is then served by either
+        a delta roll or a full rebuild (misses == delta_rolls +
+        full_rebuilds), so hits/(hits+misses) stays an honest hit ratio."""
         from nomad_tpu.scheduler.util import ready_nodes_in_dcs
 
         uid = getattr(state, "store_uid", "")
@@ -503,24 +999,93 @@ class MirrorCache:
                     self._entries.move_to_end(key)
                     self.hits += 1
                     return entry
+                ancestor = self._newest_ancestor(key)
+            entry = self._roll_forward(key, ancestor, state, datacenters)
+            if entry is not None:
+                return entry
         nodes = ready_nodes_in_dcs(state, datacenters)
         mirror = NodeMirror(nodes)
         if uid:
             with self._lock:
                 self.misses += 1
+                self.full_rebuilds += 1
                 self._entries[key] = (nodes, mirror)
                 while len(self._entries) > self.capacity:
                     self._entries.popitem(last=False)
+            telemetry.incr_counter(("mirror", "full_rebuilds"))
         return nodes, mirror
 
+    def _newest_ancestor(self, key):
+        """Lock held: the resident (key, mirror) of this (store, dc-set)
+        lineage with the highest node generation below ``key``'s."""
+        uid, nodes_index, dcs_key = key
+        best = None
+        for k in self._entries:
+            if (k[0] == uid and k[2] == dcs_key and k[1] < nodes_index
+                    and (best is None or k[1] > best[1])):
+                best = k
+        if best is None:
+            return None
+        return best, self._entries[best][1]
+
+    def _roll_forward(self, key, ancestor, state, datacenters: List[str]):
+        """Delta-roll ``ancestor`` up to ``state``'s node generation and
+        register it under ``key``; None means the caller must fully
+        rebuild. Runs OUTSIDE the cache lock — the roll dispatches device
+        work (and a first roll per bucket compiles), which must not stall
+        unrelated cache hits; a racing duplicate roll is just wasted work,
+        resolved by the insert-time re-check."""
+        if ancestor is None:
+            return None
+        changes_fn = getattr(state, "node_changes_since", None)
+        if changes_fn is None:
+            return None
+        best, mirror = ancestor
+        changes = changes_fn(best[1])
+        if changes is None:
+            return None  # log horizon exceeded
+        out = mirror.apply_delta(changes, state, datacenters)
+        if out is None:
+            return None  # membership forces repadding/reordering
+        new_mirror, restaged = out
+        entry = (new_mirror.nodes, new_mirror)
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                # Another thread served this key while we rolled: keep
+                # the resident entry (its mask caches may already be
+                # warmer) and drop ours.
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return existing
+            # The ancestor stays resident at its current LRU position:
+            # batched workers hold snapshots at interleaved node
+            # generations, and evicting it here would force a full
+            # rebuild for any eval still scheduled against the older
+            # one. It ages out once nothing hits it.
+            self.misses += 1
+            self.delta_rolls += 1
+            self.rows_restaged += restaged
+            self._entries[key] = entry
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+        telemetry.incr_counter(("mirror", "delta_rolls"))
+        if restaged:
+            telemetry.incr_counter(("mirror", "rows_restaged"), restaged)
+        return entry
+
     def stats(self) -> dict:
-        """Debug-surface snapshot: residency + hit ratio."""
+        """Debug-surface snapshot: residency, hit ratio, and the delta
+        economy (rolls vs full rebuilds, rows re-staged)."""
         with self._lock:
             return {
                 "entries": len(self._entries),
                 "capacity": self.capacity,
                 "hits": self.hits,
                 "misses": self.misses,
+                "delta_rolls": self.delta_rolls,
+                "full_rebuilds": self.full_rebuilds,
+                "rows_restaged": self.rows_restaged,
                 "node_buckets": sorted({
                     m.padded for _n, m in self._entries.values()
                 }),

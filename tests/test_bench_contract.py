@@ -44,8 +44,10 @@ def _run_bench(extra_env):
 
 def test_fallback_measurement_inside_parsed_json():
     proc, payload = _run_bench({})
-    # Failure rc: the bench did not do its TPU job...
-    assert proc.returncode == 1
+    # Success rc: a valid cpu-fallback artifact was banked — rc must
+    # read "no device", not "bench broken" (the device error stays
+    # recorded in the JSON for the driver to distinguish).
+    assert proc.returncode == 0
     assert "error" in payload
     # ...but the parsed artifact still carries the real measurement.
     assert payload["backend"] == "cpu-fallback"
@@ -61,10 +63,26 @@ def test_fallback_measurement_inside_parsed_json():
     _check_breakdown(fb["breakdown"])
     # The BASELINE configs ride the fallback line too: a dead relay must
     # not cost the round its config2/4/5 comparables.
-    for name in ("config2", "config4", "config5"):
+    for name in ("config2", "config4", "config5", "staging_delta"):
         assert name in fb, f"fallback payload missing {name}"
         assert "error" not in fb[name], fb[name]
     _check_config5(fb["config5"])
+    _check_staging_delta(fb["staging_delta"])
+
+
+def _check_staging_delta(sweep):
+    """The delta arm must show the roll path actually engaging: every
+    measured single-node write rode a delta roll (not a rebuild of the
+    warm cache), and both staging figures are real. The >=5x speedup bar
+    is a full-scale (10k-node) acceptance judged from banked artifacts,
+    not at smoke scale where fixed overheads dominate."""
+    assert isinstance(sweep, list) and sweep, sweep
+    for row in sweep:
+        assert row["delta_staging_ms_p50"] > 0
+        assert row["full_staging_ms_p50"] > 0
+        assert row["speedup"] > 0
+        assert row["delta_rolls"] >= row["runs"]
+        assert row["rows_restaged"] >= row["runs"]
 
 
 def _check_config5(c5):
@@ -101,3 +119,4 @@ def test_allow_cpu_smoke_run_succeeds():
     assert "error" not in payload
     _check_breakdown(payload["breakdown"])
     _check_config5(payload["config5"])
+    _check_staging_delta(payload["staging_delta"])

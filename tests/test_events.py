@@ -337,6 +337,8 @@ def test_debug_bundle_schema_and_redaction(client, agent):
     assert any("http" in name or "MainThread" in name
                for name in bundle["threads"]), bundle["threads"].keys()
     assert bundle["breaker"]["state"] in ("closed", "half_open", "open")
+    assert "delta_rolls" in bundle["mirror"], bundle["mirror"]
+    assert "full_rebuilds" in bundle["mirror"]
     assert "sites" in bundle["faults"]
     assert "intervals" in bundle["metrics"]
     assert "cumulative" in bundle["metrics"]

@@ -982,3 +982,450 @@ def test_burst_mix_matches_serial(seed):
     serial = run_mode(1)
     assert burst == serial, (seed, burst, serial)
     assert sum(burst.values()) == expected, (seed, burst, expected)
+
+
+# ---------------------------------------------------------------------------
+# 5. Delta-rolled device mirror ≡ fresh build (bit-identical)
+#
+# MirrorCache no longer rebuilds the whole NodeMirror on every node write:
+# it rolls the resident mirror forward through the state store's node
+# change log (NodeMirror.apply_delta), patching only dirty rows and
+# invalidating only affected mask columns. The contract is BIT-IDENTITY:
+# after any seeded sequence of upserts/removals/drain flips — including
+# the repadding boundary and the log-horizon fallback — the rolled mirror
+# must equal a mirror freshly built from the same snapshot, array for
+# array, mask for mask, id for id.
+
+N_MIRROR_SEEDS = int(os.environ.get("NOMAD_TPU_FUZZ_SEEDS", 60)) // 2
+
+
+def _mirror_rand_node(rng, i):
+    from nomad_tpu.structs import NODE_STATUS_INIT, NODE_STATUS_READY
+
+    res = Resources(
+        cpu=int(rng.integers(500, 8000)),
+        memory_mb=int(rng.integers(256, 16384)),
+        disk_mb=int(rng.integers(1024, 100_000)),
+        iops=int(rng.integers(10, 300)),
+    )
+    if rng.random() < 0.3:
+        res.networks = [NetworkResource(
+            device="eth0", cidr="10.0.0.0/8", ip=f"10.0.{i % 250}.1",
+            mbits=int(rng.integers(100, 2000)),
+        )]
+    node = Node(
+        id=f"fz-{i:04d}",
+        datacenter=str(rng.choice(["dc1", "dc2", "dc3"])),
+        name=f"fz-{i}",
+        attributes={
+            "kernel.name": "linux",
+            "driver.exec": str(rng.choice(["1", "0"])),
+            "rack": f"r{int(rng.integers(0, 4))}",
+        },
+        meta={"tier": str(rng.choice(["a", "b"]))},
+        status=str(rng.choice(
+            [NODE_STATUS_READY] * 4 + [NODE_STATUS_INIT])),
+        drain=bool(rng.random() < 0.08),
+        resources=res,
+    )
+    if rng.random() < 0.2:
+        node.reserved = Resources(
+            cpu=int(rng.integers(0, 200)),
+            memory_mb=int(rng.integers(0, 256)),
+        )
+    return node
+
+
+_MIRROR_FUZZ_CONSTRAINTS = [
+    Constraint(l_target="$attr.kernel.name", r_target="linux", operand="="),
+    Constraint(l_target="$attr.rack", r_target="r1", operand="!="),
+    Constraint(l_target="$meta.tier", r_target="a", operand="="),
+    Constraint(l_target="$node.datacenter", r_target="dc1", operand="="),
+]
+
+
+def _assert_mirror_bit_identical(rolled, fresh, where):
+    """Every array + mask + id order must match a fresh build exactly."""
+    assert rolled.n == fresh.n, where
+    assert rolled.padded == fresh.padded, where
+    assert [n.id for n in rolled.nodes] == [n.id for n in fresh.nodes], where
+    for attr in ("reserved_np", "bw_reserved", "base_mask"):
+        np.testing.assert_array_equal(
+            getattr(rolled, attr), getattr(fresh, attr),
+            err_msg=f"{where}: {attr}")
+    for attr in ("total", "sched_cap", "bw_avail"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rolled, attr)),
+            np.asarray(getattr(fresh, attr)),
+            err_msg=f"{where}: {attr}")
+    np.testing.assert_array_equal(
+        rolled.id_array(), fresh.id_array(), err_msg=f"{where}: ids")
+    np.testing.assert_array_equal(
+        rolled.driver_mask({"exec"}), fresh.driver_mask({"exec"}),
+        err_msg=f"{where}: driver_mask")
+    for c in _MIRROR_FUZZ_CONSTRAINTS:
+        np.testing.assert_array_equal(
+            rolled.constraint_mask(None, [c]),
+            fresh.constraint_mask(None, [c]),
+            err_msg=f"{where}: constraint {c.l_target} {c.operand}")
+    got_dev, got_n = rolled.device_mask(
+        None, {"exec"}, None, _MIRROR_FUZZ_CONSTRAINTS[:2])
+    want_dev, want_n = fresh.device_mask(
+        None, {"exec"}, None, _MIRROR_FUZZ_CONSTRAINTS[:2])
+    assert got_n == want_n, where
+    np.testing.assert_array_equal(
+        np.asarray(got_dev), np.asarray(want_dev),
+        err_msg=f"{where}: device_mask")
+    for got, want, name in zip(rolled.clean_usage(), fresh.clean_usage(),
+                               ("used", "job", "tg", "bw")):
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"{where}: clean_usage {name}")
+
+
+def _mirror_mutate(rng, store, idx, next_id):
+    """One random node-table write against the live store. Returns
+    (next index, next fresh id)."""
+    from nomad_tpu import structs as st
+
+    ids = [n.id for n in store.nodes()]
+    op = rng.random()
+    idx += 1
+    if not ids or op < 0.22:
+        store.upsert_node(idx, _mirror_rand_node(rng, next_id))
+        return idx, next_id + 1
+    nid = str(rng.choice(ids))
+    if op < 0.50:
+        # In-place rewrite: resource drift and/or mask-surface change.
+        node = store.node_by_id(nid).copy()
+        which = rng.random()
+        if which < 0.5:
+            node.resources = node.resources.copy()
+            node.resources.cpu = int(rng.integers(500, 8000))
+        elif which < 0.7:
+            node.attributes["rack"] = f"r{int(rng.integers(0, 4))}"
+        elif which < 0.85:
+            node.meta["tier"] = str(rng.choice(["a", "b"]))
+        else:
+            node.reserved = Resources(cpu=int(rng.integers(0, 300)))
+        store.upsert_node(idx, node)
+    elif op < 0.65:
+        store.update_node_drain(
+            idx, nid, not store.node_by_id(nid).drain)
+    elif op < 0.85:
+        store.update_node_status(idx, nid, str(rng.choice(
+            [st.NODE_STATUS_READY, st.NODE_STATUS_READY,
+             st.NODE_STATUS_DOWN, st.NODE_STATUS_INIT])))
+    else:
+        store.delete_node(idx, nid)
+    return idx, next_id
+
+
+@pytest.mark.parametrize("seed", range(N_MIRROR_SEEDS))
+def test_mirror_delta_roll_bit_identical(seed):
+    """Seeded churn (upserts, removals, drain/status flips, fresh
+    registrations) rolled through MirrorCache must yield a mirror
+    bit-identical to a fresh build at every checkpoint."""
+    from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.tpu.mirror import MirrorCache, NodeMirror
+
+    rng = np.random.default_rng(40_000 + seed)
+    store = StateStore()
+    idx = 0
+    next_id = 0
+    for _ in range(int(rng.integers(6, 70))):
+        idx += 1
+        store.upsert_node(idx, _mirror_rand_node(rng, next_id))
+        next_id += 1
+    dcs = ["dc1", "dc2"]
+    cache = MirrorCache()
+    _n, warm = cache.get(store.snapshot(), dcs)
+    # Populate the caches the roll must selectively invalidate.
+    warm.driver_mask({"exec"})
+    warm.device_mask(None, {"exec"}, None, _MIRROR_FUZZ_CONSTRAINTS[:2])
+    warm.clean_usage()
+    for step in range(int(rng.integers(3, 9))):
+        for _ in range(int(rng.integers(1, 5))):
+            idx, next_id = _mirror_mutate(rng, store, idx, next_id)
+        snap = store.snapshot()
+        _n, rolled = cache.get(snap, dcs)
+        fresh = NodeMirror(ready_nodes_in_dcs(snap, dcs))
+        _assert_mirror_bit_identical(
+            rolled, fresh, where=(seed, step, idx))
+    stats = cache.stats()
+    assert stats["delta_rolls"] + stats["full_rebuilds"] >= 1, (seed, stats)
+
+
+def test_mirror_delta_repadding_boundary():
+    """Appends inside the padding bucket roll; crossing the power-of-two
+    boundary forces (and correctly executes) a full rebuild."""
+    from nomad_tpu import structs as st
+    from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.tpu.mirror import MirrorCache, NodeMirror
+
+    def mk(i):
+        return Node(
+            id=f"pad-{i:03d}", datacenter="dc1", name=f"pad-{i}",
+            attributes={"kernel.name": "linux", "driver.exec": "1"},
+            resources=Resources(cpu=1000, memory_mb=1024),
+            status=st.NODE_STATUS_READY,
+        )
+
+    store = StateStore()
+    idx = 0
+    for i in range(63):
+        idx += 1
+        store.upsert_node(idx, mk(i))
+    cache = MirrorCache()
+    _n, m0 = cache.get(store.snapshot(), ["dc1"])
+    assert m0.padded == 64
+    # 63 -> 64: same bucket, append roll.
+    idx += 1
+    store.upsert_node(idx, mk(63))
+    snap = store.snapshot()
+    _n, m1 = cache.get(snap, ["dc1"])
+    _assert_mirror_bit_identical(
+        m1, NodeMirror(ready_nodes_in_dcs(snap, ["dc1"])), "64")
+    assert cache.stats()["delta_rolls"] == 1
+    assert cache.stats()["full_rebuilds"] == 1  # the initial build
+    # 64 -> 65: crosses to the 128 bucket, must fully rebuild.
+    idx += 1
+    store.upsert_node(idx, mk(64))
+    snap = store.snapshot()
+    _n, m2 = cache.get(snap, ["dc1"])
+    assert m2.padded == 128
+    _assert_mirror_bit_identical(
+        m2, NodeMirror(ready_nodes_in_dcs(snap, ["dc1"])), "65")
+    assert cache.stats()["delta_rolls"] == 1
+    assert cache.stats()["full_rebuilds"] == 2
+
+
+def test_mirror_delta_log_horizon_fallback(monkeypatch):
+    """Writes past the bounded change log's horizon make
+    node_changes_since return None and the cache fall back to one full
+    rebuild — never a wrong delta."""
+    from nomad_tpu import structs as st
+    from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.state import store as store_mod
+    from nomad_tpu.tpu.mirror import MirrorCache, NodeMirror
+
+    monkeypatch.setattr(store_mod, "NODE_LOG_HORIZON", 4)
+    store = StateStore()
+    idx = 0
+    for i in range(12):
+        idx += 1
+        store.upsert_node(idx, Node(
+            id=f"hz-{i:03d}", datacenter="dc1", name=f"hz-{i}",
+            attributes={"kernel.name": "linux"},
+            resources=Resources(cpu=1000, memory_mb=1024),
+            status=st.NODE_STATUS_READY,
+        ))
+    cache = MirrorCache()
+    _n, _m = cache.get(store.snapshot(), ["dc1"])
+    base_index = store.get_index("nodes")
+    # > 2 * horizon single-node writes: the log trims past base_index.
+    for i in range(10):
+        node = store.node_by_id(f"hz-{i % 12:03d}").copy()
+        node.resources = node.resources.copy()
+        node.resources.cpu += 1
+        idx += 1
+        store.upsert_node(idx, node)
+    snap = store.snapshot()
+    assert snap.node_changes_since(base_index) is None
+    _n, rolled = cache.get(snap, ["dc1"])
+    _assert_mirror_bit_identical(
+        rolled, NodeMirror(ready_nodes_in_dcs(snap, ["dc1"])), "horizon")
+    stats = cache.stats()
+    assert stats["delta_rolls"] == 0
+    assert stats["full_rebuilds"] == 2, stats
+
+
+# ---------------------------------------------------------------------------
+# 6. Delta-maintained usage tensors ≡ the full proposed-alloc walk
+#
+# build_usage now copies a cached, change-log-rolled base and touches only
+# the plan's in-flight rows; _build_usage_walk is the original O(cluster)
+# reference implementation. They must agree exactly — across alloc-table
+# generations (object rows, columnar blocks, evictions) and arbitrary
+# plans (placements, evictions of object rows, block members, stale ids).
+
+
+def _usage_quad(out):
+    return [np.asarray(x) for x in out]
+
+
+@pytest.mark.parametrize("seed", range(N_MIRROR_SEEDS))
+def test_usage_delta_matches_full_walk(seed):
+    from nomad_tpu import structs as st
+    from nomad_tpu.scheduler.context import EvalContext
+    from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import AllocBatch, Allocation, Plan
+    from nomad_tpu.tpu.mirror import MirrorCache, NodeMirror
+
+    rng = np.random.default_rng(50_000 + seed)
+    store = StateStore()
+    idx = 0
+    n0 = int(rng.integers(8, 40))
+    for i in range(n0):
+        idx += 1
+        store.upsert_node(idx, _mirror_rand_node(rng, i))
+    dcs = ["dc1", "dc2"]
+    cache = MirrorCache()
+    cache.get(store.snapshot(), dcs)
+
+    job = Job(
+        region="global", id=f"uj-{seed}", name=f"uj-{seed}",
+        type=structs.JOB_TYPE_SERVICE, priority=50, datacenters=dcs,
+        task_groups=[TaskGroup(
+            name="web", count=64,
+            tasks=[Task(name="t", driver="exec",
+                        resources=Resources(cpu=50, memory_mb=64))],
+        )],
+    )
+    other_job = Job(
+        region="global", id=f"uo-{seed}", name=f"uo-{seed}",
+        type=structs.JOB_TYPE_SERVICE, priority=50, datacenters=dcs,
+        task_groups=job.task_groups,
+    )
+
+    def rand_alloc(nid, j, serial, status=st.ALLOC_DESIRED_STATUS_RUN):
+        return Allocation(
+            id=generate_uuid(), eval_id=generate_uuid(),
+            name=f"{j.name}.web[{serial}]", node_id=nid, job_id=j.id,
+            job=j, task_group="web",
+            resources=Resources(cpu=int(rng.integers(10, 200)),
+                                memory_mb=int(rng.integers(16, 256))),
+            desired_status=status,
+        )
+
+    object_allocs = []
+    blocks_batches = []
+    for generation in range(int(rng.integers(2, 5))):
+        ids = [n.id for n in store.nodes()]
+        # Alloc-table churn: object rows (some terminal), plus a columnar
+        # block for a random job.
+        new_allocs = []
+        for s in range(int(rng.integers(1, 6))):
+            j = job if rng.random() < 0.6 else other_job
+            status = (st.ALLOC_DESIRED_STATUS_RUN
+                      if rng.random() < 0.8
+                      else st.ALLOC_DESIRED_STATUS_STOP)
+            new_allocs.append(
+                rand_alloc(str(rng.choice(ids)), j, s, status))
+        idx += 1
+        store.upsert_allocs(idx, new_allocs)
+        object_allocs.extend(new_allocs)
+        if rng.random() < 0.6:
+            j = job if rng.random() < 0.5 else other_job
+            picks = [str(rng.choice(ids))
+                     for _ in range(int(rng.integers(1, 4)))]
+            counts = [int(rng.integers(1, 5)) for _ in picks]
+            batch = AllocBatch(
+                eval_id=generate_uuid(), job=j, tg_name="web",
+                resources=Resources(cpu=20, memory_mb=32),
+                task_resources={"t": Resources(cpu=20, memory_mb=32)},
+                metrics=None,
+                node_ids=picks,
+                node_counts=counts,
+                name_idx=np.arange(sum(counts)),
+                ids_seed=int(rng.integers(1, 2**63)),
+            )
+            idx += 1
+            store.upsert_alloc_blocks(idx, [batch])
+            blocks_batches.append(batch)
+        # Cross-node supersede: restamp a live block member onto a
+        # DIFFERENT node via an object-row upsert — the member's OLD
+        # node silently loses its block usage, and the alloc log must
+        # dirty both ends or the rolled base over-counts it.
+        if rng.random() < 0.5:
+            for blk in store.alloc_blocks():
+                if blk.n_live:
+                    pos = blk.live_positions()[0]
+                    member = blk.materialize_pos(pos)
+                    member.node_id = str(rng.choice(ids))
+                    member.resources = Resources(
+                        cpu=int(rng.integers(10, 100)), memory_mb=32)
+                    idx += 1
+                    store.upsert_allocs(idx, [member])
+                    object_allocs.append(member)
+                    break
+        # A couple of node writes too: the mirror must roll while the
+        # usage base rolls independently through the alloc log.
+        for _ in range(int(rng.integers(0, 3))):
+            idx, n0 = _mirror_mutate(rng, store, idx, n0 + 1000)
+
+        snap = store.snapshot()
+        _n, rolled = cache.get(snap, dcs)
+        fresh = NodeMirror(ready_nodes_in_dcs(snap, dcs))
+
+        # Random plan: placements + evictions (object rows, live block
+        # members, stale ids).
+        plan = Plan(eval_id=generate_uuid())
+        mirror_ids = [n.id for n in fresh.nodes]
+        if mirror_ids:
+            for s in range(int(rng.integers(0, 4))):
+                nid = str(rng.choice(mirror_ids))
+                plan.node_allocation.setdefault(nid, []).append(
+                    rand_alloc(nid, job, 100 + s))
+        live_objects = [a for a in object_allocs
+                        if store.alloc_object_by_id(a.id) is not None]
+        for a in (rng.choice(live_objects, size=min(2, len(live_objects)),
+                             replace=False) if live_objects else []):
+            plan.node_update.setdefault(a.node_id, []).append(a.copy())
+        for blk in snap.alloc_blocks():
+            if rng.random() < 0.4 and blk.n_live:
+                pos = blk.live_positions()[0]
+                member = blk.materialize_pos(pos)
+                plan.node_update.setdefault(
+                    member.node_id, []).append(member)
+                break
+        if mirror_ids and rng.random() < 0.5:
+            stale = rand_alloc(str(rng.choice(mirror_ids)), job, 999)
+            plan.node_update.setdefault(stale.node_id, []).append(stale)
+
+        ctx = EvalContext(snap, plan)
+        got = _usage_quad(rolled.build_usage(ctx, job.id, "web"))
+        want = _usage_quad(fresh._build_usage_walk(ctx, job.id, "web"))
+        for g, w, name in zip(got, want,
+                              ("used", "job_count", "tg_count", "bw_used")):
+            np.testing.assert_array_equal(
+                g, w, err_msg=f"seed {seed} gen {generation}: {name}")
+
+
+@pytest.mark.parametrize("seed", range(N_MIRROR_SEEDS))
+def test_node_table_delta_matches_fresh(seed):
+    """The plan applier's columnar node table rolls through the same
+    change log (plan_apply._NodeTable.apply_delta); a rolled table must
+    equal a fresh build — rows map, columns, liveness — across the same
+    churn the mirror fuzz applies."""
+    from nomad_tpu.server import plan_apply
+    from nomad_tpu.state import StateStore
+
+    rng = np.random.default_rng(60_000 + seed)
+    with plan_apply._NODE_TABLE_LOCK:
+        plan_apply._NODE_TABLE_CACHE = None
+    store = StateStore()
+    idx = 0
+    next_id = 0
+    for _ in range(int(rng.integers(5, 50))):
+        idx += 1
+        store.upsert_node(idx, _mirror_rand_node(rng, next_id))
+        next_id += 1
+    plan_apply._node_table(store.snapshot())
+    for step in range(int(rng.integers(3, 8))):
+        for _ in range(int(rng.integers(1, 4))):
+            idx, next_id = _mirror_mutate(rng, store, idx, next_id)
+        snap = store.snapshot()
+        rolled = plan_apply._node_table(snap)
+        fresh = plan_apply._NodeTable(snap)
+        where = (seed, step, idx)
+        assert rolled.n == fresh.n, where
+        assert rolled.rows == fresh.rows, where
+        for attr in ("totals", "reserved", "dead", "scalar_only"):
+            np.testing.assert_array_equal(
+                getattr(rolled, attr), getattr(fresh, attr),
+                err_msg=f"{where}: {attr}")

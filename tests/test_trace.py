@@ -474,6 +474,13 @@ def test_agent_metrics_endpoints(agent):
     assert any(k.endswith("broker.enqueue") for k in merged_counters)
     assert any(".fsm.apply." in k for k in merged_samples)
 
+    # Device-mirror cache stats ride the same endpoint (the delta-roll
+    # economy: rolls vs full rebuilds).
+    assert "mirror_cache" in doc
+    for k in ("hits", "misses", "delta_rolls", "full_rebuilds",
+              "rows_restaged"):
+        assert k in doc["mirror_cache"], doc["mirror_cache"]
+
     status, ctype, body = _get(agent, "/v1/agent/metrics?format=prometheus")
     assert status == 200
     assert ctype.startswith("text/plain")
@@ -481,3 +488,5 @@ def test_agent_metrics_endpoints(agent):
     assert "# TYPE " in text
     assert "broker_enqueue_total" in text
     assert "fsm_apply" in text
+    assert "nomad_mirror_cache_delta_rolls_total" in text
+    assert "nomad_mirror_cache_full_rebuilds_total" in text
