@@ -108,7 +108,10 @@ class AgentConfig:
             client_servers=list(fc.client.servers),
             client_state_dir=fc.client.state_dir,
             client_alloc_dir=fc.client.alloc_dir,
-            num_schedulers=fc.server.num_schedulers,
+            # The first-class knob wins over the legacy alias when both
+            # are set in the config files.
+            num_schedulers=(fc.server.scheduler_workers
+                            or fc.server.num_schedulers),
             enabled_schedulers=list(fc.server.enabled_schedulers),
             bootstrap_expect=fc.server.bootstrap_expect,
             enable_debug=fc.enable_debug,
@@ -196,7 +199,14 @@ class Agent:
         if self.config.event_buffer_size:
             server_config.event_buffer_size = self.config.event_buffer_size
         if self.config.num_schedulers:
+            # ServerConfig resolves + validates the worker count in
+            # __post_init__; a post-construction override must set the
+            # resolved field too (or start() would ignore it) and re-run
+            # the validator — the legacy spelling must not smuggle an
+            # out-of-range count past the [0, 128] check.
             server_config.num_schedulers = self.config.num_schedulers
+            server_config.scheduler_workers = self.config.num_schedulers
+            server_config.__post_init__()
         if self.config.enabled_schedulers:
             server_config.enabled_schedulers = list(
                 self.config.enabled_schedulers

@@ -64,13 +64,18 @@ class ClientBlock:
 
 @dataclass
 class ServerBlock:
-    """config.go ServerConfig block."""
+    """config.go ServerConfig block, extended with the optimistic
+    scheduling knob: ``scheduler_workers`` is the first-class spelling of
+    worker concurrency (N workers evaluate concurrently, the plan
+    pipeline resolves conflicts optimistically); ``num_schedulers`` is
+    the legacy alias. 0 = server default."""
 
     enabled: bool = False
     bootstrap_expect: int = 0
     data_dir: str = ""
     protocol_version: int = 0
     num_schedulers: int = 0
+    scheduler_workers: int = 0
     enabled_schedulers: List[str] = field(default_factory=list)
     start_join: List[str] = field(default_factory=list)
 
@@ -225,6 +230,9 @@ class FileConfig:
                 other.server.protocol_version or self.server.protocol_version
             ),
             num_schedulers=other.server.num_schedulers or self.server.num_schedulers,
+            scheduler_workers=(
+                other.server.scheduler_workers or self.server.scheduler_workers
+            ),
             enabled_schedulers=(
                 other.server.enabled_schedulers or self.server.enabled_schedulers
             ),
@@ -350,7 +358,18 @@ def _from_mapping(data: dict) -> FileConfig:
             for k, v in value.items():
                 if k in ("enabled_schedulers", "start_join"):
                     setattr(cfg.server, k, list(v))
-                elif k in ("bootstrap_expect", "protocol_version", "num_schedulers"):
+                elif k in ("scheduler_workers", "num_schedulers"):
+                    # Validated knob (both spellings): worker concurrency
+                    # is a capacity commitment — reject nonsense at parse
+                    # time instead of spawning a surprise at
+                    # leader-establish.
+                    n = int(v)
+                    if not 0 <= n <= 128:
+                        raise ValueError(
+                            f"server.{k} must be in [0, 128], got {n}"
+                        )
+                    setattr(cfg.server, k, n)
+                elif k in ("bootstrap_expect", "protocol_version"):
                     setattr(cfg.server, k, int(v))
                 else:
                     setattr(cfg.server, k, v)

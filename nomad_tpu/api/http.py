@@ -75,6 +75,35 @@ def _mirror_prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
+def _plan_pipeline_stats() -> Dict[str, Any]:
+    """Process-wide optimistic plan-pipeline totals (plan_pipeline.py):
+    batches/plans drained, commit vs conflict split, fused-vs-scalar
+    verification economy. Late import like the mirror stats."""
+    try:
+        from nomad_tpu.server.plan_pipeline import PIPELINE_TOTALS
+
+        return PIPELINE_TOTALS.stats()
+    except Exception as e:  # pragma: no cover - import-time breakage only
+        return {"error": str(e)}
+
+
+def _plan_pipeline_prometheus_text() -> str:
+    """Pipeline totals as Prometheus lines: everything monotonic is a
+    counter; max_batch_seen is a high-watermark gauge."""
+    stats = _plan_pipeline_stats()
+    if "error" in stats:
+        return ""
+    lines = []
+    for k in ("batches", "plans", "committed", "noops", "rejected",
+              "conflicts", "refreshes", "fused_plans", "scalar_plans"):
+        name = f"nomad_plan_pipeline_{k}_total"
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {stats[k]}")
+    lines.append("# TYPE nomad_plan_pipeline_max_batch gauge")
+    lines.append(f"nomad_plan_pipeline_max_batch {stats['max_batch_seen']}")
+    return "\n".join(lines) + "\n"
+
+
 class RawResponse:
     """Non-JSON handler result (e.g. Prometheus text exposition): the
     dispatcher writes the body verbatim with the given content type."""
@@ -571,11 +600,13 @@ class HTTPServer:
         if query.get("format") == "prometheus":
             return RawResponse(
                 (telemetry.prometheus_text(sink)
-                 + _mirror_prometheus_text()).encode(),
+                 + _mirror_prometheus_text()
+                 + _plan_pipeline_prometheus_text()).encode(),
                 "text/plain; version=0.0.4",
             ), None
         return {"timestamp": trace.now(), "intervals": sink.data(),
-                "mirror_cache": _mirror_cache_stats()}, None
+                "mirror_cache": _mirror_cache_stats(),
+                "plan_pipeline": _plan_pipeline_stats()}, None
 
     def agent_traces(self, req, query) -> Tuple[Any, Optional[int]]:
         """Summaries of the tracer's retained traces, newest first

@@ -19,6 +19,10 @@ everything the observability stack retains at the moment of capture —
 - ``mirror``      device-mirror cache stats (hits/misses, delta_rolls vs
                   full_rebuilds, rows_restaged) — whether the solver's
                   staging is riding the delta path or rebuilding
+- ``plan_pipeline``  optimistic plan-pipeline totals (batches/plans,
+                  committed vs conflicts, fused vs scalar verifies) —
+                  whether the apply path is batching and how contended
+                  the optimistic concurrency is
 - ``threads``     Python stacks of every live thread (sys._current_frames
                   — the goroutine-dump analog)
 
@@ -46,7 +50,7 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 # value is then None or an {"error": ...} stub, never absent).
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
-    "faults", "breaker", "mirror", "threads",
+    "faults", "breaker", "mirror", "plan_pipeline", "threads",
 )
 
 _SECRET_MARKERS = ("token", "secret", "password")
@@ -158,6 +162,12 @@ def _mirror_section() -> Dict[str, Any]:
     return GLOBAL_MIRROR_CACHE.stats()
 
 
+def _plan_pipeline_section() -> Dict[str, Any]:
+    from nomad_tpu.server.plan_pipeline import PIPELINE_TOTALS
+
+    return PIPELINE_TOTALS.stats()
+
+
 def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
     """Build the bundle. ``agent`` is a live nomad_tpu.agent.Agent for the
     full capture; None collects the process-local subset (metrics/faults/
@@ -174,6 +184,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "faults": None,
         "breaker": None,
         "mirror": None,
+        "plan_pipeline": None,
         "threads": None,
     }
     for section, build in (
@@ -183,6 +194,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("faults", lambda: faults.get_registry().snapshot()),
         ("breaker", _breaker_section),
         ("mirror", _mirror_section),
+        ("plan_pipeline", _plan_pipeline_section),
         ("threads", thread_stacks),
     ):
         # One wedged subsystem must not cost the whole flight recording.

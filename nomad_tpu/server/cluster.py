@@ -180,7 +180,7 @@ class ClusterServer(Server):
         self.plan_applier.start()
         from nomad_tpu.server.worker import Worker
 
-        for i in range(self.config.num_schedulers):
+        for i in range(self.config.scheduler_workers):
             worker = Worker(self, i)
             worker.start()
             self.workers.append(worker)

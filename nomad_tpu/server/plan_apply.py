@@ -1,21 +1,20 @@
-"""Plan applier: the single serialization point of the cluster.
+"""Plan verification: per-node feasibility of a submitted plan.
 
-Reference: /root/reference/nomad/plan_apply.go. Dequeues plans, verifies
-token + per-node feasibility against a state snapshot, commits the feasible
-subset through the FSM, and pipelines: verification of plan N+1 overlaps the
-(raft) apply of plan N via an optimistic snapshot.
+Reference: /root/reference/nomad/plan_apply.go (the verification half).
+``evaluate_plan`` determines the committable subset of one plan against a
+state snapshot — scalar per-node checks for small plans, the vectorized
+columnar ``_NodeTable`` path for large ones. The applier loop itself lives
+in plan_pipeline.py (the optimistic batch applier): it drains K plans at
+once and generalizes this module's verification to one fused K x nodes
+tensor pass, so the single-plan semantics here are the decision contract
+the batched verifier is fuzz-pinned against.
 """
 
 from __future__ import annotations
 
-import logging
 import threading
-import time
-from typing import Optional
 
-from nomad_tpu.server.eval_broker import BrokerError, EvalBroker
-from nomad_tpu import telemetry, trace
-from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
+from nomad_tpu import telemetry
 from nomad_tpu.structs import (
     Allocation,
     Plan,
@@ -1026,186 +1025,3 @@ def _object_allocs(result: PlanResult) -> list:
         allocs.extend(alloc_list)
     allocs.extend(result.failed_allocs)
     return allocs
-
-
-class PlanApplier(threading.Thread):
-    """Long-lived applier thread (plan_apply.go:39-117).
-
-    ``raft`` is anything with apply(msg_type, payload) -> Future[index] and
-    an ``applied_index`` property — the real replication layer or the
-    in-process one. Verification of the next plan overlaps the apply of the
-    previous one by verifying against an optimistic snapshot.
-    """
-
-    def __init__(
-        self,
-        plan_queue: PlanQueue,
-        eval_broker: EvalBroker,
-        raft,
-        fsm,
-        logger: Optional[logging.Logger] = None,
-    ):
-        super().__init__(daemon=True, name="plan-applier")
-        self.plan_queue = plan_queue
-        self.eval_broker = eval_broker
-        self.raft = raft
-        # Hold the FSM, not its StateStore: a raft snapshot restore rebinds
-        # fsm.state to a fresh store (fsm.go:313-410 posture), and plans must
-        # be verified against the live one.
-        self.fsm = fsm
-        self.logger = logger or logging.getLogger("nomad_tpu.plan_apply")
-        self._stop = threading.Event()
-
-    def stop(self) -> None:
-        self._stop.set()
-
-    def run(self) -> None:
-        wait_event: Optional[threading.Event] = None
-        snap = None
-
-        while not self._stop.is_set():
-            pending = self.plan_queue.dequeue(timeout=0.2)
-            if pending is None:
-                continue
-
-            # Trace context: the worker's submit span rode the request
-            # envelope (Plan.span_ctx); the queue wait is reconstructed
-            # from the enqueue stamp so it covers the real parked time.
-            tracer = trace.get_tracer()
-            eval_id = pending.plan.eval_id
-            plan_ctx = pending.plan.span_ctx or tracer.root_ctx(eval_id)
-            tracer.start_span(
-                eval_id, "plan.queue_wait", parent=plan_ctx,
-                start=pending.enqueue_time,
-            ).finish()
-
-            # Token verification guards split-brain evals
-            # (plan_apply.go:52-58, structs.go:1466-1471). Verify + mark
-            # inflight ATOMICALLY: the inflight mark stops the nack timer
-            # from redelivering this eval while its plan is mid-commit (a
-            # second worker's snapshot would race the commit and double-
-            # place), and a non-atomic mark leaves a timer-sized hole
-            # between check and mark. Cleared in every respond path below.
-            try:
-                self.eval_broker.outstanding_reset_and_mark(
-                    pending.plan.eval_id, pending.plan.eval_token
-                )
-            except BrokerError as e:
-                self.logger.error(
-                    "plan rejected for evaluation %s: %s", pending.plan.eval_id, e
-                )
-                pending.respond(None, e)
-                continue
-
-            # Reap a completed overlap
-            if wait_event is not None and wait_event.is_set():
-                wait_event = None
-                snap = None
-
-            if wait_event is None or snap is None:
-                snap = self.fsm.state.snapshot()
-
-            t0 = time.perf_counter()
-            eval_span = tracer.start_span(
-                eval_id, "plan.evaluate", parent=plan_ctx
-            )
-            result = evaluate_plan(snap, pending.plan)
-            eval_span.annotate("refresh_index", result.refresh_index)
-            eval_span.finish()
-            telemetry.measure_since(("plan", "evaluate"), t0)
-
-            if result.is_noop():
-                self.eval_broker.plan_done(pending.plan.eval_id)
-                pending.respond(result, None)
-                continue
-
-            # Bound snapshot staleness: wait for any in-flight apply
-            if wait_event is not None:
-                wait_event.wait()
-                snap = self.fsm.state.snapshot()
-                # Re-evaluate against fresh state? The reference keeps the
-                # earlier verification (bounded staleness); so do we.
-
-            apply_span = tracer.start_span(
-                eval_id, "plan.apply", parent=plan_ctx
-            )
-            future = self._apply(result, snap, span=apply_span,
-                                 plan=pending.plan)
-            wait_event = threading.Event()
-            t = threading.Thread(
-                target=self._async_plan_wait,
-                args=(wait_event, future, result, pending, apply_span),
-                daemon=True,
-            )
-            t.start()
-
-    def _apply(self, result: PlanResult, snap, span=None, plan=None):
-        """Dispatch the replicated alloc update + optimistic snapshot apply
-        (plan_apply.go:119-144)."""
-        t0 = time.perf_counter()
-        allocs = _object_allocs(result)
-        payload = {"allocs": allocs}
-        if result.alloc_batches:
-            payload["alloc_batches"] = result.alloc_batches
-        if result.update_batches:
-            payload["update_batches"] = result.update_batches
-        if plan is not None:
-            # Plan provenance rides the replicated entry so EVERY
-            # replica's FSM publishes exactly one PlanApplied per
-            # committed plan (nomad_tpu.events) — emitting here instead
-            # would tie the event to the leader that happened to submit.
-            payload["plan"] = {
-                "eval_id": plan.eval_id,
-                "allocs": len(allocs),
-                "alloc_batches": len(result.alloc_batches),
-                "update_batches": len(result.update_batches),
-            }
-        # A synchronous replication layer (InProcRaft) applies on THIS
-        # thread: the active-span install lets the FSM hang its fsm.apply
-        # span under plan.apply. An async raft applies elsewhere and only
-        # gets the aggregate timer.
-        with trace.use_span(span if span is not None else trace.NULL_SPAN):
-            future = self.raft.apply("alloc_update", payload)
-        telemetry.measure_since(("plan", "submit"), t0)
-        if snap is not None:
-            # Stamp the optimistic snapshot with the entry's real index: with
-            # a synchronous replication layer the future is already resolved;
-            # with an async one the entry will land at applied_index + 1.
-            # Never stamp ahead of the log — a RefreshIndex taken from this
-            # snapshot must be reachable by worker wait_for_index.
-            if future.done() and future.exception() is None:
-                idx = future.result()
-            else:
-                idx = self.raft.applied_index + 1
-            if allocs:
-                snap.upsert_allocs(idx, allocs)
-            if result.alloc_batches:
-                snap.upsert_alloc_blocks(idx, result.alloc_batches)
-            if result.update_batches:
-                snap.apply_update_batches(idx, result.update_batches)
-        return future
-
-    def _async_plan_wait(self, wait_event, future, result,
-                         pending: PendingPlan, span=None):
-        """plan_apply.go:146-162"""
-        index = 0
-        try:
-            try:
-                index = future.result()
-            except Exception as e:  # raft apply failed
-                self.logger.error("failed to apply plan: %s", e)
-                if span is not None:
-                    span.annotate("error", str(e)).finish()
-                pending.respond(None, e)
-                wait_event.set()
-                return
-            result.alloc_index = index
-            if span is not None:
-                span.annotate("alloc_index", index).finish()
-            pending.respond(result, None)
-            wait_event.set()
-        finally:
-            # The commit is durable (or failed): redelivery may proceed,
-            # and a redelivered worker's wait_index now covers this plan.
-            self.eval_broker.plan_done(pending.plan.eval_id,
-                                       commit_index=index)

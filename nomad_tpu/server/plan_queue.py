@@ -37,6 +37,10 @@ class PendingPlan:
         self.enqueue_time = trace.now()
 
     def respond(self, result: Optional[PlanResult], err: Optional[Exception]) -> None:
+        # Idempotent: a racing flush() and pipeline error path must not
+        # turn an already-unblocked worker into an InvalidStateError.
+        if self.future.done():
+            return
         if err is not None:
             self.future.set_exception(err)
         else:
@@ -108,11 +112,33 @@ class PlanQueue:
                 else:
                     self._work.wait()
 
+    def dequeue_batch(self, max_batch: int,
+                      timeout: Optional[float] = None
+                      ) -> List[PendingPlan]:
+        """Blocking drain: wait for one pending plan (``dequeue``
+        semantics), then take up to ``max_batch - 1`` more that are
+        already queued, in priority-FIFO order — the plan pipeline's
+        K-at-a-time intake. Never blocks for followers: a lone plan
+        returns alone."""
+        first = self.dequeue(timeout)
+        if first is None:
+            return []
+        out = [first]
+        with self._lock:
+            while self._enabled and self._heap and len(out) < max_batch:
+                _, _, pending = heapq.heappop(self._heap)
+                out.append(pending)
+        return out
+
     def flush(self) -> None:
-        """Cancel all pending plans (plan_queue.go:170-186)."""
+        """Fail all pending plans (plan_queue.go:170-186). Runs on
+        stop()/leadership loss: every outstanding future must resolve —
+        with ERR_QUEUE_DISABLED, so a worker blocked in submit_plan
+        during failover unblocks promptly instead of leaking until its
+        eval's nack timer fires."""
         with self._lock:
             for _, _, pending in self._heap:
-                pending.respond(None, PlanQueueError("plan queue flushed"))
+                pending.respond(None, PlanQueueError(ERR_QUEUE_DISABLED))
             self._heap = []
             self._work.notify_all()
 

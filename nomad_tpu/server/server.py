@@ -22,7 +22,7 @@ from nomad_tpu.server.core_sched import CoreScheduler
 from nomad_tpu.server.eval_broker import EvalBroker
 from nomad_tpu.server.fsm import FSM, InProcRaft
 from nomad_tpu.server.heartbeat import HeartbeatManager
-from nomad_tpu.server.plan_apply import PlanApplier
+from nomad_tpu.server.plan_pipeline import PlanPipeline
 from nomad_tpu.server.plan_queue import PlanQueue
 from nomad_tpu.server.timetable import TimeTable
 from nomad_tpu.server.worker import Worker
@@ -47,7 +47,21 @@ class ServerConfig:
     region: str = "global"
     datacenter: str = "dc1"
     node_name: str = "server-1"
-    num_schedulers: int = 2
+    # Scheduler worker concurrency: N workers evaluate concurrently
+    # against delta-rolled snapshots and the plan pipeline resolves
+    # their plans optimistically (Omega posture). First-class validated
+    # knob — agent config `server { scheduler_workers = N }` with
+    # ``num_schedulers`` as the legacy alias; the AGENT layer resolves
+    # the two (scheduler_workers preferred) and passes one value down.
+    # At THIS constructor a passed num_schedulers wins over
+    # scheduler_workers, because None-vs-set is the only explicit signal
+    # a dataclass can see — scheduler_workers' default is
+    # indistinguishable from an explicit 4.
+    scheduler_workers: int = 4
+    num_schedulers: Optional[int] = None
+    # How many pending plans the pipeline drains and verifies per fused
+    # batch pass (plan_pipeline.py). 1 degenerates to the serial applier.
+    plan_batch_size: int = 8
     enabled_schedulers: List[str] = field(
         default_factory=lambda: [
             structs.JOB_TYPE_SERVICE,
@@ -87,6 +101,26 @@ class ServerConfig:
     # get a truncation marker and must re-list.
     event_buffer_size: int = 2048
 
+    def __post_init__(self) -> None:
+        if self.num_schedulers is not None:
+            self.scheduler_workers = self.num_schedulers
+        # Both spellings read the same resolved value afterwards.
+        self.num_schedulers = self.scheduler_workers
+        if (not isinstance(self.scheduler_workers, int)
+                or isinstance(self.scheduler_workers, bool)
+                or not 0 <= self.scheduler_workers <= 128):
+            raise ValueError(
+                "scheduler_workers must be an integer in [0, 128], got "
+                f"{self.scheduler_workers!r}"
+            )
+        if (not isinstance(self.plan_batch_size, int)
+                or isinstance(self.plan_batch_size, bool)
+                or not 1 <= self.plan_batch_size <= 256):
+            raise ValueError(
+                "plan_batch_size must be an integer in [1, 256], got "
+                f"{self.plan_batch_size!r}"
+            )
+
     def scheduler_factory(self, eval_type: str) -> str:
         if self.scheduler_backend == "tpu" and eval_type in (
             structs.JOB_TYPE_SERVICE,
@@ -118,13 +152,19 @@ class Server:
         self.plan_queue = PlanQueue()
         self.time_table = TimeTable()
         self.heartbeat = HeartbeatManager(self)
-        self.plan_applier = PlanApplier(
+        self.plan_applier = PlanPipeline(
             self.plan_queue, self.eval_broker, self.raft, self.fsm,
-            self.logger,
+            self.logger, max_batch=self.config.plan_batch_size,
         )
         self.workers: List[Worker] = []
         self._periodic_stop = threading.Event()
         self._started = False
+
+    @property
+    def plan_pipeline(self) -> PlanPipeline:
+        """The optimistic batch applier (``plan_applier`` is the legacy
+        spelling kept for the reference's naming)."""
+        return self.plan_applier
 
     @property
     def state_store(self):
@@ -140,7 +180,7 @@ class Server:
         self.eval_broker.set_enabled(True)
         self.plan_applier.start()
         self.restore_eval_broker()
-        for i in range(self.config.num_schedulers):
+        for i in range(self.config.scheduler_workers):
             worker = Worker(self, i)
             worker.start()
             self.workers.append(worker)
@@ -244,6 +284,17 @@ class Server:
             # inactivity, breaking absent()-style alerts).
             telemetry.set_gauge(
                 ("plan", "queue_depth"), self.plan_queue.depth()
+            )
+            # Worker concurrency + pipeline batch ceiling: the two knobs
+            # whose product bounds optimistic-apply parallelism; gauged
+            # so the exposition names the posture a conflict-rate curve
+            # was measured under.
+            telemetry.set_gauge(
+                ("worker", "concurrency"),
+                sum(1 for w in self.workers if w.is_alive()),
+            )
+            telemetry.set_gauge(
+                ("plan", "pipeline_batch_max"), self.plan_applier.max_batch
             )
             telemetry.set_gauge(
                 ("heartbeat", "active"), self.heartbeat.num_timers()
@@ -677,6 +728,7 @@ class Server:
             "broker_unacked": broker.total_unacked,
             "broker_blocked": broker.total_blocked,
             "plan_queue_depth": self.plan_queue.depth(),
+            "plan_pipeline": self.plan_applier.stats(),
             "heartbeat_timers": self.heartbeat.num_timers(),
             "scheduler": self.solver_stats(),
         }

@@ -61,9 +61,14 @@ class Worker(threading.Thread):
             self._pause_cond.notify_all()
 
     def _check_paused(self) -> None:
+        """Pure condition-notify park: both exits (set_pause(False) and
+        stop(), which routes through set_pause) notify the condition, so
+        the 0.2s poll the loop used to carry bought nothing but wakeups —
+        at N workers it was N/0.2 spurious scheduler passes per second of
+        paused time."""
         with self._pause_cond:
             while self._paused and not self._stop.is_set():
-                self._pause_cond.wait(0.2)
+                self._pause_cond.wait()
 
     def run(self) -> None:
         batch_size = getattr(self.server.config, "eval_batch_size", 1)
@@ -288,7 +293,13 @@ class Worker(threading.Thread):
         state for batched processing; defaults to the worker itself (the
         single-eval posture, kept for the legacy call shape)."""
         start = time.perf_counter()
+        # Transaction timestamp BEFORE the snapshot: the snapshot can only
+        # be newer than the index read, so conflict attribution against it
+        # errs toward reporting a conflict, never toward missing one.
+        snapshot_index = self.server.raft.applied_index
         snapshot = self.server.state_store.snapshot()
+        if planner is not None:
+            planner.snapshot_index = snapshot_index
         if planner is None:
             # Legacy single-eval posture only: concurrent batch threads
             # must not stamp shared worker state (their token rides in
@@ -335,10 +346,16 @@ class _EvalRun:
     def __init__(self, worker: Worker, token: Optional[str]):
         self.worker = worker
         self.eval_token = token
+        # Raft applied index of the snapshot this eval is planning
+        # against; stamped by _invoke_scheduler and re-stamped on every
+        # forced refresh. Rides each plan as Plan.snapshot_index — the
+        # pipeline's conflict-attribution timestamp.
+        self.snapshot_index = 0
 
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         start = time.perf_counter()
         plan.eval_token = self.eval_token
+        plan.snapshot_index = self.snapshot_index
         # The submit span's context rides the request envelope
         # (Plan.span_ctx) so the leader's applier parents its plan.* spans
         # on it even across the RPC boundary.
@@ -368,6 +385,7 @@ class _EvalRun:
                 max(result.refresh_index, result.alloc_index),
                 RAFT_SYNC_LIMIT,
             )
+            self.snapshot_index = self.worker.server.raft.applied_index
             new_state = self.worker.server.state_store.snapshot()
         return result, new_state
 

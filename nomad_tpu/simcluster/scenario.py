@@ -203,6 +203,9 @@ class ScenarioRunner:
         self._stop = threading.Event()
         self.peaks = {"broker_ready": 0, "broker_unacked": 0,
                       "broker_blocked": 0, "plan_queue_depth": 0}
+        # (t, cumulative plans, cumulative conflicts) at 10 Hz — the
+        # conflict-rate-vs-load raw series.
+        self._pipe_samples: List = []
         self._srv: Optional[ClusterServer] = None
         self._jobs: Dict[str, object] = {}
 
@@ -235,6 +238,14 @@ class ScenarioRunner:
                 self.peaks["broker_blocked"], stats.total_blocked)
             self.peaks["plan_queue_depth"] = max(
                 self.peaks["plan_queue_depth"], srv.plan_queue.depth())
+            # Conflict-rate-vs-load raw series (the Omega evaluation,
+            # PAPERS.md): cumulative pipeline counters at 10 Hz; the
+            # artifact builder differentiates into per-window load
+            # (plans/s) and conflict-rate points.
+            pipe = srv.plan_pipeline.stats()
+            self._pipe_samples.append(
+                (time.perf_counter(), pipe["plans"], pipe["conflicts"])
+            )
 
     # -- actions ------------------------------------------------------------
 
@@ -316,12 +327,17 @@ class ScenarioRunner:
         from nomad_tpu.tpu.mirror import GLOBAL_MIRROR_CACHE
 
         spec = self.spec
-        cfg = ServerConfig(
-            scheduler_backend="tpu", num_schedulers=2, eval_batch_size=4,
+        # Overrides go through the CONSTRUCTOR, not post-construction
+        # setattr: __post_init__ is what resolves + validates the
+        # scheduler_workers/num_schedulers alias pair, and a setattr
+        # after it leaves the two desynced (the artifact would then
+        # report a worker count the server isn't actually running).
+        cfg_kwargs = dict(
+            scheduler_backend="tpu", scheduler_workers=4, eval_batch_size=4,
             prewarm_shapes=False, periodic_dispatch=False,
         )
-        for k, v in spec.server_overrides.items():
-            setattr(cfg, k, v)
+        cfg_kwargs.update(spec.server_overrides)
+        cfg = ServerConfig(**cfg_kwargs)
         srv = self._srv = ClusterServer(
             cfg, ClusterConfig(bootstrap_expect=1), logger=self.logger,
         )
@@ -360,6 +376,18 @@ class ScenarioRunner:
                     timeout=fleet.rpc_timeout,
                 )
                 srv.wait_for_eval(out["eval_id"], timeout=180.0)
+                # The warmup job compiles the single-eval water-fill for
+                # this node bucket; concurrent workers additionally stack
+                # compatible evals into power-of-two-wide coalesced
+                # dispatches (ops/coalesce.py). Warm those widths too —
+                # the stated purpose of this phase is that the measured
+                # window reports steady-state, and a burst's first
+                # stacked dispatch otherwise pays its XLA compile
+                # in-window.
+                from nomad_tpu.ops.binpack import bucket
+                from nomad_tpu.ops.coalesce import warm_batch_shapes
+
+                warm_batch_shapes(bucket(max(self.n_nodes, 1)))
 
             # Phase 3: measured window. Cursor excludes bring-up/warmup.
             if spec.faults_spec is not None:
@@ -372,6 +400,7 @@ class ScenarioRunner:
             t_measure0 = time.perf_counter()
             dispatches0 = GLOBAL_SOLVER.dispatches
             mirror0 = GLOBAL_MIRROR_CACHE.stats()
+            pipe0 = srv.plan_pipeline.stats()
             watcher = threading.Thread(
                 target=self._watch_events, args=(broker, cursor),
                 daemon=True, name="sim-events")
@@ -420,6 +449,14 @@ class ScenarioRunner:
                 for k in ("hits", "misses", "delta_rolls",
                           "full_rebuilds", "rows_restaged")
             }
+            pipe1 = srv.plan_pipeline.stats()
+            pipeline = {
+                k: pipe1[k] - pipe0[k]
+                for k in ("batches", "plans", "committed", "noops",
+                          "conflicts", "refreshes", "fused_plans",
+                          "scalar_plans")
+            }
+            pipeline["max_batch_seen"] = pipe1["max_batch_seen"]
 
             # Phase 4: alloc acknowledgement (bounded client posture).
             acked = 0
@@ -439,7 +476,7 @@ class ScenarioRunner:
                 t.join(timeout=5.0)
             return self._artifact(
                 srv, fleet, reg, hb0, hb1, dispatches, acked, wall,
-                measured, len(expected_evals), mirror,
+                measured, len(expected_evals), mirror, pipeline,
             )
         finally:
             self._stop.set()
@@ -481,8 +518,54 @@ class ScenarioRunner:
             f"/{len(expected_evals)}, nodes_still_up={len(down_needed)}"
         )
 
+    def _conflict_curve(self) -> List[Dict]:
+        """Reduce the 10 Hz cumulative (plans, conflicts) series into
+        conflict-rate-vs-load points — the Omega evaluation's curve
+        (Schwarzkopf et al., fig. 7 posture): differentiate into ~0.5s
+        windows, keep windows that saw plans, and bucket them by load
+        (plans/s) so repeated load levels aggregate."""
+        samples = self._pipe_samples
+        if len(samples) < 2:
+            return []
+        windows = []
+        stride = 5  # 5 x 10 Hz = ~0.5s differentiation windows
+        for i in range(0, len(samples) - 1, stride):
+            # Clamped end: the tail beyond the last full stride still
+            # forms a window — a sub-second burst's commits land there
+            # and would otherwise vanish from the curve.
+            j = min(i + stride, len(samples) - 1)
+            t0, p0, c0 = samples[i]
+            t1, p1, c1 = samples[j]
+            dt = max(t1 - t0, 1e-9)
+            dp, dc = p1 - p0, c1 - c0
+            if dp > 0:
+                windows.append((dp / dt, dp, dc))
+        if not windows:
+            return []
+        buckets: Dict[int, List] = {}
+        for load, dp, dc in windows:
+            # Geometric load buckets (1-2, 2-4, 4-8 ... plans/s): the
+            # curve spans steady trickles and 100k-task bursts.
+            b = max(0, int(math.log2(max(load, 1.0))))
+            agg = buckets.setdefault(b, [0, 0, 0, 0.0])
+            agg[0] += 1
+            agg[1] += dp
+            agg[2] += dc
+            agg[3] += load
+        return [
+            {
+                "plans_per_sec": round(agg[3] / agg[0], 2),
+                "windows": agg[0],
+                "plans": agg[1],
+                "conflicts": agg[2],
+                "conflict_rate": round(agg[2] / max(agg[1], 1), 4),
+            }
+            for _b, agg in sorted(buckets.items())
+        ]
+
     def _artifact(self, srv, fleet, reg, hb0, hb1, dispatches, acked,
-                  wall, measured, n_injected_evals, mirror) -> Dict:
+                  wall, measured, n_injected_evals, mirror,
+                  pipeline) -> Dict:
         with self._events_lock:
             events = list(self._events)
         pending_at: Dict[str, float] = {}
@@ -580,6 +663,19 @@ class ScenarioRunner:
             # perf_opt acceptance gauge: delta_rolls >> full_rebuilds
             # under steady node-write load).
             "mirror": mirror,
+            # Optimistic plan pipeline over the measured window: the
+            # Omega posture's health — batch amortization (batches vs
+            # plans), fused vs scalar verification economy, and the
+            # first-class conflict-rate-vs-load curve.
+            "plan_pipeline": {
+                **pipeline,
+                "workers": srv.config.scheduler_workers,
+                "pipeline_batch_max": srv.plan_pipeline.max_batch,
+                "conflict_rate": round(
+                    pipeline["conflicts"] / max(pipeline["plans"], 1), 4
+                ),
+                "conflict_rate_vs_load": self._conflict_curve(),
+            },
             "events": {
                 "observed": len(events),
                 "truncated": self._truncated,

@@ -1118,6 +1118,13 @@ class Plan:
     span_ctx: Dict[str, str] = field(default_factory=dict)
     priority: int = 0
     all_at_once: bool = False
+    # Raft applied index of the snapshot the submitting worker evaluated
+    # against — the optimistic-concurrency transaction timestamp (Omega
+    # posture): the plan pipeline attributes a verification failure as a
+    # CONFLICT iff capacity committed after this index overlaps the
+    # plan's touched nodes. 0 = unknown (legacy/wire submitters): no
+    # attribution, plain stale-data refresh semantics.
+    snapshot_index: int = 0
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     failed_allocs: List[Allocation] = field(default_factory=list)
@@ -1170,6 +1177,11 @@ class PlanResult:
     update_batches: List[AllocUpdateBatch] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
+    # Transaction-time conflict attribution (plan_pipeline): the refresh
+    # was caused by capacity another plan committed after this plan's
+    # snapshot (same pipeline batch or since) — as opposed to data that
+    # was already stale in the submitter's own snapshot.
+    conflict: bool = False
 
     def is_noop(self) -> bool:
         return (

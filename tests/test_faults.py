@@ -706,8 +706,11 @@ def test_chaos_rpc_drop_20pct_under_load():
     from nomad_tpu.server import ServerConfig
     from nomad_tpu.server.cluster import form_cluster, wait_for_leader
 
+    # N>=4 concurrent workers per server: exactly-once must hold with
+    # the optimistic plan pipeline resolving their contending plans
+    # while frames drop / leaders fall.
     servers = form_cluster(3, ServerConfig(
-        scheduler_backend="host", num_schedulers=1,
+        scheduler_backend="host", scheduler_workers=4,
         min_heartbeat_ttl=300.0,
     ), base_cluster=relaxed_cluster_cfg())
     try:
@@ -746,8 +749,11 @@ def test_chaos_leader_partition_mid_plan():
     from nomad_tpu.server import ServerConfig
     from nomad_tpu.server.cluster import form_cluster, wait_for_leader
 
+    # N>=4 concurrent workers per server: exactly-once must hold with
+    # the optimistic plan pipeline resolving their contending plans
+    # while frames drop / leaders fall.
     servers = form_cluster(3, ServerConfig(
-        scheduler_backend="host", num_schedulers=1,
+        scheduler_backend="host", scheduler_workers=4,
         min_heartbeat_ttl=300.0,
     ), base_cluster=relaxed_cluster_cfg())
     try:

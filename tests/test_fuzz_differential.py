@@ -1429,3 +1429,278 @@ def test_node_table_delta_matches_fresh(seed):
             np.testing.assert_array_equal(
                 getattr(rolled, attr), getattr(fresh, attr),
                 err_msg=f"{where}: {attr}")
+
+
+# ---------------------------------------------------------------------------
+# 7. Batched plan verification parity (plan_pipeline.evaluate_plans)
+# ---------------------------------------------------------------------------
+
+N_BATCH_VERIFY_SEEDS = int(os.environ.get("NOMAD_TPU_FUZZ_SEEDS", 40))
+
+
+def _pv_alloc(rng, nid, serial, cpu=None):
+    return structs.Allocation(
+        id=generate_uuid(), eval_id=generate_uuid(),
+        name=f"pv.web[{serial}]", node_id=nid, job_id="pv-job",
+        task_group="web",
+        resources=Resources(
+            cpu=int(cpu if cpu is not None else rng.integers(50, 900)),
+            memory_mb=int(rng.integers(16, 512)),
+        ),
+        desired_status=structs.ALLOC_DESIRED_STATUS_RUN,
+    )
+
+
+def _pv_batch(rng, ids, with_net=False):
+    """One columnar placement batch over a random node subset — counts
+    sized so stacked overlapping batches overflow small nodes."""
+    from nomad_tpu.structs import AllocBatch
+
+    picks = [str(rng.choice(ids))
+             for _ in range(int(rng.integers(1, 5)))]
+    counts = [int(rng.integers(1, 40)) for _ in picks]
+    res = Resources(cpu=int(rng.integers(30, 600)),
+                    memory_mb=int(rng.integers(16, 256)))
+    if with_net:
+        res.networks = [NetworkResource(device="eth0", mbits=10)]
+    return AllocBatch(
+        eval_id=generate_uuid(), job=None, tg_name="web",
+        resources=res,
+        task_resources={"t": res},
+        metrics=None,
+        node_ids=picks, node_counts=counts,
+        name_idx=np.arange(sum(counts)),
+        ids_seed=int(rng.integers(1, 2**63)),
+    )
+
+
+def _pv_decisions(result):
+    """The decision content of one PlanResult, in comparable form."""
+    return {
+        "refresh_index": result.refresh_index,
+        "node_allocation": {
+            nid: sorted(a.id for a in allocs)
+            for nid, allocs in result.node_allocation.items() if allocs
+        },
+        "node_update": {
+            nid: sorted(a.id for a in allocs)
+            for nid, allocs in result.node_update.items() if allocs
+        },
+        "alloc_batches": sorted(
+            (tuple(b.node_ids), tuple(int(c) for c in b.node_counts))
+            for b in result.alloc_batches
+        ),
+        "update_batches": len(result.update_batches),
+    }
+
+
+@pytest.mark.parametrize("seed", range(N_BATCH_VERIFY_SEEDS))
+def test_batched_plan_verify_matches_sequential(seed):
+    """The plan pipeline's K-plan fused tensor verify is DECISION-
+    IDENTICAL to K sequential evaluate_plan calls with each committed
+    subset rolled into the snapshot between calls — across seeded
+    overlapping/disjoint plan sets, block-native existing allocs,
+    dead/drained/reserved-network nodes, object-row placements forcing
+    the scalar path mid-batch, and delta-rolled node tables."""
+    import copy as _copy
+    import itertools
+
+    from nomad_tpu.server import plan_apply
+    from nomad_tpu.server.plan_apply import evaluate_plan
+    from nomad_tpu.server.plan_pipeline import (
+        apply_result_to_snapshot,
+        evaluate_plans,
+    )
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import Plan
+
+    rng = np.random.default_rng(70_000 + seed)
+    with plan_apply._NODE_TABLE_LOCK:
+        plan_apply._NODE_TABLE_CACHE = None
+    store = StateStore()
+    idx = 0
+    next_id = 0
+    for _ in range(int(rng.integers(6, 30))):
+        idx += 1
+        store.upsert_node(idx, _mirror_rand_node(rng, next_id))
+        next_id += 1
+    # Seed a table ancestor so later verifies exercise the delta roll.
+    plan_apply._node_table(store.snapshot())
+
+    # Pre-existing columnar blocks (block-native allocs) and sometimes
+    # object rows (which force the whole batch down the scalar path).
+    ids = [n.id for n in store.nodes()]
+    for _ in range(int(rng.integers(0, 4))):
+        idx += 1
+        store.upsert_alloc_blocks(
+            idx, [_pv_batch(rng, ids, with_net=rng.random() < 0.15)])
+    if rng.random() < 0.35:
+        idx += 1
+        store.upsert_allocs(idx, [
+            _pv_alloc(rng, str(rng.choice(ids)), s)
+            for s in range(int(rng.integers(1, 4)))
+        ])
+    # Node-table churn after the ancestor build: the rolled-table path.
+    for _ in range(int(rng.integers(0, 4))):
+        idx, next_id = _mirror_mutate(rng, store, idx, next_id)
+    ids = [n.id for n in store.nodes()]
+    if not ids:
+        return
+
+    k = int(rng.integers(2, 7))
+    plans = []
+    for p in range(k):
+        plan = Plan(eval_id=f"pv-{seed}-{p}", priority=50)
+        shape = rng.random()
+        if shape < 0.6:
+            # Pure columnar: the fused path's home turf. Overlap is the
+            # point — batches draw from the same node pool.
+            for _ in range(int(rng.integers(1, 3))):
+                plan.append_batch(
+                    _pv_batch(rng, ids, with_net=rng.random() < 0.1))
+        elif shape < 0.85:
+            # Object placements (scalar path mid-batch).
+            for s in range(int(rng.integers(1, 4))):
+                nid = str(rng.choice(ids))
+                plan.node_allocation.setdefault(nid, []).append(
+                    _pv_alloc(rng, nid, s))
+        else:
+            # Mixed: a batch plus an eviction of a stale id.
+            plan.append_batch(_pv_batch(rng, ids))
+            stale = _pv_alloc(rng, str(rng.choice(ids)), 999)
+            plan.node_update.setdefault(stale.node_id, []).append(stale)
+        plans.append(plan)
+
+    plans_seq = _copy.deepcopy(plans)
+    plans_fused = _copy.deepcopy(plans)
+    snap_seq = store.snapshot()
+    snap_fused = store.snapshot()
+
+    stamp_seq = itertools.count(100_000)
+    stamp_fused = itertools.count(100_000)
+
+    want = []
+    for plan in plans_seq:
+        res = evaluate_plan(snap_seq, plan)
+        if not res.is_noop():
+            apply_result_to_snapshot(snap_seq, res, next(stamp_seq))
+        want.append(_pv_decisions(res))
+
+    got_results = evaluate_plans(
+        snap_fused, plans_fused, stamp_index=lambda: next(stamp_fused))
+    got = [_pv_decisions(r) for r in got_results]
+
+    assert got == want, f"seed {seed}: fused verify diverged"
+    # The rolled stores must agree too: same committed blocks, same
+    # object rows.
+    def _store_shape(snap):
+        return (
+            sorted((tuple(b.node_ids), tuple(int(c) for c in b.node_counts))
+                   for b in snap.alloc_blocks()),
+            sorted(a.id for nid in ids for a in snap.allocs_by_node(nid)),
+        )
+    assert _store_shape(snap_fused) == _store_shape(snap_seq), (
+        f"seed {seed}: rolled snapshots diverged"
+    )
+
+
+@pytest.mark.parametrize("seed", range(N_BATCH_VERIFY_SEEDS))
+def test_batched_plan_verify_fused_engagement_parity(seed):
+    """Same parity contract on the fused pass's home distribution — all
+    nodes live, pure columnar overlapping batches sized so the stacked
+    asks overflow small nodes mid-batch (prefix commit + scalar
+    resolution of the overflowing plan + re-fuse of the tail). Asserts
+    the fused pass actually engaged: a regression that silently sends
+    everything down the scalar path fails here, not just in benchmarks."""
+    import copy as _copy
+    import itertools
+
+    from nomad_tpu.server import plan_apply
+    from nomad_tpu.server.plan_apply import evaluate_plan
+    from nomad_tpu.server.plan_pipeline import (
+        _PipelineTotals,
+        apply_result_to_snapshot,
+        evaluate_plans,
+    )
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import Node, Plan
+
+    rng = np.random.default_rng(80_000 + seed)
+    with plan_apply._NODE_TABLE_LOCK:
+        plan_apply._NODE_TABLE_CACHE = None
+    store = StateStore()
+    idx = 0
+    n_nodes = int(rng.integers(5, 25))
+    for i in range(n_nodes):
+        idx += 1
+        store.upsert_node(idx, Node(
+            id=f"fp-{i:03d}", datacenter="dc1", name=f"fp{i}",
+            status="ready",
+            resources=Resources(
+                cpu=int(rng.integers(1000, 6000)),
+                memory_mb=int(rng.integers(2048, 16384)),
+                disk_mb=100_000, iops=10_000,
+            ),
+        ))
+    plan_apply._node_table(store.snapshot())
+    ids = [n.id for n in store.nodes()]
+
+    def _mk_batch(hog=False):
+        from nomad_tpu.structs import AllocBatch
+
+        picks = [str(rng.choice(ids))
+                 for _ in range(int(rng.integers(1, 5)))]
+        counts = [int(rng.integers(1, 6)) for _ in picks]
+        res = Resources(
+            cpu=int(rng.integers(2000, 4000) if hog
+                    else rng.integers(10, 80)),
+            memory_mb=int(rng.integers(16, 128)),
+        )
+        return AllocBatch(
+            eval_id=generate_uuid(), job=None, tg_name="web",
+            resources=res, task_resources={"t": res}, metrics=None,
+            node_ids=picks, node_counts=counts,
+            name_idx=np.arange(sum(counts)),
+            ids_seed=int(rng.integers(1, 2**63)),
+        )
+
+    # Existing block pressure so the base usage term is non-trivial.
+    for _ in range(int(rng.integers(0, 3))):
+        idx += 1
+        store.upsert_alloc_blocks(idx, [_mk_batch()])
+
+    k = int(rng.integers(3, 8))
+    plans = []
+    for p in range(k):
+        plan = Plan(eval_id=f"fp-{seed}-{p}", priority=50)
+        for _ in range(int(rng.integers(1, 3))):
+            # Mostly modest asks that stack and fit (the fused whole-
+            # commit run); ~15% hogs that overflow mid-batch and force
+            # the prefix break + scalar resolution + tail re-fuse.
+            plan.append_batch(_mk_batch(hog=rng.random() < 0.15))
+        plans.append(plan)
+
+    plans_seq = _copy.deepcopy(plans)
+    plans_fused = _copy.deepcopy(plans)
+    snap_seq = store.snapshot()
+    snap_fused = store.snapshot()
+    stamp_seq = itertools.count(100_000)
+    stamp_fused = itertools.count(100_000)
+
+    want = []
+    for plan in plans_seq:
+        res = evaluate_plan(snap_seq, plan)
+        if not res.is_noop():
+            apply_result_to_snapshot(snap_seq, res, next(stamp_seq))
+        want.append(_pv_decisions(res))
+
+    totals = _PipelineTotals()
+    got_results = evaluate_plans(
+        snap_fused, plans_fused,
+        stamp_index=lambda: next(stamp_fused), totals=totals)
+    got = [_pv_decisions(r) for r in got_results]
+
+    assert got == want, f"seed {seed}: fused verify diverged"
+    assert totals.fused_plans > 0, (
+        f"seed {seed}: fused pass never engaged on its home distribution"
+    )

@@ -1,0 +1,351 @@
+"""Optimistic plan pipeline tests (plan_pipeline.py): batch intake order,
+queue shutdown hardening (ERR_QUEUE_DISABLED on failover — workers
+blocked in submit_plan must unblock promptly), batched commit with
+transaction-time conflict attribution, and the scheduler_workers knob."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.plan_queue import (
+    ERR_QUEUE_DISABLED,
+    PlanQueue,
+    PlanQueueError,
+)
+from nomad_tpu.structs import Evaluation, Plan, Resources, generate_uuid
+
+
+# -- queue intake ------------------------------------------------------------
+
+
+def test_dequeue_batch_priority_fifo_order():
+    """One drain takes up to K plans in priority-FIFO order; leftovers
+    stay queued for the next cycle."""
+    q = PlanQueue()
+    q.set_enabled(True)
+    for eval_id, prio in (("lo", 10), ("m1", 50), ("hi", 90), ("m2", 50)):
+        q.enqueue(Plan(eval_id=eval_id, priority=prio))
+    batch = q.dequeue_batch(3, timeout=0.5)
+    assert [p.plan.eval_id for p in batch] == ["hi", "m1", "m2"]
+    rest = q.dequeue_batch(3, timeout=0.5)
+    assert [p.plan.eval_id for p in rest] == ["lo"]
+    q.set_enabled(False)
+
+
+def test_dequeue_batch_lone_plan_returns_immediately():
+    """The batch drain never waits for followers: a lone plan must not
+    pay a batching window (the latency-vs-batching tradeoff is resolved
+    by draining only what is already queued)."""
+    q = PlanQueue()
+    q.set_enabled(True)
+    q.enqueue(Plan(eval_id="only", priority=50))
+    t0 = time.monotonic()
+    batch = q.dequeue_batch(8, timeout=5.0)
+    elapsed = time.monotonic() - t0
+    assert len(batch) == 1 and batch[0].plan.eval_id == "only"
+    assert elapsed < 0.5, f"lone plan waited {elapsed:.2f}s for a batch"
+    q.set_enabled(False)
+
+
+# -- shutdown hardening ------------------------------------------------------
+
+
+def test_flush_fails_pending_with_queue_disabled():
+    q = PlanQueue()
+    q.set_enabled(True)
+    pending = q.enqueue(Plan(eval_id="x", priority=50))
+    q.set_enabled(False)
+    with pytest.raises(PlanQueueError) as ei:
+        pending.wait(timeout=1.0)
+    assert ERR_QUEUE_DISABLED in str(ei.value)
+
+
+def test_worker_blocked_on_submit_unblocks_on_failover():
+    """Regression: a worker blocked on submit_plan when leadership flips
+    (plan queue disabled) must unblock PROMPTLY with ERR_QUEUE_DISABLED —
+    outstanding PendingPlan futures are failed, not leaked until the
+    eval's nack timer redelivers somewhere else."""
+    srv = Server(ServerConfig(scheduler_backend="host", num_schedulers=0))
+    srv.plan_queue.set_enabled(True)
+    srv.eval_broker.set_enabled(True)
+    # The pipeline is deliberately NOT started: the plan stays pending,
+    # like on an applier that is busy (or gone) when leadership flips.
+    try:
+        errs = []
+
+        def submit():
+            try:
+                srv.plan_submit(Plan(eval_id=generate_uuid(), priority=50))
+            except Exception as e:  # noqa: BLE001 - asserting the type below
+                errs.append(e)
+
+        t = threading.Thread(target=submit, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while srv.plan_queue.depth() == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.plan_queue.depth() == 1
+
+        t0 = time.monotonic()
+        srv.plan_queue.set_enabled(False)  # revokeLeadership path
+        t.join(timeout=2.0)
+        unblock = time.monotonic() - t0
+        assert not t.is_alive(), "worker still blocked after failover"
+        assert unblock < 1.0, f"unblock took {unblock:.2f}s"
+        assert errs and isinstance(errs[0], PlanQueueError)
+        assert ERR_QUEUE_DISABLED in str(errs[0])
+    finally:
+        srv.shutdown()
+
+
+# -- batched commit + conflict attribution ----------------------------------
+
+
+def _seed_eval(srv, job_id):
+    ev = Evaluation(
+        id=generate_uuid(), priority=50,
+        type=structs.JOB_TYPE_SERVICE,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job_id, status=structs.EVAL_STATUS_PENDING,
+    )
+    srv.raft.apply("eval_update", {"evals": [ev]})
+    return ev
+
+
+def _place_plan(eval_id, token, node_id, cpu, snapshot_index):
+    alloc = mock.alloc()
+    alloc.node_id = node_id
+    alloc.eval_id = eval_id
+    # cpu/mem-bound contention only: the mock's NIC reservations would
+    # fail the port check before capacity ever mattered.
+    alloc.resources = Resources(cpu=cpu, memory_mb=64)
+    alloc.task_resources = {}
+    alloc.desired_status = structs.ALLOC_DESIRED_STATUS_RUN
+    plan = Plan(eval_id=eval_id, eval_token=token, priority=50,
+                snapshot_index=snapshot_index)
+    plan.append_alloc(alloc)
+    return plan
+
+
+def test_pipeline_batch_commits_in_order_and_bounces_conflict():
+    """Two plans from the same pre-batch snapshot contending for one
+    node's capacity, drained in ONE pipeline batch: the first commits,
+    the second fails verification AND is attributed as a transaction-time
+    conflict (capacity committed after its snapshot index overlaps its
+    footprint) — the Omega bounce, riding the existing RefreshIndex
+    path. Exactly one alloc lands on the node."""
+    from nomad_tpu.server.plan_pipeline import PIPELINE_TOTALS
+
+    srv = Server(ServerConfig(scheduler_backend="host", num_schedulers=0))
+    srv.plan_queue.set_enabled(True)
+    srv.eval_broker.set_enabled(True)
+    try:
+        node = mock.node()
+        # 900 cpu headroom after the mock's 100 reserved: fits one 600
+        # ask, not two (the mock NIC stays so bandwidth checks pass).
+        node.resources.cpu = 1000
+        srv.raft.apply("node_register", {"node": node})
+        ev_a = _seed_eval(srv, "job-a")
+        ev_b = _seed_eval(srv, "job-b")
+        dq_a, tok_a, _ = srv.eval_dequeue(["service"], timeout=1.0)
+        dq_b, tok_b, _ = srv.eval_dequeue(["service"], timeout=1.0)
+        assert {dq_a.id, dq_b.id} == {ev_a.id, ev_b.id}
+        tokens = {dq_a.id: tok_a, dq_b.id: tok_b}
+
+        snap_index = srv.raft.applied_index  # both pre-commit snapshots
+        plan_a = _place_plan(dq_a.id, tokens[dq_a.id], node.id, 600,
+                             snap_index)
+        plan_b = _place_plan(dq_b.id, tokens[dq_b.id], node.id, 600,
+                             snap_index)
+        # Enqueue BOTH before the pipeline starts: one drain, one batch.
+        pend_a = srv.plan_queue.enqueue(plan_a)
+        pend_b = srv.plan_queue.enqueue(plan_b)
+        conflicts0 = PIPELINE_TOTALS.stats()["conflicts"]
+        srv.plan_applier.start()
+
+        res_a = pend_a.wait(timeout=5.0)
+        res_b = pend_b.wait(timeout=5.0)
+        # Commit order is queue order: A whole-committed...
+        assert res_a.node_allocation and res_a.refresh_index == 0
+        assert not res_a.conflict
+        # ...B bounced whole with a refresh token and conflict mark.
+        assert not res_b.node_allocation
+        assert res_b.refresh_index > 0
+        assert res_b.conflict is True
+        assert PIPELINE_TOTALS.stats()["conflicts"] == conflicts0 + 1
+
+        allocs = [a for a in srv.state_store.allocs_by_node(node.id)
+                  if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN]
+        assert len(allocs) == 1, "double-committed capacity"
+    finally:
+        srv.shutdown()
+
+
+def test_stale_refresh_without_overlap_is_not_a_conflict():
+    """A plan that fails verification for a reason its own snapshot
+    already contained (target node never existed) is a plain stale-data
+    refresh, NOT a conflict — attribution requires overlapping capacity
+    committed after the plan's snapshot index."""
+    srv = Server(ServerConfig(scheduler_backend="host", num_schedulers=0))
+    srv.plan_queue.set_enabled(True)
+    srv.eval_broker.set_enabled(True)
+    try:
+        node = mock.node()
+        srv.raft.apply("node_register", {"node": node})
+        ev = _seed_eval(srv, "job-x")
+        dq, tok, _ = srv.eval_dequeue(["service"], timeout=1.0)
+        plan = _place_plan(dq.id, tok, "no-such-node", 100,
+                           srv.raft.applied_index)
+        pend = srv.plan_queue.enqueue(plan)
+        srv.plan_applier.start()
+        res = pend.wait(timeout=5.0)
+        assert res.refresh_index > 0
+        assert res.conflict is False
+    finally:
+        srv.shutdown()
+
+
+def test_all_bounce_batch_does_not_pin_stale_snapshot():
+    """Regression: a batch that commits NOTHING (all plans bounce) leaves
+    no applies in flight, and the next batch must re-snapshot fresh —
+    out-of-band raft writes (capacity freed, nodes registered) have to be
+    visible, or every later plan verifies against the pinned stale
+    snapshot and bounces forever."""
+    srv = Server(ServerConfig(scheduler_backend="host", num_schedulers=0))
+    srv.plan_queue.set_enabled(True)
+    srv.eval_broker.set_enabled(True)
+    try:
+        node = mock.node()
+        node.resources.cpu = 1000
+        srv.raft.apply("node_register", {"node": node})
+        srv.plan_applier.start()
+
+        # Batch 1: bounces whole (2000 cpu never fits the 1000-cpu node)
+        # — nothing commits, nothing dispatches.
+        ev_a = _seed_eval(srv, "job-bounce")
+        dq_a, tok_a, _ = srv.eval_dequeue(["service"], timeout=1.0)
+        res_a = srv.plan_queue.enqueue(
+            _place_plan(dq_a.id, tok_a, node.id, 2000,
+                        srv.raft.applied_index)
+        ).wait(timeout=5.0)
+        assert res_a.refresh_index > 0 and not res_a.node_allocation
+
+        # Out-of-band raft write AFTER the all-bounce batch: new node.
+        node2 = mock.node()
+        node2.resources.cpu = 4000
+        srv.raft.apply("node_register", {"node": node2})
+
+        # Batch 2 places on node2 — a pinned pre-node2 snapshot would
+        # treat it as unknown and bounce this plan indefinitely.
+        ev_b = _seed_eval(srv, "job-after")
+        dq_b, tok_b, _ = srv.eval_dequeue(["service"], timeout=1.0)
+        res_b = srv.plan_queue.enqueue(
+            _place_plan(dq_b.id, tok_b, node2.id, 2000,
+                        srv.raft.applied_index)
+        ).wait(timeout=5.0)
+        assert res_b.refresh_index == 0 and res_b.node_allocation, \
+            "fresh raft state invisible: stale optimistic snapshot pinned"
+    finally:
+        srv.shutdown()
+
+
+def test_batch_commits_carry_distinct_real_indices():
+    """Regression: the commit-footprint log must record each of a
+    batch's K commits at its OWN raft index (fixed up to the real index
+    once the apply resolves), not all K at the same applied_index + 1 —
+    identical indices break the reversed scan's early-exit and
+    under-attribute conflicts for snapshots taken mid-batch."""
+    srv = Server(ServerConfig(scheduler_backend="host", num_schedulers=0))
+    srv.plan_queue.set_enabled(True)
+    srv.eval_broker.set_enabled(True)
+    try:
+        nodes = []
+        for _ in range(2):
+            n = mock.node()
+            n.resources.cpu = 4000
+            srv.raft.apply("node_register", {"node": n})
+            nodes.append(n)
+        ev_a = _seed_eval(srv, "job-i1")
+        ev_b = _seed_eval(srv, "job-i2")
+        dq_a, tok_a, _ = srv.eval_dequeue(["service"], timeout=1.0)
+        dq_b, tok_b, _ = srv.eval_dequeue(["service"], timeout=1.0)
+        toks = {dq_a.id: tok_a, dq_b.id: tok_b}
+        snap_index = srv.raft.applied_index
+        # Disjoint nodes: both whole-commit in one batch.
+        pend_a = srv.plan_queue.enqueue(
+            _place_plan(dq_a.id, toks[dq_a.id], nodes[0].id, 500,
+                        snap_index))
+        pend_b = srv.plan_queue.enqueue(
+            _place_plan(dq_b.id, toks[dq_b.id], nodes[1].id, 500,
+                        snap_index))
+        srv.plan_applier.start()
+        res_a = pend_a.wait(timeout=5.0)
+        res_b = pend_b.wait(timeout=5.0)
+        assert res_a.node_allocation and res_b.node_allocation
+        assert res_a.alloc_index != res_b.alloc_index
+        logged = {idx: touched
+                  for idx, touched in srv.plan_applier._commit_log}
+        assert logged == {
+            res_a.alloc_index: {nodes[0].id},
+            res_b.alloc_index: {nodes[1].id},
+        }
+    finally:
+        srv.shutdown()
+
+
+# -- the scheduler_workers knob ----------------------------------------------
+
+
+def test_scheduler_workers_validation_and_alias():
+    with pytest.raises(ValueError):
+        ServerConfig(scheduler_workers=-1)
+    with pytest.raises(ValueError):
+        ServerConfig(scheduler_workers=1000)
+    with pytest.raises(ValueError):
+        ServerConfig(scheduler_workers="four")
+    with pytest.raises(ValueError):
+        ServerConfig(plan_batch_size=0)
+    # Legacy alias wins when set; both spellings read resolved.
+    cfg = ServerConfig(num_schedulers=1)
+    assert cfg.scheduler_workers == 1 and cfg.num_schedulers == 1
+    cfg = ServerConfig(scheduler_workers=6)
+    assert cfg.num_schedulers == 6
+    # Default posture: N >= 4 concurrent workers.
+    assert ServerConfig().scheduler_workers >= 4
+
+
+def test_scheduler_workers_agent_config_knob():
+    from nomad_tpu.agent_config import parse_config
+
+    cfg = parse_config('server { enabled = true\n scheduler_workers = 8 }')
+    assert cfg.server.scheduler_workers == 8
+    with pytest.raises(ValueError):
+        parse_config('server { scheduler_workers = 500 }')
+    # The legacy spelling must not bypass the range check — neither at
+    # parse time nor through the agent's post-construction override.
+    with pytest.raises(ValueError):
+        parse_config('server { num_schedulers = 500 }')
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    bad = AgentConfig.dev()
+    bad.num_schedulers = 200
+    with pytest.raises(ValueError):
+        Agent(bad)
+    # merge: later file overrides
+    base = parse_config('server { scheduler_workers = 2 }')
+    over = parse_config('server { scheduler_workers = 8 }')
+    assert base.merge(over).server.scheduler_workers == 8
+
+
+def test_started_server_spawns_configured_workers():
+    srv = Server(ServerConfig(scheduler_backend="host",
+                              scheduler_workers=5))
+    try:
+        srv.start()
+        assert len(srv.workers) == 5
+        assert all(w.is_alive() for w in srv.workers)
+    finally:
+        srv.shutdown()

@@ -284,6 +284,7 @@ def test_submit_plan_refresh_covers_own_commit(srv):
             return PlanResult(refresh_index=3, alloc_index=9)
 
         state_store = srv.state_store
+        raft = srv.raft  # refresh re-stamps the transaction timestamp
 
     class FakeWorker:
         server = FakeServer
