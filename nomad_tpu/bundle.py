@@ -38,6 +38,7 @@ set. Paths (cert/key files) are locations, not credentials, and stay.
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 import traceback
@@ -50,8 +51,13 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 # value is then None or an {"error": ...} stub, never absent).
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
-    "faults", "breaker", "mirror", "plan_pipeline", "threads",
+    "faults", "breaker", "mirror", "plan_pipeline", "nomadlint", "threads",
 )
+
+# Every `python -m tools.nomadlint` run writes its full report here; the
+# bundle embeds it so a red tier-1 run records what the static gate saw
+# without re-running the analysis in-process.
+NOMADLINT_REPORT_PATH = "/tmp/nomadlint_report.json"
 
 _SECRET_MARKERS = ("token", "secret", "password")
 
@@ -168,6 +174,23 @@ def _plan_pipeline_section() -> Dict[str, Any]:
     return PIPELINE_TOTALS.stats()
 
 
+def _nomadlint_section() -> Optional[Dict[str, Any]]:
+    """Most recent nomadlint report, if a gate run left one. None (not an
+    error) when no lint run happened on this host — the section is about
+    provenance, and an absent report is a fact worth recording as such."""
+    import os
+
+    try:
+        with open(NOMADLINT_REPORT_PATH) as f:
+            report = json.load(f)
+        mtime = os.path.getmtime(NOMADLINT_REPORT_PATH)
+    except (OSError, ValueError):
+        return None
+    # mtime + the report's own repo/generated_at stamps let a reader
+    # detect a stale or foreign report (the path is host-global).
+    return {"path": NOMADLINT_REPORT_PATH, "mtime": mtime, "report": report}
+
+
 def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
     """Build the bundle. ``agent`` is a live nomad_tpu.agent.Agent for the
     full capture; None collects the process-local subset (metrics/faults/
@@ -176,6 +199,8 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
 
     bundle: Dict[str, Any] = {
         "format": BUNDLE_FORMAT,
+        # nomadlint: allow(DET002) -- user-facing capture timestamp on
+        # an operator artifact; never used in interval arithmetic.
         "captured_at": time.time(),
         "metrics": None,
         "traces": None,
@@ -185,6 +210,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "breaker": None,
         "mirror": None,
         "plan_pipeline": None,
+        "nomadlint": None,
         "threads": None,
     }
     for section, build in (
@@ -195,6 +221,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("breaker", _breaker_section),
         ("mirror", _mirror_section),
         ("plan_pipeline", _plan_pipeline_section),
+        ("nomadlint", _nomadlint_section),
         ("threads", thread_stacks),
     ):
         # One wedged subsystem must not cost the whole flight recording.

@@ -87,6 +87,9 @@ class Event:
         self.type = etype
         self.key = key
         self.raft_index = raft_index
+        # nomadlint: allow(DET002) -- user-facing event timestamp served
+        # over /v1/event/stream and compared across processes; latency
+        # math on it (scenario.py) accepts NTP-step noise by design.
         self.time = time.time()
         self.emitter = emitter
         self.payload = payload or {}

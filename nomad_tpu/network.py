@@ -9,9 +9,10 @@ folds in dense bandwidth feasibility (SURVEY.md §7 "Hard parts").
 from __future__ import annotations
 
 import ipaddress
-import random
+from random import Random
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from nomad_tpu import prng
 from nomad_tpu.structs import Allocation, NetworkResource, Node
 
 MIN_DYNAMIC_PORT = 20000
@@ -23,11 +24,23 @@ class NetworkIndex:
     """Indexes available vs used network resources on one node
     (reference: network.go:21-37)."""
 
-    def __init__(self) -> None:
+    def __init__(self, rng: Optional[Random] = None) -> None:
         self.avail_networks: List[NetworkResource] = []
         self.avail_bandwidth: Dict[str, int] = {}
         self.used_ports: Dict[str, Set[int]] = {}
         self.used_bandwidth: Dict[str, int] = {}
+        # Dynamic-port draw stream (port choices land in allocs — a
+        # decision path, nomadlint DET001). Callers that draw ports MUST
+        # pass their per-eval stream (EvalContext.prng): two evals whose
+        # snapshots cannot see each other must not pick the same ports on
+        # a shared node, or every optimistic/stale-snapshot placement
+        # collides at plan verification and bounces. Without ``rng`` the
+        # fallback is a node-salted stream built lazily at the first draw
+        # — deterministic, and safe only for draw-free consumers
+        # (allocs_fit collision checks, which never pay for seeding).
+        self._rng: Optional[Random] = rng
+        self._rng_external = rng is not None
+        self._node_salt = 0
 
     def overcommitted(self) -> bool:
         """Bandwidth overcommit check (network.go:39-48)."""
@@ -40,6 +53,9 @@ class NetworkIndex:
         """Set up available networks from the node; returns True on
         collision (network.go:50-70)."""
         collide = False
+        if not self._rng_external:
+            self._rng = None
+            self._node_salt = prng.salt(node.id)
         if node.resources is not None:
             for n in node.resources.networks:
                 if n.device:
@@ -118,12 +134,16 @@ class NetworkIndex:
                 offered=True,
             )
 
+            if ask.dynamic_ports and self._rng is None:
+                self._rng = prng.stream(
+                    self._node_salt, "network.dynamic_ports"
+                )
             for _ in range(len(ask.dynamic_ports)):
                 for attempt_num in range(MAX_RAND_PORT_ATTEMPTS + 1):
                     if attempt_num == MAX_RAND_PORT_ATTEMPTS:
                         err = "dynamic port selection failed"
                         return False
-                    rand_port = MIN_DYNAMIC_PORT + random.randrange(
+                    rand_port = MIN_DYNAMIC_PORT + self._rng.randrange(
                         MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT
                     )
                     if rand_port in used_ports:

@@ -43,7 +43,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from nomad_tpu import faults
+from nomad_tpu import faults, telemetry
 from nomad_tpu.raft.log_codec import decode_payload, encode_payload
 from nomad_tpu.rpc import ConnPool, RPCError, RPCServer, RemoteError
 
@@ -400,6 +400,11 @@ class RaftNode:
                 data = base64.b64decode(snap["data"])
                 self.fsm.restore_bytes(data)
             except Exception:
+                # Restore failures of ANY kind fall through to the older
+                # retained copy (that is what retain=2 is for) — but a
+                # skipped snapshot is forensic gold after a bad restart,
+                # so it counts, not just logs (nomadlint EXC001).
+                telemetry.incr_counter(("raft", "snapshot_restore_failed"))
                 self.logger.warning("raft: skipping unreadable snapshot %s", path)
                 continue
             self.snapshot_index = snap["index"]
@@ -437,6 +442,10 @@ class RaftNode:
     # -- helpers ------------------------------------------------------------
 
     def _random_deadline(self) -> float:
+        # nomadlint: allow(DET001) -- election-timeout jitter is liveness
+        # randomization (split-vote avoidance, raft §5.2), not a placement
+        # decision: replay determinism never depends on which replica wins
+        # an election, and seeding it per-node would correlate restarts.
         return time.monotonic() + random.uniform(
             self.config.election_timeout_min, self.config.election_timeout_max
         )
@@ -798,6 +807,11 @@ class RaftNode:
                     )
                 error = None
             except Exception as e:  # deterministic FSM error
+                # Counted because the error is SWALLOWED for entries
+                # nobody holds a future for (replicated followers): a
+                # silently diverging FSM would otherwise leave zero
+                # evidence (nomadlint EXC001).
+                telemetry.incr_counter(("raft", "fsm_apply_error"))
                 error = e
             self.last_applied = index
             future = self._apply_futures.pop(index, None)

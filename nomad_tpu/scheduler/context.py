@@ -9,7 +9,10 @@ plus planned placements.
 from __future__ import annotations
 
 import logging
+from random import Random
 from typing import Dict, List, Optional, Pattern
+
+from nomad_tpu import prng
 
 from nomad_tpu.structs import (
     Allocation,
@@ -30,6 +33,19 @@ class EvalContext:
         self._metrics = AllocMetric()
         self.regexp_cache: Dict[str, Pattern] = {}
         self.constraint_cache: Dict[str, object] = {}
+        self._prngs: Dict[str, Random] = {}
+
+    def prng(self, name: str) -> Random:
+        """Name-salted seeded stream scoped to THIS evaluation (the
+        faults.py pattern, nomadlint DET001): seeded from the eval id so
+        two workers' concurrent evals draw independently, salted by
+        ``name`` so two sites inside one eval never share a cursor."""
+        rng = self._prngs.get(name)
+        if rng is None:
+            rng = self._prngs[name] = prng.stream(
+                prng.salt(self._plan.eval_id), name
+            )
+        return rng
 
     @property
     def state(self):

@@ -10,8 +10,8 @@ differential-tested against, and handles the rare data-dependent cases
 
 from __future__ import annotations
 
-import random
 import re
+from random import Random
 from typing import Dict, List, Optional, Set, Tuple
 
 from nomad_tpu.scheduler.context import EvalContext
@@ -27,9 +27,14 @@ from nomad_tpu.structs import (
 from nomad_tpu.version import check_version_constraint
 
 
-def shuffle_nodes(nodes: List[Node]) -> None:
-    """In-place Fisher-Yates (reference: scheduler/util.go:257-263)."""
-    random.shuffle(nodes)
+def shuffle_nodes(nodes: List[Node], rng: Random) -> None:
+    """In-place Fisher-Yates (reference: scheduler/util.go:257-263).
+
+    ``rng`` is the caller's name-salted seeded stream (EvalContext.prng)
+    — the shuffle exists to decorrelate concurrent schedulers, and a
+    per-eval seeded stream does that without coupling the decision to
+    the process-global random cursor (nomadlint DET001)."""
+    rng.shuffle(nodes)
 
 
 class StaticIterator:
@@ -66,7 +71,7 @@ class StaticIterator:
 
 def new_random_iterator(ctx: EvalContext, nodes: List[Node]) -> StaticIterator:
     """Shuffled StaticIterator (reference: feasible.go:74-83)."""
-    shuffle_nodes(nodes)
+    shuffle_nodes(nodes, ctx.prng("feasible.shuffle"))
     return StaticIterator(ctx, nodes)
 
 

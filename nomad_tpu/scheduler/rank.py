@@ -115,8 +115,10 @@ class BinPackIterator:
 
             proposed = option.proposed_allocs(self.ctx)
 
-            # Index existing network usage
-            net_idx = NetworkIndex()
+            # Index existing network usage. Port draws ride THIS eval's
+            # seeded stream: concurrent evals with stale snapshots must
+            # draw independently (see NetworkIndex.__init__).
+            net_idx = NetworkIndex(self.ctx.prng("network.dynamic_ports"))
             net_idx.set_node(option.node)
             net_idx.add_allocs(proposed)
 

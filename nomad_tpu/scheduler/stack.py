@@ -65,7 +65,7 @@ class GenericStack:
         self.max_score = MaxScoreIterator(ctx, self.limit)
 
     def set_nodes(self, base_nodes: List[Node]) -> None:
-        shuffle_nodes(base_nodes)
+        shuffle_nodes(base_nodes, self.ctx.prng("feasible.shuffle"))
         self.source.set_nodes(base_nodes)
         # Power-of-two-choices: batch inspects 2 nodes, service ~log2(n)
         # (stack.go:109-121)

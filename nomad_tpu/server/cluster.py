@@ -794,13 +794,21 @@ class ClusterServer(Server):
     def forward_region(self, region: str, method: str, args: dict):
         """RPC to any server of another region (rpc.go:204-228
         forwardRegion picks a random server from the region table)."""
-        import random as _random
+        from nomad_tpu import prng
 
         members = self.region_peers.get(region)
         if not members:
             raise RPCError(f"no path to region {region!r}")
         addrs = list(members.values())
-        _random.shuffle(addrs)
+        # Load-spreading shuffle over region servers; a per-instance
+        # name-salted stream decorrelates successive forwards without
+        # the global random cursor (nomadlint DET001).
+        rng = getattr(self, "_region_rng", None)
+        if rng is None:
+            rng = self._region_rng = prng.stream(
+                prng.salt(self.config.node_name), "cluster.forward_region"
+            )
+        rng.shuffle(addrs)
         last: Optional[Exception] = None
         for addr in addrs:
             try:

@@ -34,6 +34,9 @@ class CoreScheduler:
         """Reap terminal evals (and their allocs) older than the GC
         threshold, when every alloc is terminal (core_sched.go:42-101)."""
         threshold = self.server.config.eval_gc_threshold
+        # nomadlint: allow(DET002) -- compared against TimeTable's
+        # persisted WALL stamps (survive restarts); monotonic clocks
+        # don't span processes.
         oldest = time.time() - threshold
         old_index = self.server.time_table.nearest_index(oldest)
 
@@ -61,6 +64,8 @@ class CoreScheduler:
         """Reap down nodes with no non-terminal allocs
         (core_sched.go:103-188)."""
         threshold = self.server.config.node_gc_threshold
+        # nomadlint: allow(DET002) -- same wall-stamp comparison as
+        # _eval_gc above.
         oldest = time.time() - threshold
         old_index = self.server.time_table.nearest_index(oldest)
 

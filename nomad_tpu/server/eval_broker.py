@@ -23,12 +23,11 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import random
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from nomad_tpu import faults, telemetry, trace
+from nomad_tpu import faults, prng, telemetry, trace
 from nomad_tpu.structs import Evaluation, generate_uuid
 
 FAILED_QUEUE = "_failed"
@@ -104,7 +103,8 @@ class _UnackEval:
 class EvalBroker:
     """At-least-once evaluation broker (reference: eval_broker.go:43-111)."""
 
-    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3):
+    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
+                 seed: int = 0):
         if nack_timeout < 0:
             raise ValueError("timeout cannot be negative")
         import logging as _logging
@@ -112,6 +112,10 @@ class EvalBroker:
         self.logger = _logging.getLogger("nomad_tpu.eval_broker")
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        # Scheduler-queue tie-break stream: seeded per broker (name-salted,
+        # the faults.py pattern) so the choice among equal-priority queues
+        # never couples to the process-global random cursor.
+        self._rng = prng.stream(seed, "broker.scheduler_choice")
         self._enabled = False
         self._lock = threading.Lock()
         self._work_available = threading.Condition(self._lock)
@@ -342,7 +346,7 @@ class EvalBroker:
 
         if not eligible:
             return None
-        sched = eligible[0] if len(eligible) == 1 else random.choice(eligible)
+        sched = eligible[0] if len(eligible) == 1 else self._rng.choice(eligible)
         return self._dequeue_for_sched(sched)
 
     def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:

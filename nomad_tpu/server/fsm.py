@@ -18,9 +18,12 @@ import pickle
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, TYPE_CHECKING
 
 from nomad_tpu import faults, telemetry, trace
+
+if TYPE_CHECKING:  # injected collaborator; import would be circular
+    from nomad_tpu.server.eval_broker import EvalBroker
 from nomad_tpu.events import EventBroker
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import Allocation, Evaluation, Job, Node
@@ -32,7 +35,7 @@ class FSM:
 
     def __init__(
         self,
-        eval_broker=None,
+        eval_broker: Optional["EvalBroker"] = None,
         logger: Optional[logging.Logger] = None,
         events: Optional[EventBroker] = None,
     ):

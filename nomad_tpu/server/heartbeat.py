@@ -27,12 +27,11 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import random
 import threading
 import time
 from typing import Dict, List, Tuple
 
-from nomad_tpu import faults, telemetry
+from nomad_tpu import faults, prng, telemetry
 from nomad_tpu.structs import NODE_STATUS_DOWN
 
 
@@ -130,7 +129,13 @@ class HeartbeatManager:
         ttl = rate_scaled_interval(
             cfg.max_heartbeats_per_second, cfg.min_heartbeat_ttl, others,
         )
-        ttl += random.uniform(0, ttl)  # jitter like the reference
+        # Jitter like the reference, but deterministic: the jitter exists
+        # to spread NODES apart (decorrelate beat storms), which a
+        # name-salted hash fraction does without a PRNG cursor — the
+        # grant for a node is a pure function of (seed, node).
+        ttl += ttl * prng.fraction(
+            "heartbeat.jitter", cfg.seed, node_id,
+        )
         gen = next(self._gen)
         entry = _Entry(node_id, time.monotonic() + ttl, ttl, gen)
         self._timers[node_id] = entry

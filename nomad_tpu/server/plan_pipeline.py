@@ -379,6 +379,7 @@ class PlanPipeline(threading.Thread):
             try:
                 self._process_batch(batch)
             except Exception as e:  # never leak blocked workers
+                telemetry.incr_counter(("plan", "pipeline", "batch_failure"))
                 self.logger.exception("plan pipeline batch failed")
                 for pending in batch:
                     if not pending.future.done():
@@ -392,7 +393,13 @@ class PlanPipeline(threading.Thread):
                         try:
                             self.eval_broker.plan_done(pending.plan.eval_id)
                         except Exception:
-                            pass
+                            # plan_done is a lock-guarded decrement; a
+                            # failure here means broker state is already
+                            # torn down — count it and keep failing the
+                            # remaining futures (nomadlint EXC001).
+                            telemetry.incr_counter(
+                                ("plan", "pipeline", "plan_done_error")
+                            )
 
     def _process_batch(self, batch: List[PendingPlan]) -> None:
         tracer = trace.get_tracer()
@@ -526,7 +533,12 @@ class PlanPipeline(threading.Thread):
             try:
                 f.result()
             except Exception:
-                pass
+                # The failure was already delivered to ITS plan's worker
+                # by the waiter thread; here the future is only drained
+                # for the single-overlap staleness bound. Still counted:
+                # a quietly failing apply stream is a sick raft layer
+                # (nomadlint EXC001).
+                telemetry.incr_counter(("plan", "pipeline", "apply_error"))
         self._inflight = []
 
         dispatched = []

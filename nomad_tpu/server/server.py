@@ -62,6 +62,11 @@ class ServerConfig:
     # How many pending plans the pipeline drains and verifies per fused
     # batch pass (plan_pipeline.py). 1 degenerates to the serial applier.
     plan_batch_size: int = 8
+    # Seed for the server's name-salted decision-path PRNG streams
+    # (broker scheduler choice, heartbeat jitter — nomad_tpu.prng). The
+    # simcluster scenario runner stamps its run seed here so replays
+    # draw identically.
+    seed: int = 0
     enabled_schedulers: List[str] = field(
         default_factory=lambda: [
             structs.JOB_TYPE_SERVICE,
@@ -141,7 +146,8 @@ class Server:
         self.logger = logger or logging.getLogger("nomad_tpu.server")
 
         self.eval_broker = EvalBroker(
-            self.config.eval_nack_timeout, self.config.eval_delivery_limit
+            self.config.eval_nack_timeout, self.config.eval_delivery_limit,
+            seed=self.config.seed,
         )
         self.fsm = FSM(
             eval_broker=self.eval_broker, logger=self.logger,

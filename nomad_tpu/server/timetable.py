@@ -31,6 +31,9 @@ class TimeTable:
         """Record (index, time), coalescing within granularity
         (timetable.go Witness)."""
         if when is None:
+            # nomadlint: allow(DET002) -- the table IS the raft-index ->
+            # wall-clock mapping and serializes across restarts; a
+            # monotonic stamp would be meaningless in the next process.
             when = time.time()
         with self._lock:
             if self._table and when - self._table[-1][0] < self.granularity:

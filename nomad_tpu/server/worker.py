@@ -179,7 +179,10 @@ class Worker(threading.Thread):
                 except Exception as e:
                     # Transient forwarding failure (follower -> leader blip):
                     # keep trying — one miss must not disable the keep-alive
-                    # for the rest of a long solve.
+                    # for the rest of a long solve. Counted so a touch loop
+                    # that NEVER succeeds shows up in metrics, not just a
+                    # debug log (nomadlint EXC001).
+                    telemetry.incr_counter(("worker", "touch_error"))
                     self.logger.debug(
                         "eval touch failed for %s (retrying): %s", ev.id, e
                     )
@@ -222,6 +225,7 @@ class Worker(threading.Thread):
             return None
         except Exception as e:
             # Transient cluster conditions (no leader yet, forwarding error)
+            telemetry.incr_counter(("worker", "dequeue_error"))
             self.logger.debug("dequeue failed, retrying: %s", e)
             self._dequeue_backoff.sleep(stop=self._stop)
             return None
@@ -243,6 +247,7 @@ class Worker(threading.Thread):
             self._dequeue_backoff.sleep(stop=self._stop)
             return []
         except Exception as e:
+            telemetry.incr_counter(("worker", "dequeue_error"))
             self.logger.debug("batch dequeue failed, retrying: %s", e)
             self._dequeue_backoff.sleep(stop=self._stop)
             return []
@@ -264,6 +269,12 @@ class Worker(threading.Thread):
             else:
                 self.server.eval_nack(eval_id, token)
         except Exception as e:
+            # Best-effort, but an ack that never lands re-delivers the
+            # eval after nack_timeout — count it so a systematically
+            # failing ack path alarms (nomadlint EXC001).
+            telemetry.incr_counter(
+                ("worker", "send_ack_error" if ack else "send_nack_error")
+            )
             self.logger.error(
                 "failed to %s evaluation '%s': %s", "ack" if ack else "nack",
                 eval_id, e,
@@ -320,6 +331,10 @@ class Worker(threading.Thread):
             telemetry.measure_since(("worker", "invoke_scheduler", ev.type), start)
             return True
         except Exception:
+            # The eval is nack'd by the caller (at-least-once redelivery),
+            # but a scheduler crash is the highest-signal failure a worker
+            # can see — counted per eval type (nomadlint EXC001).
+            telemetry.incr_counter(("worker", "scheduler_failure", ev.type))
             self.logger.exception("failed to process evaluation %s", ev.id)
             return False
 

@@ -335,6 +335,11 @@ class ScenarioRunner:
         cfg_kwargs = dict(
             scheduler_backend="tpu", scheduler_workers=4, eval_batch_size=4,
             prewarm_shapes=False, periodic_dispatch=False,
+            # The run seed feeds the server's name-salted decision-path
+            # streams (broker scheduler choice, heartbeat jitter): a
+            # replay with the same seed draws identically, a different
+            # seed decorrelates (nomad_tpu.prng).
+            seed=self.seed,
         )
         cfg_kwargs.update(spec.server_overrides)
         cfg = ServerConfig(**cfg_kwargs)
@@ -497,6 +502,9 @@ class ScenarioRunner:
             snap = srv.state_store.snapshot()
             if down_needed:
                 down_needed = {
+                    # nomadlint: allow(DET003) -- order-independent
+                    # filter: the result set is only len()/emptiness
+                    # checked.
                     nid for nid in down_needed
                     if (snap.node_by_id(nid) is not None
                         and snap.node_by_id(nid).status

@@ -119,6 +119,9 @@ class StoredAllocBlock(AllocBatch):
             return
         pos = 0
         for nid, cnt in zip(self.node_ids, self.node_counts):
+            # nomadlint: allow(DET003) -- commutative membership count
+            # (sum of 1s): the iteration order of the set cannot change
+            # the result.
             live = cnt - sum(1 for p in self.excluded if pos <= p < pos + cnt)
             if live:
                 yield nid, live

@@ -773,10 +773,11 @@ def _apply_update_batches(t: _Tables, index: int, batches,
         # batch-wide: every row of a batch shares its eval id, and job
         # ids collapse to one unless b.job was None.
         items.extend(
-            item_alloc_job(j) for j in {r.job_id for r in stamped_rows}
+            item_alloc_job(j) for j in sorted({r.job_id for r in stamped_rows})
         )
         items.extend(
-            item_alloc_eval(e) for e in {r.eval_id for r in stamped_rows}
+            item_alloc_eval(e)
+            for e in sorted({r.eval_id for r in stamped_rows})
         )
     if watch is not None:
         if watch.has_waiters_for("alloc_node"):

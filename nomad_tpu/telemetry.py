@@ -22,6 +22,7 @@ import socket
 import sys
 import threading
 import time
+import traceback
 from typing import Dict, List, Optional, Tuple
 
 Key = Tuple[str, ...]
@@ -80,6 +81,8 @@ class AggregateSample:
         self.sum += v
         self.sum_sq += v * v
         self.last = v
+        # nomadlint: allow(DET002) -- display-only last-sample wall
+        # stamp (go-metrics AggregateSample parity); no arithmetic.
         self.last_time = time.time()
         # Algorithm R: after the reservoir fills, sample i survives with
         # probability RESERVOIR_SIZE/i — a uniform sample of the series.
@@ -150,6 +153,9 @@ class InmemSink:
         self._lock = threading.Lock()
 
     def _current(self) -> IntervalMetrics:
+        # nomadlint: allow(DET002) -- interval buckets are wall-aligned
+        # by design (go-metrics inmem.go): dump() strftime's them and
+        # scrapers correlate them across hosts.
         now = time.time()
         start = now - (now % self.interval)
         if self.intervals and self.intervals[-1].interval == start:
@@ -507,3 +513,196 @@ def build_sink(
         sinks.append(inmem)
         return inmem, FanoutSink(sinks)
     return inmem, inmem
+
+
+# ---------------------------------------------------------------------------
+# LockWatchdog: runtime validation of the nomadlint lock-order pass
+# ---------------------------------------------------------------------------
+
+
+class LockOrderViolation:
+    """One observed acquisition that inverts the canonical order."""
+
+    __slots__ = ("held", "acquired", "thread", "stack")
+
+    def __init__(self, held: str, acquired: str, thread: str, stack: str):
+        self.held = held
+        self.acquired = acquired
+        self.thread = thread
+        self.stack = stack
+
+    def __repr__(self) -> str:
+        return (f"LockOrderViolation(held={self.held!r}, "
+                f"acquired={self.acquired!r}, thread={self.thread!r})")
+
+
+class _WatchedLock:
+    """Transparent wrapper around a threading lock that reports
+    acquisitions/releases to a LockWatchdog under one canonical lock id.
+    Reentrant acquires (RLocks, two instances of one lock class) only
+    report the 0->1 transition, mirroring the static model where
+    instances of a class share one graph node."""
+
+    __slots__ = ("_nl_inner", "_nl_wd", "_nl_id")
+
+    def __init__(self, wd: "LockWatchdog", inner, lock_id: str):
+        self._nl_inner = inner
+        self._nl_wd = wd
+        self._nl_id = lock_id
+
+    def acquire(self, *args, **kwargs):
+        got = self._nl_inner.acquire(*args, **kwargs)
+        if got:
+            self._nl_wd._on_acquire(self._nl_id)
+        return got
+
+    def release(self):
+        self._nl_wd._on_release(self._nl_id)
+        return self._nl_inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._nl_inner.locked()
+
+    def __getattr__(self, name):
+        # Condition(wrapped_rlock) binds _is_owned/_release_save/
+        # _acquire_restore straight to the inner lock: ownership state
+        # lives there, and a wait()'s temporary full-release must not
+        # disturb the wrapper's held-stack (the waiting thread acquires
+        # nothing while blocked, so its stack stays consistent).
+        return getattr(self._nl_inner, name)
+
+
+class LockWatchdog:
+    """Debug-mode runtime assertion of the nomadlint lock-order pass.
+
+    ``install()`` patches ``threading.Lock``/``threading.RLock`` so that
+    every lock constructed at a KNOWN construction site (the ``sites``
+    mapping of (repo-relative file, line) -> canonical lock id, produced
+    by ``tools.nomadlint.lockorder.analyze().sites()``) is wrapped with
+    acquisition tracking; locks built anywhere else — stdlib, tests,
+    third-party — are returned raw and untouched. While installed, every
+    tracked acquisition is checked against the canonical acquisition
+    order: acquiring a lock ranked EARLIER than one already held by the
+    same thread records a LockOrderViolation. Tests assert
+    ``violations == []`` after driving a real workload, which validates
+    the statically computed order against real interleavings.
+
+    Test-only by design: wrapping costs a dict lookup + list append per
+    acquisition, and installation is process-global. Use as a context
+    manager around server construction + workload."""
+
+    def __init__(self, order, sites, repo: Optional[str] = None):
+        import os
+
+        self._rank = {lock_id: i for i, lock_id in enumerate(order)}
+        self._sites = {tuple(k): v for k, v in dict(sites).items()}
+        self._repo = os.path.abspath(
+            repo
+            or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        self._tls = threading.local()
+        # Appends/adds below are CPython-atomic; the watchdog deliberately
+        # owns NO lock of its own (it would join the very graph it checks).
+        self.violations: List[LockOrderViolation] = []
+        self._observed: set = set()
+        self._orig = None
+
+    # -- wiring --------------------------------------------------------------
+
+    def install(self) -> "LockWatchdog":
+        if self._orig is not None:
+            raise RuntimeError("LockWatchdog already installed")
+        self._orig = (threading.Lock, threading.RLock)
+        threading.Lock = self._factory(self._orig[0])  # type: ignore
+        threading.RLock = self._factory(self._orig[1])  # type: ignore
+        return self
+
+    def uninstall(self) -> None:
+        if self._orig is None:
+            return
+        threading.Lock, threading.RLock = self._orig  # type: ignore
+        self._orig = None
+
+    def __enter__(self) -> "LockWatchdog":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def _factory(self, real):
+        import os
+
+        def build(*args, **kwargs):
+            inner = real(*args, **kwargs)
+            frame = sys._getframe(1)
+            fname = frame.f_code.co_filename
+            if not fname.startswith(self._repo):
+                return inner
+            rel = os.path.relpath(fname, self._repo).replace(os.sep, "/")
+            lock_id = self._sites.get((rel, frame.f_lineno))
+            if lock_id is None:
+                return inner
+            return _WatchedLock(self, inner, lock_id)
+
+        return build
+
+    def watch(self, inner, lock_id: str):
+        """Wrap one explicit lock under ``lock_id`` — the unit-testable
+        path that skips construction-site frame mapping."""
+        return _WatchedLock(self, inner, lock_id)
+
+    # -- tracking ------------------------------------------------------------
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _on_acquire(self, lock_id: str) -> None:
+        held = self._held()
+        rank = self._rank.get(lock_id)
+        for h in held:
+            if h == lock_id:
+                continue  # instance identity is invisible statically
+            self._observed.add((h, lock_id))
+            hr = self._rank.get(h)
+            if hr is not None and rank is not None and hr > rank:
+                self.violations.append(LockOrderViolation(
+                    held=h, acquired=lock_id,
+                    thread=threading.current_thread().name,
+                    stack="".join(traceback.format_stack(limit=12)),
+                ))
+        held.append(lock_id)
+
+    def _on_release(self, lock_id: str) -> None:
+        held = getattr(self._tls, "held", None)
+        if held:
+            # Remove the most recent entry for this id: releases are
+            # typically LIFO, but out-of-order release is legal.
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == lock_id:
+                    del held[i]
+                    break
+
+    # -- results -------------------------------------------------------------
+
+    def observed_edges(self) -> set:
+        """(held, acquired) pairs actually exercised while installed."""
+        return set(self._observed)
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            lines = [f"  {v.held} -> {v.acquired} on {v.thread}"
+                     for v in self.violations]
+            raise AssertionError(
+                "lock-order violations observed:\n" + "\n".join(lines)
+            )

@@ -896,7 +896,11 @@ class NodeMirror:
             touched.update(plan.node_update)
             index_get = self.index.get
             node_iter = []
-            for nid in touched:
+            # sorted: the walk order must be a pure function of the
+            # touched set, not its hash order (nomadlint DET003) — the
+            # accumulation is commutative ints, but the fuzz families
+            # compare intermediate row dirtiness too.
+            for nid in sorted(touched):
                 i = index_get(nid)
                 if i is not None:
                     node_iter.append((i, self.nodes[i]))

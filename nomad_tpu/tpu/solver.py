@@ -392,7 +392,9 @@ class TPUStack:
 
             net_idx = net_indexes.get(idx)
             if net_idx is None:
-                net_idx = NetworkIndex()
+                # Per-eval seeded port stream, like BinPackIterator: stale-
+                # snapshot evals must not collide on a shared node's ports.
+                net_idx = NetworkIndex(self.ctx.prng("network.dynamic_ports"))
                 net_idx.set_node(node)
                 net_idx.add_allocs(self.ctx.proposed_allocs(node.id))
                 net_indexes[idx] = net_idx

@@ -47,6 +47,8 @@ from typing import Any, Dict, List, Optional
 # Monotonic wall clock: epoch-anchored perf_counter, so spans from every
 # thread order consistently (time.time() can step backwards under NTP,
 # which would break the nesting invariants the trace consumers rely on).
+# nomadlint: allow(DET002) -- one-shot wall anchor for the monotonic
+# span clock; sampled exactly once at import, never in span math.
 _EPOCH = time.time() - time.perf_counter()
 
 

@@ -414,6 +414,7 @@ def test_heartbeat_tick_drop_skips_renewal():
     class _Cfg:
         min_heartbeat_ttl = 10.0
         max_heartbeats_per_second = 50.0
+        seed = 0  # feeds the deterministic TTL-jitter fraction
 
     class _Srv:
         config = _Cfg()

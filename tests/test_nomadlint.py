@@ -1,0 +1,454 @@
+"""nomadlint self-tests: fixture snippets per rule (positive, negative,
+and the allow() escape hatch), a lock-graph cycle fixture, the
+LockWatchdog runtime check, and the tier-1 drift gates — the committed
+baseline and lock order must match a fresh run on the current tree, so
+the gate can never silently rot.
+
+Fixtures are tiny fake repos written under tmp_path with the SAME
+directory shape as the real tree (the passes scope by repo-relative
+path: nomad_tpu/scheduler is a decision path, nomad_tpu/raft is a hot
+path, nomad_tpu/tpu is traced code)."""
+
+import textwrap
+import threading
+
+import pytest
+
+from tools.nomadlint import (
+    baseline as baseline_mod,
+    determinism,
+    excepts,
+    lockorder,
+    run_passes,
+    tracehygiene,
+)
+from tools.nomadlint.project import Project
+from tools.nomadlint.registry import Finding, RULES, parse_allow
+
+
+def _project(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Project(repo=str(tmp_path), roots=("nomad_tpu",))
+
+
+def _rules(findings):
+    return [f.rule_id for f in findings]
+
+
+# -- determinism pass --------------------------------------------------------
+
+
+def test_determinism_fixture_positive_and_negative(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/scheduler/fix.py": """\
+            import random
+            import time
+            from random import Random
+
+            def decide(nodes, seed):
+                random.shuffle(nodes)          # DET001: global stream
+                deadline = time.time() + 5     # DET002: wall deadline
+                s = {1, 2, 3}
+                for x in s:                    # DET003: hash order
+                    pass
+                rng = Random(seed)             # negative: seeded stream
+                rng.shuffle(nodes)
+                t0 = time.monotonic()          # negative: monotonic
+                for x in sorted(s):            # negative: sorted set
+                    pass
+                return deadline, t0
+        """,
+    })
+    findings = determinism.run(project)
+    assert sorted(_rules(findings)) == ["DET001", "DET002", "DET003"]
+    by_rule = {f.rule_id: f for f in findings}
+    assert "random.shuffle" in by_rule["DET001"].snippet
+    assert "time.time()" in by_rule["DET002"].snippet
+    assert by_rule["DET003"].snippet == "for x in s:                    # DET003: hash order"
+    # Every finding carries the enclosing qualname for stable baselining.
+    assert all(f.qualname.endswith("fix.decide") for f in findings)
+
+
+def test_determinism_set_attribute_iteration(tmp_path):
+    # The dominant shape in scheduler/server code: a set stored on self
+    # in __init__, iterated in a method. The method's stamped qualname is
+    # the CLASS's dotted name (its enclosing scope) — regression for the
+    # lookup that made this branch dead.
+    project = _project(tmp_path, {
+        "nomad_tpu/server/fix.py": """\
+            class Tracker:
+                def __init__(self):
+                    self.pending = set()
+                    self.done = []
+
+                def drain(self):
+                    for x in self.pending:   # DET003: set attribute
+                        pass
+                    for x in sorted(self.pending):  # negative
+                        pass
+                    for x in self.done:      # negative: list attribute
+                        pass
+        """,
+    })
+    findings = determinism.run(project)
+    assert _rules(findings) == ["DET003"]
+    assert "self.pending" in findings[0].message
+    assert findings[0].qualname.endswith("Tracker.drain")
+
+
+def test_determinism_outside_decision_scope_only_checks_time(tmp_path):
+    # api/ is not a decision path: DET001/DET003 do not apply there, and
+    # it is outside TIME_SCOPE too — no findings at all.
+    project = _project(tmp_path, {
+        "nomad_tpu/api/fix.py": """\
+            import random
+
+            def pick(xs):
+                return random.choice(xs)
+        """,
+    })
+    assert determinism.run(project) == []
+
+
+def test_allow_escape_suppresses_with_reason(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/scheduler/fix.py": """\
+            import random
+
+            def decide(xs):
+                # nomadlint: allow(DET001) -- fixture: sanctioned draw
+                random.shuffle(xs)
+                random.choice(xs)  # nomadlint: allow(DET001)
+                # nomadlint: allow(NOPE999) -- no such rule
+                return xs
+        """,
+    })
+    # Both draws suppressed: one by a comment-line allow above, one by a
+    # trailing same-line allow.
+    assert determinism.run(project) == []
+    # ...but the reasonless allow and the unknown-rule allow are
+    # themselves findings (META001/META002): suppression is never free.
+    meta = _rules(project.meta_findings())
+    assert meta == ["META001", "META002"]
+
+
+def test_allow_reason_parsing():
+    a = parse_allow("x = 1  # nomadlint: allow(DET001, DET002) -- why", 7)
+    assert a.rules == ("DET001", "DET002")
+    assert a.reason == "why" and a.line == 7
+    a = parse_allow("# nomadlint: allow(EXC001)", 3)
+    assert a.rules == ("EXC001",) and a.reason is None
+    assert parse_allow("# plain comment", 1) is None
+
+
+# -- exception-hygiene pass --------------------------------------------------
+
+
+def test_excepts_fixture(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/raft/fix.py": """\
+            from nomad_tpu import telemetry
+
+            def hot(fut):
+                try:
+                    pass
+                except Exception:      # EXC001: silently eaten
+                    pass
+                try:
+                    pass
+                except:                # EXC002: bare
+                    pass
+                try:
+                    pass
+                except Exception:      # negative: re-raises
+                    raise
+                try:
+                    pass
+                except Exception as e:  # negative: propagates into future
+                    fut.set_exception(e)
+                try:
+                    pass
+                except Exception:      # negative: counts telemetry
+                    telemetry.incr_counter(("raft", "x"))
+                try:
+                    pass
+                except ValueError:     # negative: typed
+                    pass
+        """,
+    })
+    assert sorted(_rules(excepts.run(project))) == ["EXC001", "EXC002"]
+
+
+def test_excepts_ignores_cold_modules(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/api/fix.py": """\
+            def cold():
+                try:
+                    pass
+                except Exception:
+                    pass
+        """,
+    })
+    assert excepts.run(project) == []
+
+
+# -- trace-hygiene pass ------------------------------------------------------
+
+
+def test_tracehygiene_fixture(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/tpu/fix.py": """\
+            import functools
+
+            import jax
+
+            TABLE = {}
+
+            def grow():
+                TABLE["k"] = 1
+
+            @jax.jit
+            def bad_branch(x):
+                if x > 0:              # TRC001: traced branch
+                    return x
+                return -x
+
+            @jax.jit
+            def reads_state(x):
+                return x + TABLE["k"]  # TRC003: mutated module state
+
+            @jax.jit
+            def ok_shape(x):
+                if x.shape[0] > 2:     # negative: shape-level
+                    return x
+                return x
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def with_static(x, n):
+                for i in range(n):     # negative: n is static
+                    x = x + 1
+                return x
+
+            def call_site(x):
+                return with_static(x, [1, 2])  # TRC002: unhashable static
+        """,
+    })
+    assert sorted(_rules(tracehygiene.run(project))) == [
+        "TRC001", "TRC002", "TRC003",
+    ]
+
+
+# -- lock-order pass ---------------------------------------------------------
+
+
+def test_lock_graph_cycle_fixture(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/server/fixlocks.py": """\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ab():
+                with A:
+                    with B:
+                        pass
+
+            def ba():
+                with B:
+                    with A:
+                        pass
+        """,
+    })
+    an = lockorder.analyze(project)
+    a = "nomad_tpu.server.fixlocks.A"
+    b = "nomad_tpu.server.fixlocks.B"
+    assert (a, b) in an.edges and (b, a) in an.edges
+    assert [a, b] in an.cycles
+    # run() reports the cycle as LCK001 (plus LCK003: the real repo's
+    # committed order naturally doesn't describe this fixture tree).
+    assert "LCK001" in _rules(lockorder.run(project))
+
+
+def test_lock_graph_order_edges_and_condition_alias(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/server/fixlocks.py": """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+                    self._inner = threading.Lock()
+
+                def outerwork(self):
+                    with self._cv:       # acquires _lock via the alias
+                        self.helper()
+
+                def helper(self):
+                    with self._inner:    # transitive: _lock -> _inner
+                        pass
+        """,
+    })
+    an = lockorder.analyze(project)
+    c = "nomad_tpu.server.fixlocks.C"
+    assert an.aliases[f"{c}._cv"] == f"{c}._lock"
+    assert (f"{c}._lock", f"{c}._inner") in an.edges
+    assert an.cycles == []
+    # Canonical order respects the edge.
+    assert an.order.index(f"{c}._lock") < an.order.index(f"{c}._inner")
+    # sites(): construction lines resolve to canonical ids (the alias
+    # collapses onto its backing lock) — the LockWatchdog's runtime map.
+    sites = an.sites()
+    assert set(sites.values()) == {f"{c}._lock", f"{c}._inner"}
+
+
+def test_lock_order_inversion_against_committed(tmp_path, monkeypatch):
+    project = _project(tmp_path, {
+        "nomad_tpu/server/fixlocks.py": """\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ab():
+                with A:
+                    with B:
+                        pass
+        """,
+    })
+    a = "nomad_tpu.server.fixlocks.A"
+    b = "nomad_tpu.server.fixlocks.B"
+    committed = {"order": [b, a], "edges": [[b, a]], "aliases": {}}
+    monkeypatch.setattr(lockorder, "load_committed",
+                        lambda path=None: committed)
+    findings = lockorder.run(project)
+    inv = [f for f in findings if f.rule_id == "LCK002"]
+    assert len(inv) == 1
+    assert f"{a} -> {b}" in inv[0].message
+
+
+# -- baseline semantics ------------------------------------------------------
+
+
+def test_baseline_compare_new_and_stale():
+    f = Finding("DET001", "nomad_tpu/x.py", 10, "x.f", "msg", snippet="s")
+    g = Finding("DET001", "nomad_tpu/x.py", 99, "x.f", "msg", snippet="s")
+    # Identity excludes the line number: g is the same finding moved.
+    assert f.key() == g.key()
+    new, stale = baseline_mod.compare([f], {f.key(): 1})
+    assert new == [] and stale == []
+    # Two occurrences against a budget of one: the second is NEW.
+    new, stale = baseline_mod.compare([f, g], {f.key(): 1})
+    assert new == [g] and stale == []
+    # A fixed finding leaves a stale row that must be pruned.
+    new, stale = baseline_mod.compare([], {f.key(): 1})
+    assert new == [] and stale == [f.key()]
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = Finding("EXC001", "nomad_tpu/y.py", 3, "y.g", "msg", snippet="t")
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.save([f, f], path)
+    assert baseline_mod.load(path) == {f.key(): 2}
+
+
+# -- LockWatchdog (runtime half of the lockorder pass) -----------------------
+
+
+def test_lock_watchdog_clean_and_inversion():
+    from nomad_tpu.telemetry import LockWatchdog
+
+    wd = LockWatchdog(order=["m.A", "m.B"], sites={})
+    a = wd.watch(threading.Lock(), "m.A")
+    b = wd.watch(threading.Lock(), "m.B")
+    with a:
+        with b:
+            pass
+    wd.assert_clean()
+    assert ("m.A", "m.B") in wd.observed_edges()
+    with b:
+        with a:  # inverts the canonical order
+            pass
+    assert len(wd.violations) == 1
+    v = wd.violations[0]
+    assert (v.held, v.acquired) == ("m.B", "m.A")
+    with pytest.raises(AssertionError, match="m.B -> m.A"):
+        wd.assert_clean()
+
+
+def test_lock_watchdog_install_wraps_only_known_sites(tmp_path):
+    from nomad_tpu.telemetry import LockWatchdog, _WatchedLock
+
+    src = tmp_path / "fixmod.py"
+    src.write_text("import threading\n"
+                   "def build():\n"
+                   "    return threading.Lock(), threading.Lock()\n")
+    ns = {}
+    exec(compile(src.read_text(), str(src), "exec"), ns)
+    wd = LockWatchdog(
+        order=["fix.L"], sites={("fixmod.py", 3): "fix.L"},
+        repo=str(tmp_path),
+    )
+    with wd:
+        known, _also_line3 = ns["build"]()
+        unknown = threading.Lock()  # this test file: not a known site
+    assert isinstance(known, _WatchedLock)
+    assert not isinstance(unknown, _WatchedLock)
+    with known:
+        pass
+    assert threading.Lock is not None  # uninstalled cleanly
+    assert wd.violations == []
+
+
+# -- tier-1 drift gates: the committed artifacts match a fresh run -----------
+
+
+@pytest.fixture(scope="module")
+def real_project():
+    project = Project()
+    assert not project.errors
+    return project
+
+
+def test_tree_clean_against_committed_baseline(real_project):
+    """The gate tier-1 enforces: a fresh run over the current tree has
+    zero findings outside the committed baseline AND zero stale baseline
+    rows — any drift is an explicit decision (--write-baseline), never an
+    accident."""
+    findings = run_passes(real_project)
+    new, stale = baseline_mod.compare(findings, baseline_mod.load())
+    assert not new, "new findings:\n" + "\n".join(f.render() for f in new)
+    assert not stale, f"stale baseline rows: {stale}"
+
+
+def test_committed_lock_order_matches_fresh_analysis(real_project):
+    an = lockorder.analyze(real_project)
+    assert an.cycles == [], f"lock-order cycles: {an.cycles}"
+    assert lockorder.load_committed() == lockorder.committed_payload(an), \
+        "lock_order.json drifted — regenerate with --write-lock-order"
+    # The watchdog's runtime map is live: every construction site the
+    # static pass found exists at the recorded line and builds a lock.
+    import os
+    for (rel, line), lock_id in sorted(an.sites().items()):
+        with open(os.path.join(real_project.repo, rel)) as f:
+            text = f.readlines()[line - 1]
+        assert ("Lock(" in text or "Condition(" in text), (
+            f"{rel}:{line} ({lock_id}) is not a lock construction site"
+        )
+
+
+def test_rule_table_is_stable():
+    """Rule IDs referenced by baselines/allow()s/fixtures all exist and
+    follow the <PASS><NNN> shape."""
+    import re
+
+    for rid, rule in RULES.items():
+        assert re.fullmatch(r"[A-Z]{3,4}\d{3}", rid)
+        assert rule.id == rid and rule.title and rule.description
+    assert {"DET001", "DET002", "DET003", "LCK001", "LCK002", "LCK003",
+            "EXC001", "EXC002", "TRC001", "TRC002", "TRC003",
+            "META001", "META002"} <= set(RULES)

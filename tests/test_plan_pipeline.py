@@ -349,3 +349,46 @@ def test_started_server_spawns_configured_workers():
         assert all(w.is_alive() for w in srv.workers)
     finally:
         srv.shutdown()
+
+
+# -- runtime validation of the static lock order -----------------------------
+
+
+def test_lock_watchdog_asserts_static_order_under_pipeline():
+    """The nomadlint lock-order pass validated DYNAMICALLY: compute the
+    canonical acquisition order from the current tree, install the
+    LockWatchdog (every lock built at a known construction site gets
+    acquisition tracking), then drive a full multi-worker register →
+    eval → plan-pipeline → apply workload. Every nested acquisition any
+    thread performs must respect the statically computed order — a
+    violation here means the static graph missed a real inversion."""
+    from nomad_tpu.telemetry import LockWatchdog
+    from tools.nomadlint import lockorder
+    from tools.nomadlint.project import Project
+
+    an = lockorder.analyze(Project())
+    assert an.order and an.sites() and not an.cycles
+    wd = LockWatchdog(order=an.order, sites=an.sites())
+    with wd:
+        srv = Server(ServerConfig(scheduler_backend="host",
+                                  scheduler_workers=4))
+        try:
+            srv.start()
+            for _ in range(10):
+                srv.node_register(mock.node())
+            eval_ids = [srv.job_register(mock.job())[0] for _ in range(3)]
+            for eid in eval_ids:
+                ev = srv.wait_for_eval(eid, timeout=20.0)
+                assert ev.status == structs.EVAL_STATUS_COMPLETE
+        finally:
+            srv.shutdown()
+    wd.assert_clean()
+    observed = wd.observed_edges()
+    assert observed, "watchdog tracked no nested acquisitions — the " \
+        "construction-site map is stale"
+    # The workload exercised edges the static pass predicted (e.g. the
+    # FSM's raft lock feeding the broker/state/telemetry locks).
+    assert observed & an.closure(), (
+        f"no overlap between observed {sorted(observed)[:5]}... and the "
+        "static edge closure"
+    )

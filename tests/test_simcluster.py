@@ -461,3 +461,16 @@ def test_steady_10k_scenario():
             <= art["heartbeat"]["rate_cap_per_sec"])
     assert art["plan_latency_ms"]["n"] == 24
     assert art["events"]["truncated"] is False
+    # Same-seed replay pins the BANKED canonical digest: moving the
+    # decision-path draws (node shuffle, broker scheduler choice,
+    # heartbeat jitter) off the global random module onto seeded
+    # per-context streams (nomadlint DET001) must leave the canonical
+    # event history byte-identical to the committed r07 artifact.
+    import json
+    import os
+
+    banked_path = os.path.join(os.path.dirname(__file__), "..",
+                               "SIMLOAD_steady-10k_s42_r07.json")
+    with open(banked_path) as f:
+        banked = json.load(f)
+    assert art["events"]["digest"] == banked["events"]["digest"]

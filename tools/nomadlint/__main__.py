@@ -1,0 +1,159 @@
+"""nomadlint CLI.
+
+    python -m tools.nomadlint                # report every finding
+    python -m tools.nomadlint --baseline     # tier-1 gate: fail only on
+                                             # findings not in baseline.json
+                                             # (or on stale baseline rows)
+    python -m tools.nomadlint --write-baseline    # regenerate baseline.json
+    python -m tools.nomadlint --write-lock-order  # regenerate lock_order.json
+    python -m tools.nomadlint --rules        # print the rule table
+    python -m tools.nomadlint --json         # machine-readable report
+
+Every run also writes the full report to /tmp/nomadlint_report.json so a
+failed tier-1 run's debug bundle can embed it (nomad_tpu/bundle.py
+``nomadlint`` section) without re-running the analysis in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from nomad_tpu.bundle import NOMADLINT_REPORT_PATH as REPORT_PATH  # noqa: E402
+from tools.nomadlint import baseline as baseline_mod  # noqa: E402
+from tools.nomadlint import lockorder, run_passes  # noqa: E402
+from tools.nomadlint.project import Project  # noqa: E402
+from tools.nomadlint.registry import RULES  # noqa: E402
+
+
+def _report_payload(findings, new, stale, baselined, roots):
+    import time
+
+    return {
+        "format": "nomadlint-report/v1",
+        # Provenance: the report lands at a host-global /tmp path that a
+        # debug bundle may embed days later — stamp what tree produced
+        # it, when, and over which roots, so a stale, foreign, or
+        # partial-coverage report is detectable.
+        "repo": REPO,
+        "roots": list(roots),
+        "generated_at": time.time(),
+        "total": len(findings),
+        "new": [vars(f) for f in new],
+        "baselined": baselined,
+        "stale_baseline_keys": stale,
+        "by_rule": _by_rule(findings),
+    }
+
+
+def _by_rule(findings):
+    out = {}
+    for f in findings:
+        out[f.rule_id] = out.get(f.rule_id, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nomadlint")
+    ap.add_argument("--baseline", action="store_true",
+                    help="gate mode: fail only on non-baselined findings")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--write-lock-order", action="store_true")
+    ap.add_argument("--rules", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("paths", nargs="*",
+                    help="restrict analysis to these repo-relative roots")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            flag = " (retired)" if rule.retired else ""
+            print(f"{rule.id}  [{rule.pass_name}]{flag}  {rule.title}")
+            print(f"        {rule.description}")
+        return 0
+
+    from tools.nomadlint.project import DEFAULT_ROOTS
+
+    if args.paths and (args.baseline or args.write_baseline
+                       or args.write_lock_order):
+        # The baseline and lock order are whole-tree artifacts: writing
+        # either from a subtree would drop every out-of-subtree row, and
+        # gating a subtree against them would read out-of-subtree rows
+        # as stale/drifted.
+        ap.error("--baseline/--write-baseline/--write-lock-order operate "
+                 "on the full tree; drop the path restriction")
+
+    project = Project(
+        repo=REPO,
+        roots=tuple(args.paths) if args.paths else DEFAULT_ROOTS,
+    )
+    if project.errors:
+        for err in project.errors:
+            print(f"nomadlint: parse error: {err}", file=sys.stderr)
+        return 2
+
+    if args.write_lock_order:
+        an = lockorder.analyze(project)
+        lockorder.write_committed(an)
+        print(f"wrote {lockorder.LOCK_ORDER_PATH} "
+              f"({len(an.order)} locks, {len(an.edges)} edges)")
+        if an.cycles:
+            for cyc in an.cycles:
+                print("CYCLE: " + " -> ".join(cyc + [cyc[0]]),
+                      file=sys.stderr)
+            return 1
+        return 0
+
+    findings = run_passes(project)
+
+    if args.write_baseline:
+        baseline_mod.save(findings)
+        print(f"wrote {baseline_mod.BASELINE_PATH} "
+              f"({len(findings)} findings)")
+        return 0
+
+    base = baseline_mod.load() if args.baseline else {}
+    new, stale = baseline_mod.compare(findings, base)
+    baselined = len(findings) - len(new)
+
+    payload = _report_payload(findings, new, stale, baselined, project.roots)
+    try:
+        with open(REPORT_PATH, "w") as f:
+            json.dump(payload, f, indent=1)
+    except OSError:
+        pass
+
+    if args.json:
+        print(json.dumps(payload, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"nomadlint: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed findings "
+                  "still grandfathered) — prune with --write-baseline:",
+                  file=sys.stderr)
+            for k in stale:
+                print(f"  {k}", file=sys.stderr)
+        summary = (f"nomadlint: {len(findings)} finding(s), "
+                   f"{baselined} baselined, {len(new)} new")
+        print(summary)
+
+    if args.baseline:
+        return 1 if (new or stale) else 0
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `nomadlint --rules | head` closing stdout is not an error.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,58 @@
+"""Committed baseline: grandfathered findings.
+
+A baseline entry is a finding's stable key — rule id, file, enclosing
+qualname, and the stripped source line (NOT the line number, so edits
+above a grandfathered site don't read as drift). ``compare`` returns
+(new, fixed): new findings fail the gate; fixed entries are stale
+baseline rows that must be pruned (``--write-baseline``), so the
+baseline can only ever shrink without an explicit decision."""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, Iterable, List, Tuple
+
+from tools.nomadlint.registry import Finding
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load(path: str = BASELINE_PATH) -> Dict[str, int]:
+    """key -> count (one site can yield the same keyed finding twice,
+    e.g. two identical snippets in one function)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {e["key"]: int(e.get("count", 1)) for e in data.get("findings", [])}
+
+
+def save(findings: Iterable[Finding], path: str = BASELINE_PATH) -> None:
+    counts = Counter(f.key() for f in findings)
+    payload = {
+        "format": "nomadlint-baseline/v1",
+        "findings": [
+            {"key": k, "count": n} for k, n in sorted(counts.items())
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+
+
+def compare(findings: List[Finding], baseline: Dict[str, int]
+            ) -> Tuple[List[Finding], List[str]]:
+    """(new findings not covered by the baseline, stale baseline keys)."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in budget.items() if n > 0)
+    return new, stale

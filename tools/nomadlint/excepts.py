@@ -1,0 +1,101 @@
+"""Exception-hygiene pass: broad handlers in the replication/scheduling
+hot path must leave evidence.
+
+Scope: raft append/apply, the FSM, plan verification/commit, and the
+worker/broker loops — the modules where an eaten exception is a silent
+state divergence. A broad handler (``except Exception`` / bare
+``except:``) passes iff it does at least one of:
+
+- re-raises (``raise``),
+- propagates the caught exception object into a future/response
+  (``set_exception(e)`` / ``respond(..., e)``),
+- counts a telemetry metric (``telemetry.incr_counter``/``add_sample``/
+  ``measure_since``/``set_gauge``),
+- fires a fault site (``faults.fire``).
+
+``logger.error(...)`` alone deliberately does NOT pass: logs rot in
+buffers nobody greps; metrics alarm.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.nomadlint.project import Project, qualname_of
+from tools.nomadlint.registry import Finding
+
+HOT_SCOPE = (
+    "nomad_tpu/raft",
+    "nomad_tpu/server/fsm.py",
+    "nomad_tpu/server/plan_pipeline.py",
+    "nomad_tpu/server/plan_apply.py",
+    "nomad_tpu/server/plan_queue.py",
+    "nomad_tpu/server/worker.py",
+    "nomad_tpu/server/eval_broker.py",
+)
+
+_TELEMETRY_FUNCS = ("incr_counter", "add_sample", "measure_since", "set_gauge")
+_PROPAGATORS = ("set_exception", "respond")
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts)
+    return False
+
+
+def _handler_ok(handler: ast.ExceptHandler) -> bool:
+    caught = handler.name  # `except Exception as e` -> "e"
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if (f.attr in _TELEMETRY_FUNCS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "telemetry"):
+                return True
+            if (f.attr == "fire" and isinstance(f.value, ast.Name)
+                    and f.value.id == "faults"):
+                return True
+            if f.attr in _PROPAGATORS and caught:
+                if any(isinstance(a, ast.Name) and a.id == caught
+                       for a in node.args):
+                    return True
+        elif isinstance(f, ast.Name) and f.id in _TELEMETRY_FUNCS:
+            return True
+    return False
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.scoped(HOT_SCOPE):
+        raw: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _handler_ok(node):
+                continue
+            rule = "EXC002" if node.type is None else "EXC001"
+            what = ("bare except" if node.type is None
+                    else "broad `except Exception`")
+            raw.append(Finding(
+                rule, mod.relpath, node.lineno, qualname_of(node),
+                f"{what} in a hot path neither re-raises, propagates the "
+                "error, counts telemetry, nor fires a fault site",
+                snippet=mod.snippet(node.lineno),
+            ))
+        findings.extend(project.filter_allowed(mod, raw))
+    return findings

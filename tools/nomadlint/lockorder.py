@@ -1,0 +1,762 @@
+"""Lock-order pass: extract the whole-program lock graph and enforce a
+canonical acquisition order.
+
+Model:
+
+- A **lock node** is a construction site, named by its owning scope:
+  ``module.Class.attr`` for ``self.attr = threading.Lock()`` and
+  ``module.NAME`` for module-level locks. Instances of one class share a
+  node (instance identity is invisible statically), so self-edges L->L
+  are skipped rather than reported.
+- ``threading.Condition(self._lock)`` is an **alias** of the lock it
+  wraps: acquiring the condition acquires that lock.
+- An **edge** L -> M means some region holding L acquires M — directly
+  (nested ``with``), or transitively through calls the resolver can
+  follow (self-methods, same-module functions, project-module imports,
+  and attributes whose class is inferable from constructor assignments).
+
+Checks: LCK001 (cycle in the current graph), LCK002 (a current edge that
+inverts the committed canonical order in ``lock_order.json``), LCK003
+(the committed file does not match a fresh computation — regenerate with
+``--write-lock-order``).
+
+The same analysis feeds ``telemetry.LockWatchdog``: ``analyze()`` returns
+construction sites (file, line) per lock and the transitive closure of
+the edge set, which the watchdog asserts against real acquisitions under
+tests — the static result validated dynamically.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.nomadlint.project import ModuleInfo, Project, qualname_of
+from tools.nomadlint.registry import Finding
+
+LOCK_ORDER_PATH = os.path.join(os.path.dirname(__file__), "lock_order.json")
+
+_LOCK_CTORS = ("Lock", "RLock")
+_MAX_CALL_DEPTH = 8
+
+
+def _annotation_class(ann: Optional[ast.AST]) -> Optional[str]:
+    """Bare class name from a parameter annotation: ``FSM``,
+    ``"FSM"`` (quoted), ``Optional[FSM]``, ``mod.FSM``."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.strip()
+        return name.split(".")[-1] if name.isidentifier() or "." in name \
+            else None
+    if isinstance(ann, ast.Subscript):
+        base = ann.value
+        if (isinstance(base, ast.Name) and base.id == "Optional") or (
+                isinstance(base, ast.Attribute) and base.attr == "Optional"):
+            return _annotation_class(ann.slice)
+        return None
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    return None
+
+
+def _ctor_classes(value: Optional[ast.AST],
+                  global_types: Dict[str, str]) -> List[str]:
+    """Class names an assigned expression may construct: a direct
+    ``C(...)`` call, a module-level instance's class, or either arm of an
+    ``x if cond else C()`` default-injection idiom."""
+    if value is None:
+        return []
+    if isinstance(value, ast.IfExp):
+        return (_ctor_classes(value.body, global_types)
+                + _ctor_classes(value.orelse, global_types))
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+        return [value.func.id]
+    if isinstance(value, ast.Name) and value.id in global_types:
+        return [global_types[value.id]]
+    return []
+
+
+def _is_threading_call(node: ast.AST, names: Tuple[str, ...]) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when node is threading.X(...) or a
+    bare X(...) imported from threading."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr in names
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("threading", "_threading")):
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in names:
+        return f.id
+    return None
+
+
+@dataclass
+class LockNode:
+    lock_id: str
+    file: str
+    line: int
+    kind: str                      # Lock | RLock | Condition
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    file: str
+    line: int
+    via: str                       # qualname of the holding function
+
+
+@dataclass
+class Analysis:
+    locks: Dict[str, LockNode] = field(default_factory=dict)
+    aliases: Dict[str, str] = field(default_factory=dict)   # alias id -> lock id
+    edges: Dict[Tuple[str, str], Edge] = field(default_factory=dict)
+    order: List[str] = field(default_factory=list)
+    cycles: List[List[str]] = field(default_factory=list)
+
+    def closure(self) -> Set[Tuple[str, str]]:
+        """Transitive closure of the edge set (small graph; Floyd-style)."""
+        succ: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            succ.setdefault(a, set()).add(b)
+        closed: Set[Tuple[str, str]] = set()
+        for start in succ:
+            stack, seen = [start], set()
+            while stack:
+                cur = stack.pop()
+                for nxt in succ.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            closed.update((start, n) for n in seen)
+        return closed
+
+    def sites(self) -> Dict[Tuple[str, int], str]:
+        """(file, line) of each lock/alias construction -> lock id — the
+        LockWatchdog's runtime mapping."""
+        out = {(n.file, n.line): self.aliases.get(n.lock_id, n.lock_id)
+               for n in self.locks.values()}
+        return out
+
+
+class _ModuleEnv:
+    """Per-module name resolution: imports of project modules, classes,
+    module-level instance types, and per-class attribute types."""
+
+    def __init__(self, mod: ModuleInfo, project_mods: Set[str]):
+        self.mod = mod
+        self.import_map: Dict[str, str] = {}      # local name -> module
+        self.from_map: Dict[str, Tuple[str, str]] = {}  # name -> (module, orig)
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.global_types: Dict[str, str] = {}    # NAME -> ClassName
+        self.attr_types: Dict[Tuple[str, str], str] = {}  # (Class, attr) -> ClassName
+        self.functions: Dict[str, ast.FunctionDef] = {}   # module-level funcs
+        # (Class, attr) -> method names: `self._handlers = {...: self._m}`
+        # dispatch tables, so indirect handler calls stay in the graph.
+        self.method_tables: Dict[Tuple[str, str], Set[str]] = {}
+
+        for node in mod.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name in project_mods:
+                        self.import_map[alias.asname
+                                        or alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                base = node.module
+                if node.level:
+                    parts = mod.modname.split(".")
+                    base = ".".join(parts[:-node.level] + [node.module])
+                for alias in node.names:
+                    full = f"{base}.{alias.name}"
+                    if full in project_mods:
+                        self.import_map[alias.asname or alias.name] = full
+                    elif base in project_mods:
+                        self.from_map[alias.asname or alias.name] = (
+                            base, alias.name
+                        )
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.Assign):
+                if (isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Name)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.global_types[tgt.id] = node.value.func.id
+
+        for cls in self.classes.values():
+            for sub in ast.walk(cls):
+                if isinstance(sub, ast.AnnAssign):
+                    targets = [sub.target] if sub.value is not None else []
+                    value = sub.value
+                elif isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                    value = sub.value
+                else:
+                    continue
+                self_targets = [
+                    t for t in targets
+                    if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self")
+                ]
+                if not self_targets:
+                    continue
+                for value_cls in _ctor_classes(value, self.global_types):
+                    for tgt in self_targets:
+                        self.attr_types[(cls.name, tgt.attr)] = value_cls
+                if isinstance(value, ast.Dict):
+                    methods = {
+                        v.attr for v in value.values
+                        if (isinstance(v, ast.Attribute)
+                            and isinstance(v.value, ast.Name)
+                            and v.value.id == "self")
+                    }
+                    if methods:
+                        for tgt in self_targets:
+                            self.method_tables[(cls.name, tgt.attr)] = methods
+            # `def __init__(self, fsm: FSM)` + `self.fsm = fsm`: the
+            # annotation types the attribute. Collaborator objects are
+            # usually INJECTED, not constructed — without this, every
+            # lock-holding call through an injected dependency (e.g.
+            # InProcRaft holding _lock while calling self.fsm.apply) is
+            # invisible to the edge extraction.
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                ann: Dict[str, str] = {}
+                args = (item.args.posonlyargs + item.args.args
+                        + item.args.kwonlyargs)
+                for a in args:
+                    cname = _annotation_class(a.annotation)
+                    if cname is not None:
+                        ann[a.arg] = cname
+                if not ann:
+                    continue
+                for sub in ast.walk(item):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    names = [sub.value] if isinstance(sub.value, ast.Name) \
+                        else ([sub.value.body, sub.value.orelse]
+                              if isinstance(sub.value, ast.IfExp) else [])
+                    param = next(
+                        (n.id for n in names
+                         if isinstance(n, ast.Name) and n.id in ann), None,
+                    )
+                    if param is None:
+                        continue
+                    for tgt in sub.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            self.attr_types.setdefault(
+                                (cls.name, tgt.attr), ann[param]
+                            )
+
+
+def _collect_locks(mod: ModuleInfo, env: _ModuleEnv, an: Analysis) -> None:
+    def lock_expr_id(expr: ast.AST, cls_name: Optional[str]) -> Optional[str]:
+        """The lock id an expression names when used as a Condition's
+        backing lock (self.X in the same class, or a module global)."""
+        if (cls_name and isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return f"{mod.modname}.{cls_name}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            return f"{mod.modname}.{expr.id}"
+        return None
+
+    def visit(body, cls_name: Optional[str]):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                visit(node.body, node.name)
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(node.body, cls_name)
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                kind = _is_threading_call(sub.value, _LOCK_CTORS + ("Condition",))
+                if kind is None:
+                    continue
+                for tgt in sub.targets:
+                    owner = None
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self" and cls_name):
+                        owner = f"{mod.modname}.{cls_name}.{tgt.attr}"
+                    elif isinstance(tgt, ast.Name):
+                        owner = f"{mod.modname}.{tgt.id}"
+                    if owner is None:
+                        continue
+                    an.locks[owner] = LockNode(
+                        owner, mod.relpath, sub.value.lineno, kind
+                    )
+                    if kind == "Condition" and sub.value.args:
+                        backing = lock_expr_id(sub.value.args[0], cls_name)
+                        if backing is not None:
+                            an.aliases[owner] = backing
+
+    visit(mod.tree.body, None)
+
+
+class _Resolver:
+    """Cross-module call + lock-expression resolution."""
+
+    def __init__(self, project: Project, envs: Dict[str, _ModuleEnv],
+                 an: Analysis):
+        self.project = project
+        self.envs = envs
+        self.an = an
+        # qualname -> FunctionDef for every function/method in scope
+        self.funcs: Dict[str, ast.AST] = {}
+        # ClassName -> [qual prefix] (classes may share names across modules)
+        self.class_quals: Dict[str, List[str]] = {}
+        for modname, env in envs.items():
+            for fname, fnode in env.functions.items():
+                self.funcs[f"{modname}.{fname}"] = fnode
+            for cname, cnode in env.classes.items():
+                self.class_quals.setdefault(cname, []).append(
+                    f"{modname}.{cname}"
+                )
+                for item in cnode.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.funcs[f"{modname}.{cname}.{item.name}"] = item
+        self._locks_of: Dict[str, Set[str]] = {}
+
+    def canon(self, lock_id: Optional[str]) -> Optional[str]:
+        if lock_id is None:
+            return None
+        lock_id = self.an.aliases.get(lock_id, lock_id)
+        return lock_id if lock_id in self.an.locks else None
+
+    def resolve_lock_expr(self, expr: ast.AST, env: _ModuleEnv,
+                          cls_name: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.canon(f"{env.mod.modname}.{expr.id}")
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and cls_name:
+                got = self.canon(f"{env.mod.modname}.{cls_name}.{expr.attr}")
+                if got is not None:
+                    return got
+                # Base classes in the same project (single level).
+                cnode = env.classes.get(cls_name)
+                if cnode is not None:
+                    for b in cnode.bases:
+                        bname = b.id if isinstance(b, ast.Name) else None
+                        for q in self.class_quals.get(bname or "", []):
+                            got = self.canon(f"{q}.{expr.attr}")
+                            if got is not None:
+                                return got
+                return None
+            if base.id in env.import_map:
+                return self.canon(f"{env.import_map[base.id]}.{expr.attr}")
+            cls = env.global_types.get(base.id)
+            if cls is not None:
+                for q in self.class_quals.get(cls, []):
+                    got = self.canon(f"{q}.{expr.attr}")
+                    if got is not None:
+                        return got
+        elif (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and cls_name):
+            cls = env.attr_types.get((cls_name, base.attr))
+            if cls is not None:
+                for q in self.class_quals.get(cls, []):
+                    got = self.canon(f"{q}.{expr.attr}")
+                    if got is not None:
+                        return got
+        return None
+
+    def resolve_call(self, call: ast.Call, env: _ModuleEnv,
+                     cls_name: Optional[str]) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in env.from_map:
+                m, orig = env.from_map[f.id]
+                qual = f"{m}.{orig}"
+                if qual in self.funcs:
+                    return qual
+                # from X import Class — constructor call: __init__
+                if f"{qual}.__init__" in self.funcs:
+                    return f"{qual}.__init__"
+                return None
+            qual = f"{env.mod.modname}.{f.id}"
+            if qual in self.funcs:
+                return qual
+            if f.id in env.classes:
+                q = f"{env.mod.modname}.{f.id}.__init__"
+                return q if q in self.funcs else None
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        base = f.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and cls_name:
+                for q in self.class_quals.get(cls_name, []):
+                    if q.startswith(env.mod.modname + "."):
+                        cand = f"{q}.{f.attr}"
+                        if cand in self.funcs:
+                            return cand
+                cnode = env.classes.get(cls_name)
+                if cnode is not None:
+                    for b in cnode.bases:
+                        bname = b.id if isinstance(b, ast.Name) else None
+                        for q in self.class_quals.get(bname or "", []):
+                            cand = f"{q}.{f.attr}"
+                            if cand in self.funcs:
+                                return cand
+                return None
+            if base.id in env.import_map:
+                cand = f"{env.import_map[base.id]}.{f.attr}"
+                return cand if cand in self.funcs else None
+            cls = env.global_types.get(base.id)
+            if cls is not None:
+                for q in self.class_quals.get(cls, []):
+                    cand = f"{q}.{f.attr}"
+                    if cand in self.funcs:
+                        return cand
+        elif (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and cls_name):
+            cls = env.attr_types.get((cls_name, base.attr))
+            if cls is not None:
+                for q in self.class_quals.get(cls, []):
+                    cand = f"{q}.{f.attr}"
+                    if cand in self.funcs:
+                        return cand
+        return None
+
+    # -- transitive lock sets ------------------------------------------------
+
+    def locks_of(self, qual: str, _depth: int = 0,
+                 _stack: Optional[Set[str]] = None) -> Set[str]:
+        """Every lock ``qual`` may acquire, directly or through resolvable
+        calls (over-approximate, memoized)."""
+        if qual in self._locks_of:
+            return self._locks_of[qual]
+        if _depth > _MAX_CALL_DEPTH:
+            return set()
+        stack = _stack or set()
+        if qual in stack:
+            return set()
+        fn = self.funcs.get(qual)
+        if fn is None:
+            return set()
+        env, cls_name = self._context_of(qual)
+        # Dispatch-table indirection: `h = self._handlers.get(k); h(...)`
+        # (or a direct `self._handlers[k](...)`) may call any method the
+        # table references — without this the FSM's entire apply fan-out
+        # would be invisible to the graph.
+        table_vars: Dict[str, Set[str]] = {}
+        if cls_name is not None:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                methods = self._table_methods(node.value, env, cls_name)
+                if methods:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            table_vars[tgt.id] = methods
+        out: Set[str] = set()
+
+        def dispatch(methods: Set[str]) -> None:
+            for m in sorted(methods):
+                for q in self.class_quals.get(cls_name or "", []):
+                    cand = f"{q}.{m}"
+                    if cand in self.funcs and cand != qual:
+                        out.update(self.locks_of(
+                            cand, _depth + 1, stack | {qual}
+                        ))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lock = self.resolve_lock_expr(
+                        item.context_expr, env, cls_name
+                    )
+                    if lock is not None:
+                        out.add(lock)
+            elif isinstance(node, ast.Call):
+                callee = self.resolve_call(node, env, cls_name)
+                if callee is not None and callee != qual:
+                    out |= self.locks_of(
+                        callee, _depth + 1, stack | {qual}
+                    )
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in table_vars):
+                    dispatch(table_vars[node.func.id])
+                else:
+                    methods = self._table_methods(node.func, env, cls_name)
+                    if methods:
+                        dispatch(methods)
+        self._locks_of[qual] = out
+        return out
+
+    def _table_methods(self, expr: ast.AST, env: _ModuleEnv,
+                       cls_name: Optional[str]) -> Set[str]:
+        """Method names reachable through ``self.<table>.get(...)`` /
+        ``self.<table>[...]`` when <table> is a recorded dispatch dict."""
+        if cls_name is None:
+            return set()
+
+        def table_of(base: ast.AST) -> Set[str]:
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                return env.method_tables.get((cls_name, base.attr), set())
+            return set()
+
+        if (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "get"):
+            return table_of(expr.func.value)
+        if isinstance(expr, ast.Subscript):
+            return table_of(expr.value)
+        return set()
+
+    def _context_of(self, qual: str) -> Tuple[_ModuleEnv, Optional[str]]:
+        parts = qual.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:cut])
+            env = self.envs.get(modname)
+            if env is not None:
+                rest = parts[cut:]
+                cls = rest[0] if len(rest) == 2 else None
+                return env, cls
+        raise KeyError(qual)
+
+
+def analyze(project: Project) -> Analysis:
+    an = Analysis()
+    envs: Dict[str, _ModuleEnv] = {}
+    project_mods = {m.modname for m in project.modules.values()}
+    for relpath, mod in sorted(project.modules.items()):
+        envs[mod.modname] = _ModuleEnv(mod, project_mods)
+        _collect_locks(mod, envs[mod.modname], an)
+
+    resolver = _Resolver(project, envs, an)
+
+    # Edges: for every with-region, locks acquired inside the body.
+    for qual, fn in sorted(resolver.funcs.items()):
+        env, cls_name = resolver._context_of(qual)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            held = [
+                resolver.resolve_lock_expr(item.context_expr, env, cls_name)
+                for item in node.items
+            ]
+            held = [h for h in held if h is not None]
+            if not held:
+                continue
+            # `with A, B:` orders A before B.
+            for i in range(len(held) - 1):
+                _add_edge(an, held[i], held[i + 1], env.mod.relpath,
+                          node.lineno, qual)
+            inner: Set[str] = set()
+            for body_node in node.body:
+                for sub in ast.walk(body_node):
+                    if isinstance(sub, ast.With):
+                        for item in sub.items:
+                            lock = resolver.resolve_lock_expr(
+                                item.context_expr, env, cls_name
+                            )
+                            if lock is not None:
+                                inner.add(lock)
+                    elif isinstance(sub, ast.Call):
+                        callee = resolver.resolve_call(sub, env, cls_name)
+                        if callee is not None:
+                            inner |= resolver.locks_of(callee)
+            for h in held:
+                for m in inner:
+                    _add_edge(an, h, m, env.mod.relpath, node.lineno, qual)
+
+    _order_and_cycles(an)
+    return an
+
+
+def _add_edge(an: Analysis, src: str, dst: str, file: str, line: int,
+              via: str) -> None:
+    src = an.aliases.get(src, src)
+    dst = an.aliases.get(dst, dst)
+    if src == dst:
+        return  # instance identity unknown statically; see module doc
+    an.edges.setdefault((src, dst), Edge(src, dst, file, line, via))
+
+
+def _order_and_cycles(an: Analysis) -> None:
+    """Kahn topological sort with lexicographic tie-break; unsortable
+    leftovers are the cycle participants (reported via SCC walk)."""
+    nodes = sorted(an.locks)
+    nodes = [n for n in nodes if n not in an.aliases]
+    succ: Dict[str, Set[str]] = {n: set() for n in nodes}
+    pred: Dict[str, Set[str]] = {n: set() for n in nodes}
+    for (a, b) in an.edges:
+        if a in succ and b in succ:
+            succ[a].add(b)
+            pred[b].add(a)
+    ready = sorted(n for n in nodes if not pred[n])
+    order: List[str] = []
+    pred = {n: set(p) for n, p in pred.items()}
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        newly = []
+        for m in sorted(succ[n]):
+            pred[m].discard(n)
+            if not pred[m]:
+                newly.append(m)
+        ready = sorted(set(ready) | set(newly))
+    an.order = order
+    leftover = [n for n in nodes if n not in set(order)]
+    if leftover:
+        an.cycles = _sccs(leftover, succ)
+
+
+def _sccs(nodes: List[str], succ: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan over the leftover (cyclic) subgraph."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    out: List[List[str]] = []
+    nodeset = set(nodes)
+
+    def strong(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(succ.get(v, ())):
+            if w not in nodeset:
+                continue
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                out.append(sorted(comp))
+
+    for v in sorted(nodes):
+        if v not in index:
+            strong(v)
+    return out
+
+
+# -- committed order ---------------------------------------------------------
+
+def load_committed(path: str = LOCK_ORDER_PATH) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def committed_payload(an: Analysis) -> dict:
+    """Line-number-free so unrelated edits don't read as drift."""
+    return {
+        "order": an.order,
+        "edges": sorted([a, b] for (a, b) in an.edges),
+        "aliases": dict(sorted(an.aliases.items())),
+    }
+
+
+def write_committed(an: Analysis, path: str = LOCK_ORDER_PATH) -> None:
+    with open(path, "w") as f:
+        json.dump(committed_payload(an), f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def run(project: Project) -> List[Finding]:
+    an = analyze(project)
+    findings: List[Finding] = []
+    for cyc in an.cycles:
+        first = next(
+            (an.edges[(a, b)] for a in cyc for b in cyc
+             if (a, b) in an.edges), None,
+        )
+        findings.append(Finding(
+            "LCK001", first.file if first else "tools/nomadlint",
+            first.line if first else 0,
+            first.via if first else "lockorder",
+            "lock-order cycle: " + " -> ".join(cyc + [cyc[0]]),
+            snippet="cycle:" + ",".join(cyc),
+        ))
+    from tools.nomadlint.project import DEFAULT_ROOTS
+
+    if tuple(project.roots) != tuple(DEFAULT_ROOTS):
+        # A path-restricted analysis sees only a partial lock graph:
+        # comparing it against the whole-tree committed order would
+        # read every out-of-scope lock as drift. Cycles (above) are
+        # still real; the committed-order checks need the full tree.
+        return findings
+    committed = load_committed()
+    if committed is None:
+        findings.append(Finding(
+            "LCK003", "tools/nomadlint/lock_order.json", 0, "lockorder",
+            "no committed lock order — generate with --write-lock-order",
+            snippet="missing",
+        ))
+        return findings
+    committed_edges = {tuple(e) for e in committed.get("edges", [])}
+    committed_closure = _close(committed_edges)
+    for (a, b), edge in sorted(an.edges.items()):
+        if (b, a) in committed_closure and (a, b) not in committed_edges:
+            findings.append(Finding(
+                "LCK002", edge.file, edge.line, edge.via,
+                f"acquisition {a} -> {b} inverts the committed canonical "
+                f"order ({b} precedes {a})",
+                snippet=f"{a}->{b}",
+            ))
+    if committed != committed_payload(an):
+        findings.append(Finding(
+            "LCK003", "tools/nomadlint/lock_order.json", 0, "lockorder",
+            "committed lock order drifted from a fresh computation — "
+            "regenerate with --write-lock-order",
+            snippet="drift",
+        ))
+    return findings
+
+
+def _close(edges: Set[Tuple[str, str]]) -> Set[Tuple[str, str]]:
+    succ: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        succ.setdefault(a, set()).add(b)
+    out: Set[Tuple[str, str]] = set()
+    for start in succ:
+        stack, seen = [start], set()
+        while stack:
+            cur = stack.pop()
+            for nxt in succ.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        out.update((start, n) for n in seen)
+    return out

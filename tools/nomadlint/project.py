@@ -1,0 +1,156 @@
+"""Project loader: parse every module once, resolve allow() directives,
+and provide the shared lookups the passes run against."""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+from tools.nomadlint.registry import Allow, Finding, RULES, parse_allow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The analyzed tree. tools/ and tests/ are deliberately out: tests drive
+# nondeterminism on purpose, and tools are operator-side.
+DEFAULT_ROOTS = ("nomad_tpu",)
+
+
+def _annotate_qualnames(tree: ast.Module, modname: str) -> None:
+    """Stamp every node with the dotted scope that encloses it
+    (``module.Class.method``) — the stable half of a finding's baseline
+    key."""
+
+    def walk(node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child._nl_qualname = qual  # type: ignore[attr-defined]
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                walk(child, f"{qual}.{child.name}")
+            else:
+                walk(child, qual)
+
+    tree._nl_qualname = modname  # type: ignore[attr-defined]
+    walk(tree, modname)
+
+
+def qualname_of(node: ast.AST, default: str = "?") -> str:
+    return getattr(node, "_nl_qualname", default)
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str            # repo-relative, forward slashes
+    modname: str            # dotted import name
+    lines: List[str]
+    tree: ast.Module
+    allows: Dict[int, Allow] = field(default_factory=dict)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def snippet(self, lineno: int) -> str:
+        return self.line(lineno).strip()
+
+
+class Project:
+    def __init__(self, repo: str = REPO,
+                 roots: Iterable[str] = DEFAULT_ROOTS):
+        self.repo = repo
+        self.roots = tuple(roots)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.errors: List[str] = []
+        for root in roots:
+            base = os.path.join(repo, root)
+            if os.path.isfile(base) and base.endswith(".py"):
+                self._load(os.path.relpath(base, repo))
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__"
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        self._load(os.path.relpath(
+                            os.path.join(dirpath, fn), repo
+                        ))
+
+    def _load(self, relpath: str) -> None:
+        relpath = relpath.replace(os.sep, "/")
+        path = os.path.join(self.repo, relpath)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=relpath)
+        except (OSError, SyntaxError) as e:
+            self.errors.append(f"{relpath}: {e}")
+            return
+        modname = relpath[:-3].replace("/", ".")
+        if modname.endswith(".__init__"):
+            modname = modname[:-len(".__init__")]
+        lines = source.splitlines()
+        mod = ModuleInfo(relpath=relpath, modname=modname,
+                         lines=lines, tree=tree)
+        for i, text in enumerate(lines, start=1):
+            allow = parse_allow(text, i)
+            if allow is not None:
+                mod.allows[i] = allow
+        _annotate_qualnames(tree, modname)
+        self.modules[relpath] = mod
+
+    # -- scoping -------------------------------------------------------------
+
+    def in_scope(self, relpath: str, scope: Iterable[str]) -> bool:
+        return any(
+            relpath == s or relpath.startswith(s.rstrip("/") + "/")
+            for s in scope
+        )
+
+    def scoped(self, scope: Iterable[str]) -> List[ModuleInfo]:
+        return [m for rp, m in sorted(self.modules.items())
+                if self.in_scope(rp, scope)]
+
+    # -- suppression ---------------------------------------------------------
+
+    def allowed(self, mod: ModuleInfo, lineno: int, rule_id: str) -> bool:
+        """A finding is suppressed by an allow() on its own line, or
+        anywhere in the contiguous comment block directly above it —
+        reasons are encouraged to be real prose, which wraps."""
+        allow = mod.allows.get(lineno)
+        if allow is not None and rule_id in allow.rules:
+            return True
+        at = lineno - 1
+        while at >= 1 and mod.line(at).lstrip().startswith("#"):
+            allow = mod.allows.get(at)
+            if allow is not None and rule_id in allow.rules:
+                return True
+            at -= 1
+        return False
+
+    def meta_findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        for relpath, mod in sorted(self.modules.items()):
+            for lineno, allow in sorted(mod.allows.items()):
+                if allow.reason is None:
+                    out.append(Finding(
+                        "META001", relpath, lineno, mod.modname,
+                        "allow() without `-- <reason>`: "
+                        f"allow({', '.join(allow.rules)})",
+                        snippet=mod.snippet(lineno),
+                    ))
+                for rid in allow.rules:
+                    if rid not in RULES:
+                        out.append(Finding(
+                            "META002", relpath, lineno, mod.modname,
+                            f"allow() names unknown rule {rid!r}",
+                            snippet=mod.snippet(lineno),
+                        ))
+        return out
+
+    def filter_allowed(self, mod: ModuleInfo,
+                       findings: Iterable[Finding]) -> List[Finding]:
+        return [f for f in findings
+                if not self.allowed(mod, f.line, f.rule_id)]

@@ -1,0 +1,305 @@
+"""JAX trace-hygiene pass over ``tpu/`` and ``ops/``.
+
+A jitted function's Python executes only while TRACING; value-dependent
+Python control flow either raises (ConcretizationTypeError) or — with
+static arguments — silently recompiles per distinct value. ``ops/fit.py``
+grew jit_trace telemetry counters to catch the resulting retrace storms
+at runtime; this pass catches the hazard classes statically:
+
+- TRC001: ``if``/``while``/``for`` on a traced parameter inside a jitted
+  function (uses of ``.shape``/``.ndim``/``.dtype``/``.size`` and
+  ``len(x)`` are shape-level and fine).
+- TRC002: a call site feeding a list/dict/set literal (or comprehension)
+  to a static argument — unhashable, raises at call time.
+- TRC003: a jitted function reading module-level mutable state that some
+  other code in the module mutates — the traced-time value is baked into
+  the executable and later mutations are silently ignored.
+
+Jit detection covers ``@jax.jit``, ``@jit``,
+``@partial(jax.jit, ...)``/``@functools.partial(jax.jit, ...)``, and
+``name = jax.jit(fn, ...)`` module-level wrapping.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.nomadlint.project import ModuleInfo, Project, qualname_of
+from tools.nomadlint.registry import Finding
+
+TRACE_SCOPE = (
+    "nomad_tpu/tpu",
+    "nomad_tpu/ops",
+    "nomad_tpu/parallel",
+)
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit as a bare expression."""
+    if isinstance(node, ast.Attribute):
+        return (node.attr == "jit" and isinstance(node.value, ast.Name)
+                and node.value.id == "jax")
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_statics(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= _int_set(kw.value)
+        elif kw.arg == "static_argnames":
+            names |= _str_set(kw.value)
+    return nums, names
+
+
+def _int_set(expr: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    elts = expr.elts if isinstance(expr, (ast.Tuple, ast.List)) else [expr]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            out.add(e.value)
+    return out
+
+
+def _str_set(expr: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    elts = expr.elts if isinstance(expr, (ast.Tuple, ast.List)) else [expr]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.add(e.value)
+    return out
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(static_argnums, static_argnames) when fn is jit-decorated."""
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return set(), set()
+        if isinstance(dec, ast.Call):
+            if _is_jit_expr(dec.func):
+                return _jit_call_statics(dec)
+            f = dec.func
+            is_partial = (
+                (isinstance(f, ast.Name) and f.id == "partial")
+                or (isinstance(f, ast.Attribute) and f.attr == "partial")
+            )
+            if is_partial and dec.args and _is_jit_expr(dec.args[0]):
+                return _jit_call_statics(dec)
+    return None
+
+
+def _traced_params(fn: ast.FunctionDef, statics: Tuple[Set[int], Set[str]]
+                   ) -> Set[str]:
+    nums, names = statics
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    traced = set()
+    for i, p in enumerate(params):
+        if p in ("self", "cls"):
+            continue
+        if i in nums or p in names:
+            continue
+        traced.add(p)
+    traced |= {a.arg for a in fn.args.kwonlyargs if a.arg not in names}
+    return traced
+
+
+class _ParentedWalk:
+    """Name uses with their immediate parent, for shape-attr whitelisting."""
+
+    def __init__(self, root: ast.AST):
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(root):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def value_level_names(self, expr: ast.AST, targets: Set[str]) -> List[ast.Name]:
+        """Names in ``expr`` matching ``targets`` used as VALUES — not as
+        ``x.shape``-style shape access and not inside len()/isinstance()."""
+        out = []
+        for node in ast.walk(expr):
+            if not (isinstance(node, ast.Name) and node.id in targets):
+                continue
+            parent = self.parents.get(node)
+            if (isinstance(parent, ast.Attribute)
+                    and parent.value is node
+                    and parent.attr in _SHAPE_ATTRS):
+                continue
+            if (isinstance(parent, ast.Call)
+                    and isinstance(parent.func, ast.Name)
+                    and parent.func.id in ("len", "isinstance", "type")
+                    and node in parent.args):
+                continue
+            out.append(node)
+        return out
+
+
+def _mutated_globals(mod: ModuleInfo) -> Set[str]:
+    """Module-level names bound to mutable containers AND mutated
+    somewhere (method mutation, subscript/aug assignment, or
+    global-rebind)."""
+    mutable: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, (ast.Dict, ast.List, ast.Set,
+                                       ast.DictComp, ast.ListComp,
+                                       ast.SetComp)) or (
+                isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in ("dict", "list", "set",
+                                           "defaultdict", "OrderedDict",
+                                           "deque")
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        mutable.add(tgt.id)
+    if not mutable:
+        return set()
+    mutated: Set[str] = set()
+    _MUTATORS = {"append", "add", "update", "setdefault", "pop", "popitem",
+                 "extend", "insert", "remove", "discard", "clear",
+                 "appendleft"}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in mutable):
+                mutated.add(f.value.id)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in tgts:
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in mutable):
+                    mutated.add(tgt.value.id)
+        elif isinstance(node, ast.Global):
+            mutated |= set(node.names) & mutable
+    return mutated
+
+
+def _wrapped_statics(mod: ModuleInfo) -> Dict[str, Tuple[Set[int], Set[str]]]:
+    """fn-name -> statics for ``name = jax.jit(fn, static_...=...)``."""
+    out: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_expr(node.func)):
+            continue
+        if node.args and isinstance(node.args[0], ast.Name):
+            out[node.args[0].id] = _jit_call_statics(node)
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.scoped(TRACE_SCOPE):
+        raw: List[Finding] = []
+        mutated = _mutated_globals(mod)
+        wrapped = _wrapped_statics(mod)
+        jitted: List[Tuple[ast.FunctionDef, Tuple[Set[int], Set[str]]]] = []
+        static_names_by_fn: Dict[str, Set[str]] = {}
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            statics = _jit_decoration(node)
+            if statics is None and node.name in wrapped:
+                statics = wrapped[node.name]
+            if statics is None:
+                continue
+            jitted.append((node, statics))
+            nums, names = statics
+            params = [a.arg for a in node.args.posonlyargs + node.args.args]
+            resolved = set(names)
+            resolved |= {params[i] for i in nums if i < len(params)}
+            static_names_by_fn[node.name] = resolved
+
+        for fn, statics in jitted:
+            traced = _traced_params(fn, statics)
+            pw = _ParentedWalk(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    hits = pw.value_level_names(node.test, traced)
+                    if hits:
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        raw.append(Finding(
+                            "TRC001", mod.relpath, node.lineno,
+                            qualname_of(node),
+                            f"Python `{kind}` on traced value(s) "
+                            f"{sorted({h.id for h in hits})} inside jitted "
+                            f"{fn.name} — use lax.cond/select or make the "
+                            "argument static",
+                            snippet=mod.snippet(node.lineno),
+                        ))
+                elif isinstance(node, ast.For):
+                    it = node.iter
+                    direct = (isinstance(it, ast.Name) and it.id in traced)
+                    over_range = (
+                        isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Name)
+                        and it.func.id == "range"
+                        and any(pw.value_level_names(a, traced)
+                                for a in it.args)
+                    )
+                    if direct or over_range:
+                        raw.append(Finding(
+                            "TRC001", mod.relpath, node.lineno,
+                            qualname_of(node),
+                            f"Python `for` over traced value inside jitted "
+                            f"{fn.name} — use lax.fori_loop/scan or a "
+                            "static bound",
+                            snippet=mod.snippet(node.lineno),
+                        ))
+                # TRC003: reads of mutated module-level containers.
+                if isinstance(node, ast.Name) and node.id in mutated \
+                        and isinstance(node.ctx, ast.Load):
+                    raw.append(Finding(
+                        "TRC003", mod.relpath, node.lineno,
+                        qualname_of(node),
+                        f"jitted {fn.name} reads module state "
+                        f"{node.id!r} that is mutated elsewhere — the "
+                        "traced-time value is baked into the compiled "
+                        "executable",
+                        snippet=mod.snippet(node.lineno),
+                    ))
+
+        # TRC002: unhashable literals at static positions of local calls.
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in static_names_by_fn):
+                continue
+            static_names = static_names_by_fn[node.func.id]
+            fn_def = next(f for f, _ in jitted if f.name == node.func.id)
+            params = [a.arg for a in fn_def.args.posonlyargs
+                      + fn_def.args.args]
+            feeds = []
+            for i, a in enumerate(node.args):
+                if i < len(params) and params[i] in static_names:
+                    feeds.append((params[i], a))
+            for kw in node.keywords:
+                if kw.arg in static_names:
+                    feeds.append((kw.arg, kw.value))
+            for pname, expr in feeds:
+                if isinstance(expr, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp)):
+                    raw.append(Finding(
+                        "TRC002", mod.relpath, expr.lineno,
+                        qualname_of(node),
+                        f"static argument {pname!r} of {node.func.id} fed "
+                        "an unhashable container literal — jit static "
+                        "args must be hashable (tuple it)",
+                        snippet=mod.snippet(expr.lineno),
+                    ))
+        seen = set()
+        deduped = []
+        for f in raw:
+            k = (f.rule_id, f.line, f.message)
+            if k not in seen:
+                seen.add(k)
+                deduped.append(f)
+        findings.extend(project.filter_allowed(mod, deduped))
+    return findings

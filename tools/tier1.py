@@ -112,6 +112,31 @@ PYTEST_ARGS = [
 TIMEOUT_S = 870  # the ROADMAP tier-1 budget
 
 
+def run_nomadlint() -> int:
+    """The static-analysis gate, run BEFORE pytest: any nomadlint finding
+    outside the committed baseline fails tier-1 without spending the test
+    budget. The run also refreshes /tmp/nomadlint_report.json, which a
+    failed run's debug bundle embeds (nomad_tpu.bundle `nomadlint`
+    section) — red-run forensics carry the gate's view of the tree."""
+    print("=== nomadlint gate ===")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.nomadlint", "--baseline"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=120,
+        )
+        out, rc = proc.stdout, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = ((e.stdout or "") if isinstance(e.stdout, str)
+               else (e.stdout or b"").decode("utf-8", "replace"))
+        out += "\nnomadlint gate TIMED OUT after 120s\n"
+        rc = 1
+    sys.stdout.write(out)
+    with open("/tmp/tier1_nomadlint.log", "w") as f:
+        f.write(out)
+    return rc
+
+
 def run_once(n: int) -> dict:
     import threading
 
@@ -172,6 +197,13 @@ def run_once(n: int) -> dict:
 
 def main() -> int:
     repeat = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    if run_nomadlint() != 0:
+        capture_bundle("/tmp/tier1_nomadlint_bundle.json")
+        print("tier1: nomadlint gate FAILED — fix the findings, suppress "
+              "with `# nomadlint: allow(RULE) -- reason`, or grandfather "
+              "with `python -m tools.nomadlint --write-baseline` "
+              "(log: /tmp/tier1_nomadlint.log)")
+        return 1
     results = [run_once(n) for n in range(1, repeat + 1)]
     print("\n=== tier1 summary ===")
     all_failed: dict = {}
