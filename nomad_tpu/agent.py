@@ -51,6 +51,14 @@ class AgentConfig:
     # Cluster event stream (nomad_tpu.events): ring size of retained
     # events (0 = default 2048) — the /v1/event/stream resume window.
     event_buffer_size: int = 0
+    # Prometheus histogram bucket bounds in ms (empty = the
+    # telemetry.DEFAULT_HISTOGRAM_BUCKETS_MS set): summary quantiles
+    # can't be aggregated across servers; fixed-bucket histograms can.
+    histogram_buckets: List[float] = field(default_factory=list)
+    # Declarative latency SLOs (nomad_tpu.slo): objective name ->
+    # threshold ms. None = the default objective set; {} disables the
+    # monitor. Served at /v1/agent/slo + slo.* metrics.
+    slo_objectives: Optional[Dict[str, float]] = None
     enable_syslog: bool = False
     syslog_facility: str = "LOCAL0"
     leave_on_interrupt: bool = False
@@ -121,6 +129,11 @@ class AgentConfig:
             trace_buffer_size=fc.telemetry.trace_buffer_size,
             disable_tracing=fc.telemetry.disable_tracing,
             event_buffer_size=fc.telemetry.event_buffer_size,
+            histogram_buckets=list(fc.telemetry.histogram_buckets),
+            # None (no slo{} block) = default objectives; an explicit
+            # empty block rides through as {} and disables the monitor.
+            slo_objectives=(dict(fc.telemetry.slo)
+                            if fc.telemetry.slo is not None else None),
             enable_syslog=fc.enable_syslog,
             syslog_facility=fc.syslog_facility,
             leave_on_interrupt=fc.leave_on_interrupt,
@@ -198,6 +211,8 @@ class Agent:
         )
         if self.config.event_buffer_size:
             server_config.event_buffer_size = self.config.event_buffer_size
+        if self.config.slo_objectives is not None:
+            server_config.slo_objectives = dict(self.config.slo_objectives)
         if self.config.num_schedulers:
             # ServerConfig resolves + validates the worker count in
             # __post_init__; a post-construction override must set the
@@ -273,6 +288,7 @@ class Agent:
         inmem, sink = telemetry.build_sink(
             statsite_addr=self.config.statsite_addr,
             statsd_addr=self.config.statsd_addr,
+            histogram_buckets=self.config.histogram_buckets or None,
         )
         self.inmem_sink = inmem
         telemetry.set_global(

@@ -86,7 +86,13 @@ class Telemetry:
     (nomad_tpu.trace): ``trace_buffer_size`` bounds the completed-trace
     ring (0 = the default of 256), ``disable_tracing`` turns span
     recording off entirely, and ``event_buffer_size`` bounds the cluster
-    event stream ring (nomad_tpu.events; 0 = the default of 2048)."""
+    event stream ring (nomad_tpu.events; 0 = the default of 2048).
+    ``histogram_buckets`` overrides the fixed Prometheus histogram bucket
+    bounds in ms (empty = telemetry.DEFAULT_HISTOGRAM_BUCKETS_MS); the
+    ``slo { }`` sub-block declares latency objectives
+    (``submit_to_placed_p95_ms = 250`` style, nomad_tpu.slo). Absent vs
+    explicitly empty matters for ``slo``: no block (None) means the
+    default objective set, an empty ``slo { }`` disables the monitor."""
 
     statsite_address: str = ""
     statsd_address: str = ""
@@ -94,6 +100,8 @@ class Telemetry:
     trace_buffer_size: int = 0
     disable_tracing: bool = False
     event_buffer_size: int = 0
+    histogram_buckets: List[float] = field(default_factory=list)
+    slo: Optional[Dict[str, float]] = None
 
 
 @dataclass
@@ -263,6 +271,21 @@ class FileConfig:
                 other.telemetry.event_buffer_size
                 or self.telemetry.event_buffer_size
             ),
+            histogram_buckets=(
+                list(other.telemetry.histogram_buckets)
+                or list(self.telemetry.histogram_buckets)
+            ),
+            # Objectives merge key-by-key like client.meta: a later file
+            # overrides one objective's threshold without dropping the
+            # rest of the set. None = no block (defaults apply); an
+            # explicit empty block anywhere in the chain disables — so a
+            # later `slo {}` must override, not vanish into the merge.
+            slo=(
+                self.telemetry.slo if other.telemetry.slo is None
+                else other.telemetry.slo if (not other.telemetry.slo
+                                             or self.telemetry.slo is None)
+                else {**self.telemetry.slo, **other.telemetry.slo}
+            ),
         )
         out.atlas = Atlas(
             infrastructure=other.atlas.infrastructure or self.atlas.infrastructure,
@@ -377,6 +400,26 @@ def _from_mapping(data: dict) -> FileConfig:
             for k, v in value.items():
                 if k in ("trace_buffer_size", "event_buffer_size"):
                     v = int(v)
+                elif k == "histogram_buckets":
+                    if (not isinstance(v, (list, tuple))
+                            or not all(isinstance(b, (int, float))
+                                       and not isinstance(b, bool)
+                                       and b > 0 for b in v)):
+                        raise ValueError(
+                            "telemetry.histogram_buckets must be a list "
+                            "of positive numbers (bucket bounds in ms)"
+                        )
+                    v = sorted(float(b) for b in v)
+                elif k == "slo":
+                    if not isinstance(v, dict):
+                        raise ValueError("telemetry.slo must be a mapping")
+                    # Parse-time validation: a typo'd objective name must
+                    # fail config load, not agent start.
+                    from nomad_tpu.slo import Objective
+
+                    v = {name: float(ms) for name, ms in v.items()}
+                    for name, ms in v.items():
+                        Objective.parse(name, ms)
                 setattr(cfg.telemetry, k, v)
         elif key == "atlas":
             for k, v in value.items():

@@ -230,6 +230,13 @@ class Evaluations:
                     q: Optional[QueryOptions] = None) -> Tuple[List[Dict], QueryMeta]:
         return self.client.query(f"/v1/evaluation/{eval_id}/allocations", q=q)
 
+    def timeline(self, eval_id: str) -> Dict:
+        """Lifecycle timeline (/v1/evaluation/<id>/timeline): the
+        submit→placed(→running) stage decomposition, per-attempt
+        segments included (nomad_tpu.lifecycle)."""
+        out, _ = self.client.query(f"/v1/evaluation/{eval_id}/timeline")
+        return out
+
 
 class Allocations:
     """api/allocations.go"""
@@ -244,6 +251,13 @@ class Allocations:
              q: Optional[QueryOptions] = None) -> Tuple[Allocation, QueryMeta]:
         out, meta = self.client.query(f"/v1/allocation/{alloc_id}", q=q)
         return from_dict(Allocation, out), meta
+
+    def timeline(self, alloc_id: str) -> Dict:
+        """Lifecycle timeline for one allocation
+        (/v1/allocation/<id>/timeline): resolves through the alloc's
+        evaluation and carries ``alloc_id`` in the body."""
+        out, _ = self.client.query(f"/v1/allocation/{alloc_id}/timeline")
+        return out
 
 
 class Events:
@@ -303,6 +317,13 @@ class AgentApi:
     def metrics(self) -> Dict:
         """Live InmemSink aggregates (/v1/agent/metrics JSON body)."""
         out, _ = self.client.query("/v1/agent/metrics")
+        return out
+
+    def slo(self) -> Dict:
+        """Live SLO state (/v1/agent/slo): objectives with observed
+        percentiles, rolling error budgets, and burn rates
+        (nomad_tpu.slo)."""
+        out, _ = self.client.query("/v1/agent/slo")
         return out
 
     def debug_bundle(self, events: int = 0) -> Dict:

@@ -104,6 +104,21 @@ def _plan_pipeline_prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
+def _trace_prometheus_text() -> str:
+    """Tracer loss accounting as Prometheus lines: without the aggregate
+    counters, silent span/trace loss under 10k-node load is invisible
+    until someone opens the one clipped trace."""
+    stats = trace.get_tracer().stats()
+    lines = []
+    for k in ("spans_dropped", "traces_evicted"):
+        name = f"nomad_trace_{k}_total"
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {stats[k]}")
+    lines.append("# TYPE nomad_trace_retained gauge")
+    lines.append(f"nomad_trace_retained {stats['retained']}")
+    return "\n".join(lines) + "\n"
+
+
 class RawResponse:
     """Non-JSON handler result (e.g. Prometheus text exposition): the
     dispatcher writes the body verbatim with the given content type."""
@@ -169,8 +184,13 @@ class HTTPServer:
             (r"^/v1/evaluation/(?P<eval_id>[^/]+)/allocations$",
              self.eval_allocations),
             (r"^/v1/evaluation/(?P<eval_id>[^/]+)/trace$", self.eval_trace),
+            (r"^/v1/evaluation/(?P<eval_id>[^/]+)/timeline$",
+             self.eval_timeline),
+            (r"^/v1/allocation/(?P<alloc_id>[^/]+)/timeline$",
+             self.alloc_timeline),
             (r"^/v1/event/stream$", self.event_stream),
             (r"^/v1/agent/self$", self.agent_self),
+            (r"^/v1/agent/slo$", self.agent_slo),
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
             (r"^/v1/agent/debug$", self.agent_debug),
@@ -455,6 +475,39 @@ class HTTPServer:
             raise HTTPCodedError(404, "no trace for evaluation")
         return {"eval_id": eval_id, "spans": spans}, None
 
+    def eval_timeline(self, req, query, eval_id: str) -> Tuple[Any, Optional[int]]:
+        """Per-evaluation lifecycle timeline (nomad_tpu.lifecycle): the
+        submit→placed(→running) stage decomposition stitched from the
+        retained trace spans + the server's event ring. Degrades
+        honestly: with tracing off (or the trace evicted) the stages are
+        all ``unattributed`` but the end-to-end anchors still serve."""
+        from nomad_tpu import lifecycle
+
+        srv = self._srv()
+        tl = lifecycle.stitch_from_server(srv, eval_id)
+        if tl is None:
+            raise HTTPCodedError(404, "no timeline for evaluation")
+        return tl.to_dict(), None
+
+    def alloc_timeline(self, req, query, alloc_id: str) -> Tuple[Any, Optional[int]]:
+        """Per-allocation timeline: resolves the alloc's evaluation (the
+        granularity plans, columnar blocks, and traces share) and serves
+        that timeline stamped with the alloc id."""
+        from nomad_tpu import lifecycle
+
+        srv = self._srv()
+        alloc = srv.state_store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise HTTPCodedError(404, "alloc not found")
+        if not alloc.eval_id:
+            raise HTTPCodedError(404, "alloc has no evaluation")
+        tl = lifecycle.stitch_from_server(srv, alloc.eval_id)
+        if tl is None:
+            raise HTTPCodedError(404, "no timeline for allocation")
+        out = tl.to_dict()
+        out["alloc_id"] = alloc_id
+        return out, None
+
     # -- event stream (reference: nomad/stream, /v1/event/stream) ------------
 
     def event_stream(self, req, query) -> Tuple[Any, Optional[int]]:
@@ -588,6 +641,18 @@ class HTTPServer:
     def agent_self(self, req, query) -> Tuple[Any, Optional[int]]:
         return self.agent.self_info(), None
 
+    def agent_slo(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Live SLO state (nomad_tpu.slo): every configured objective's
+        threshold vs observed percentiles, rolling error budget, and
+        burn rate — the `are we inside the promise right now` surface
+        ROADMAP item 5's p95 submit→placed < 250ms target is judged by."""
+        srv = self._srv()
+        monitor = getattr(srv, "slo_monitor", None)
+        if monitor is None:
+            raise HTTPCodedError(404, "SLO monitoring disabled "
+                                      "(empty slo_objectives)")
+        return monitor.snapshot(), None
+
     def agent_metrics(self, req, query) -> Tuple[Any, Optional[int]]:
         """Live InmemSink aggregates. Default JSON (all retained
         intervals, plus the device-mirror cache's delta economy);
@@ -601,12 +666,14 @@ class HTTPServer:
             return RawResponse(
                 (telemetry.prometheus_text(sink)
                  + _mirror_prometheus_text()
-                 + _plan_pipeline_prometheus_text()).encode(),
+                 + _plan_pipeline_prometheus_text()
+                 + _trace_prometheus_text()).encode(),
                 "text/plain; version=0.0.4",
             ), None
         return {"timestamp": trace.now(), "intervals": sink.data(),
                 "mirror_cache": _mirror_cache_stats(),
-                "plan_pipeline": _plan_pipeline_stats()}, None
+                "plan_pipeline": _plan_pipeline_stats(),
+                "trace": trace.get_tracer().stats()}, None
 
     def agent_traces(self, req, query) -> Tuple[Any, Optional[int]]:
         """Summaries of the tracer's retained traces, newest first

@@ -23,6 +23,11 @@ everything the observability stack retains at the moment of capture —
                   committed vs conflicts, fused vs scalar verifies) —
                   whether the apply path is batching and how contended
                   the optimistic concurrency is
+- ``slo``         the live SLO snapshot (nomad_tpu.slo): objectives vs
+                  observed percentiles, error budgets, burn rates
+- ``timelines``   the worst-K slowest submit→placed lifecycle timelines
+                  (nomad_tpu.lifecycle) stitched from the retained spans
+                  and event ring — where the tail's time went
 - ``threads``     Python stacks of every live thread (sys._current_frames
                   — the goroutine-dump analog)
 
@@ -51,7 +56,8 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 # value is then None or an {"error": ...} stub, never absent).
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
-    "faults", "breaker", "mirror", "plan_pipeline", "nomadlint", "threads",
+    "faults", "breaker", "mirror", "plan_pipeline", "slo", "timelines",
+    "nomadlint", "threads",
 )
 
 # Every `python -m tools.nomadlint` run writes its full report here; the
@@ -174,6 +180,31 @@ def _plan_pipeline_section() -> Dict[str, Any]:
     return PIPELINE_TOTALS.stats()
 
 
+def _slo_section(agent) -> Optional[Dict[str, Any]]:
+    """Live SLO snapshot from the agent's server (None without one, or
+    with the monitor disabled)."""
+    server = getattr(agent, "server", None) if agent is not None else None
+    monitor = getattr(server, "slo_monitor", None)
+    return monitor.snapshot() if monitor is not None else None
+
+
+# Worst-K slowest timelines embedded per bundle: summaries of the tail,
+# not the whole run — a red tier-1 bundle must stay one readable JSON.
+TIMELINE_WORST_K = 8
+
+
+def _timelines_section(agent, last_events: int) -> List[Dict[str, Any]]:
+    """Worst-K slowest submit→placed lifecycle timelines stitched from
+    the same events the ``events`` section carries plus the retained
+    traces (nomad_tpu.lifecycle) — the flight recorder answers 'where
+    did the slow placements spend their time' directly."""
+    from nomad_tpu import lifecycle
+
+    events = _events_section(agent, last_events)
+    timelines = lifecycle.stitch(events)
+    return lifecycle.worst_k(timelines.values(), k=TIMELINE_WORST_K)
+
+
 def _nomadlint_section() -> Optional[Dict[str, Any]]:
     """Most recent nomadlint report, if a gate run left one. None (not an
     error) when no lint run happened on this host — the section is about
@@ -210,6 +241,8 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "breaker": None,
         "mirror": None,
         "plan_pipeline": None,
+        "slo": None,
+        "timelines": [],
         "nomadlint": None,
         "threads": None,
     }
@@ -221,6 +254,8 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("breaker", _breaker_section),
         ("mirror", _mirror_section),
         ("plan_pipeline", _plan_pipeline_section),
+        ("slo", lambda: _slo_section(agent)),
+        ("timelines", lambda: _timelines_section(agent, last_events)),
         ("nomadlint", _nomadlint_section),
         ("threads", thread_stacks),
     ):

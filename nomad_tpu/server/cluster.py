@@ -178,6 +178,8 @@ class ClusterServer(Server):
         ).start()
         self.raft.start()
         self.plan_applier.start()
+        if self.slo_monitor is not None:
+            self.slo_monitor.start()
         from nomad_tpu.server.worker import Worker
 
         for i in range(self.config.scheduler_workers):

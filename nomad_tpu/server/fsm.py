@@ -216,9 +216,15 @@ class FSM:
     def _apply_alloc_client_update(self, index: int, payload: dict) -> None:
         self.state.update_allocs_from_client(index, payload["allocs"])
         for a in payload["allocs"]:
+            # eval_id/job_id ride the payload so lifecycle consumers
+            # (nomad_tpu.lifecycle, nomad_tpu.slo) can close the
+            # submit→running loop from the event stream alone — the
+            # event key stays the alloc id and the digest (key + type
+            # sequences) is unchanged.
             self.events.publish(
                 "Alloc", "AllocClientUpdated", key=a.id, raft_index=index,
-                payload={"client_status": a.client_status},
+                payload={"client_status": a.client_status,
+                         "eval_id": a.eval_id, "job_id": a.job_id},
             )
 
     # -- snapshot/restore (fsm.go:299-593) ---------------------------------

@@ -105,6 +105,12 @@ class ServerConfig:
     # /v1/event/stream resume window. Consumers further behind than this
     # get a truncation marker and must re-list.
     event_buffer_size: int = 2048
+    # Declarative latency SLOs (nomad_tpu.slo): objective name ->
+    # threshold ms, e.g. {"submit_to_placed_p95_ms": 250}. None = the
+    # slo.DEFAULT_OBJECTIVES set; {} disables the monitor entirely.
+    slo_objectives: Optional[Dict[str, float]] = None
+    # Rolling error-budget window for the SLO burn-rate accounting.
+    slo_window_s: float = 3600.0
 
     def __post_init__(self) -> None:
         if self.num_schedulers is not None:
@@ -163,6 +169,18 @@ class Server:
             self.logger, max_batch=self.config.plan_batch_size,
         )
         self.workers: List[Worker] = []
+        # Live SLO accounting over this server's own event stream
+        # (nomad_tpu.slo; /v1/agent/slo). An empty objectives dict opts
+        # out; None means the default objective set. Read-only on
+        # decisions: the monitor is an event-ring consumer.
+        self.slo_monitor: Optional[object] = None
+        if self.config.slo_objectives is None or self.config.slo_objectives:
+            from nomad_tpu.slo import SLOMonitor
+
+            self.slo_monitor = SLOMonitor(
+                self.fsm.events, self.config.slo_objectives,
+                window_s=self.config.slo_window_s,
+            )
         self._periodic_stop = threading.Event()
         self._started = False
 
@@ -185,6 +203,8 @@ class Server:
         self.plan_queue.set_enabled(True)
         self.eval_broker.set_enabled(True)
         self.plan_applier.start()
+        if self.slo_monitor is not None:
+            self.slo_monitor.start()
         self.restore_eval_broker()
         for i in range(self.config.scheduler_workers):
             worker = Worker(self, i)
@@ -253,6 +273,8 @@ class Server:
         self._periodic_stop.set()
         for worker in self.workers:
             worker.stop()
+        if self.slo_monitor is not None:
+            self.slo_monitor.stop()
         self.plan_applier.stop()
         self.plan_queue.set_enabled(False)
         self.eval_broker.set_enabled(False)
@@ -737,6 +759,8 @@ class Server:
             "plan_pipeline": self.plan_applier.stats(),
             "heartbeat_timers": self.heartbeat.num_timers(),
             "scheduler": self.solver_stats(),
+            "slo": (self.slo_monitor.summary()
+                    if self.slo_monitor is not None else None),
         }
 
     @staticmethod

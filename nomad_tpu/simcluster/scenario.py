@@ -23,6 +23,16 @@ every run emits one JSON artifact:
   workers is scheduling noise; per-entity lifecycles (this eval went
   pending→planned→complete) are the seed-reproducible contract, the same
   reduction tests/test_events.py pins for fault replays.
+- ``latency_attribution``: the end-to-end story (nomad_tpu.lifecycle) —
+  submit→placed / submit→running p50/p95/p99 plus the per-stage
+  waterfall (queue-wait vs service-time, each stage's share of the p95
+  tail) stitched from the run's own trace spans + event stream, and the
+  artifact's SLO verdicts (nomad_tpu.slo.evaluate_artifact). The layer
+  is read-only on decisions: the event digest pins that an r08 run with
+  attribution equals the banked pre-attribution r07 digest. The
+  tracing-overhead arm (tools/simload.py --overhead-arm) re-runs the
+  scenario with the layer off (tracer disabled, SLO monitor off) and
+  stamps the plan-p50 delta here.
 """
 
 from __future__ import annotations
@@ -192,10 +202,16 @@ def _quantiles(samples: List[float]) -> Dict:
 class ScenarioRunner:
     def __init__(self, spec: ScenarioSpec, seed: int = 42,
                  logger: Optional[logging.Logger] = None,
-                 n_nodes: Optional[int] = None):
+                 n_nodes: Optional[int] = None,
+                 attribution_layer: bool = True):
         self.spec = spec
         self.seed = int(seed)
         self.n_nodes = int(n_nodes or spec.n_nodes)
+        # The tracing-overhead arm: False runs the identical scenario with
+        # the whole attribution layer off — tracer disabled (no spans),
+        # SLO monitor unconstructed — so the plan-p50 delta IS the layer's
+        # hot-path cost. Decisions must not depend on it (digest-pinned).
+        self.attribution_layer = bool(attribution_layer)
         self.logger = logger or logging.getLogger("nomad_tpu.simcluster")
         self._events: List = []
         self._events_lock = threading.Lock()
@@ -342,12 +358,20 @@ class ScenarioRunner:
             seed=self.seed,
         )
         cfg_kwargs.update(spec.server_overrides)
+        if not self.attribution_layer:
+            cfg_kwargs["slo_objectives"] = {}
         cfg = ServerConfig(**cfg_kwargs)
         srv = self._srv = ClusterServer(
             cfg, ClusterConfig(bootstrap_expect=1), logger=self.logger,
         )
         fleet = SimFleet(srv.rpc_addr, logger=self.logger)
         threads: List[threading.Thread] = []
+        from nomad_tpu import trace as trace_mod
+
+        tracer = trace_mod.get_tracer()
+        tracing_was = tracer.enabled
+        if not self.attribution_layer:
+            tracer.enabled = False
         t_run0 = time.perf_counter()
         try:
             srv.start()
@@ -485,6 +509,7 @@ class ScenarioRunner:
             )
         finally:
             self._stop.set()
+            tracer.enabled = tracing_was
             if spec.faults_spec is not None:
                 faults.get_registry().clear()
             fleet.stop()
@@ -691,6 +716,26 @@ class ScenarioRunner:
             },
             "deterministic_contract": self.spec.deterministic,
         }
+        # End-to-end latency attribution (nomad_tpu.lifecycle): stitch a
+        # timeline per eval the measured window submitted — spans from
+        # the process tracer, anchors from the same events digested
+        # above — and reduce into the submit→placed / submit→running
+        # percentiles + per-stage waterfall. Strictly post-hoc: runs
+        # after quiesce, reads retained state only.
+        if self.attribution_layer:
+            from nomad_tpu import lifecycle, slo
+
+            timelines = lifecycle.stitch(events)
+            att = lifecycle.attribution(timelines.values())
+            att["slo_check"] = slo.evaluate_artifact(att)
+            artifact["latency_attribution"] = att
+            artifact["slo"] = (
+                srv.slo_monitor.snapshot()
+                if srv.slo_monitor is not None else None
+            )
+        else:
+            artifact["latency_attribution"] = None
+            artifact["slo"] = None
         if self.spec.faults_spec is not None:
             artifact["faults"] = faults.get_registry().snapshot()
         return artifact
@@ -721,15 +766,19 @@ def _backend_name() -> str:
 
 def run_scenario(name: str, seed: int = 42, out_path: Optional[str] = None,
                  n_nodes: Optional[int] = None,
-                 logger: Optional[logging.Logger] = None) -> Dict:
-    """Run one named scenario; optionally write the JSON artifact."""
+                 logger: Optional[logging.Logger] = None,
+                 attribution_layer: bool = True) -> Dict:
+    """Run one named scenario; optionally write the JSON artifact.
+    ``attribution_layer=False`` is the tracing-overhead arm: same
+    scenario, tracer + SLO monitor off."""
     spec = SCENARIOS.get(name)
     if spec is None:
         raise KeyError(
             f"unknown scenario {name!r} (have: {sorted(SCENARIOS)})"
         )
     artifact = ScenarioRunner(
-        spec, seed=seed, n_nodes=n_nodes, logger=logger
+        spec, seed=seed, n_nodes=n_nodes, logger=logger,
+        attribution_layer=attribution_layer,
     ).run()
     if out_path:
         with open(out_path, "w") as f:

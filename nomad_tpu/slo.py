@@ -1,0 +1,321 @@
+"""SLO layer: declarative latency objectives, burn rates, live monitoring.
+
+ROADMAP item 5 names the target — p95 submit→placed < 250ms — but until
+now nothing in the agent *watched* it: the artifacts measured plan
+latency per run and no live surface said "are we inside the objective
+right now, and how fast is the error budget burning?". This module adds
+that surface:
+
+- **Objectives** are declared in agent config (``telemetry { slo {
+  submit_to_placed_p95_ms = 250 } }``) or ``ServerConfig.slo_objectives``;
+  the spelling ``<metric>_p<NN>_ms = <threshold>`` is parsed into
+  (metric, percentile objective, threshold).
+- **Samples** come from the server's own event stream, not from new
+  hot-path instruments: an :class:`SLOMonitor` thread tails the FSM's
+  event broker (``EvalUpdated(pending)`` → ``PlanApplied`` →
+  ``AllocClientUpdated(running)``) and computes submit→placed /
+  submit→running per eval — read-only on decisions by construction, the
+  same posture as the lifecycle stitcher.
+- **Error budgets** ride :class:`telemetry.BurnRateWindow`: each sample
+  is good iff it lands under the threshold; the objective percentile is
+  the budget (p95 → 5% of samples may be bad per window).
+- **Exposition**: ``/v1/agent/slo`` serves :meth:`SLOMonitor.snapshot`;
+  the monitor also publishes ``slo.<name>.burn_rate`` /
+  ``slo.<name>.budget_remaining`` gauges and a ``slo.<name>.breach``
+  counter through the ordinary telemetry sink, so the Prometheus scrape
+  carries them with zero extra wiring.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional
+
+from nomad_tpu import structs, telemetry
+
+# The metrics an objective may bind to. submit_to_placed is Sparrow's
+# headline cut to durable placement; submit_to_running extends through the
+# client ack (PAPERS.md).
+METRICS = ("submit_to_placed", "submit_to_running")
+
+# Default objectives when none are configured: the ROADMAP item-5 target
+# plus a looser end-to-end bound through the client ack.
+DEFAULT_OBJECTIVES: Dict[str, float] = {
+    "submit_to_placed_p95_ms": 250.0,
+    "submit_to_running_p95_ms": 1000.0,
+}
+
+_NAME_RE = re.compile(r"^(?P<metric>[a-z_]+)_p(?P<pct>\d{1,2})_ms$")
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One parsed objective: ``percentile`` of ``metric`` samples must
+    land at or under ``threshold_ms`` over the rolling window."""
+
+    name: str
+    metric: str
+    percentile: float
+    threshold_ms: float
+    window_s: float = 3600.0
+
+    @classmethod
+    def parse(cls, name: str, threshold_ms: float,
+              window_s: float = 3600.0) -> "Objective":
+        m = _NAME_RE.match(name)
+        if m is None:
+            raise ValueError(
+                f"SLO objective {name!r} must look like "
+                "<metric>_p<NN>_ms (e.g. submit_to_placed_p95_ms)"
+            )
+        metric = m.group("metric")
+        if metric not in METRICS:
+            raise ValueError(
+                f"SLO metric {metric!r} unknown (have: {METRICS})"
+            )
+        pct = int(m.group("pct"))
+        if not 1 <= pct <= 99:
+            raise ValueError(f"SLO percentile must be in [1, 99], got {pct}")
+        threshold = float(threshold_ms)
+        if threshold <= 0:
+            raise ValueError(f"SLO threshold must be positive, got {threshold}")
+        return cls(name=name, metric=metric, percentile=pct / 100.0,
+                   threshold_ms=threshold, window_s=window_s)
+
+
+def parse_objectives(spec: Optional[Dict[str, float]],
+                     window_s: float = 3600.0) -> List[Objective]:
+    """Config block -> objective list; None/empty means the defaults."""
+    items = spec if spec else DEFAULT_OBJECTIVES
+    return [Objective.parse(name, ms, window_s)
+            for name, ms in sorted(items.items())]
+
+
+class _Tracker:
+    """One objective's rolling accounting: burn-rate window + a bounded
+    reservoir so the snapshot reports the observed percentile next to
+    the target."""
+
+    __slots__ = ("objective", "window", "sample")
+
+    def __init__(self, objective: Objective):
+        self.objective = objective
+        self.window = telemetry.BurnRateWindow(
+            window_s=objective.window_s, objective=objective.percentile,
+        )
+        self.sample = telemetry.AggregateSample()
+
+    def record(self, value_ms: float) -> bool:
+        good = value_ms <= self.objective.threshold_ms
+        self.window.record(good)
+        self.sample.ingest(value_ms)
+        return good
+
+    def snapshot(self) -> Dict[str, Any]:
+        o = self.objective
+        stats = self.window.stats()
+        quantiles = self.sample.quantiles()
+        return {
+            "name": o.name,
+            "metric": o.metric,
+            "percentile": o.percentile,
+            "threshold_ms": o.threshold_ms,
+            "observed": {
+                "count": self.sample.count,
+                "max_ms": round(self.sample.max, 2),
+                **{k: round(v, 2) for k, v in quantiles.items()},
+            },
+            # Inside the objective iff the bad fraction stays within the
+            # budget the percentile grants.
+            "met": stats["burn_rate"] <= 1.0,
+            **stats,
+        }
+
+
+class SLOMonitor(threading.Thread):
+    """Tails one server's event broker and keeps the SLO books.
+
+    Deliberately a CONSUMER of the bounded event ring rather than a
+    hot-path hook: the control plane publishes exactly what it published
+    before (SIMLOAD event digests pin this), and a wedged monitor can
+    never block an apply. The cost of that posture is honesty about
+    loss: if the monitor ever falls further behind than the ring, the
+    gap is counted (``truncated_gaps``), not silently absorbed."""
+
+    # Bounded pending/placed maps: an eval that never places (or whose
+    # running ack never arrives) must not leak forever.
+    MAX_TRACKED = 8192
+
+    def __init__(self, broker, objectives: Optional[Dict[str, float]] = None,
+                 window_s: float = 3600.0, poll_interval: float = 0.25):
+        super().__init__(daemon=True, name="slo-monitor")
+        self.broker = broker
+        self.trackers = [_Tracker(o)
+                         for o in parse_objectives(objectives, window_s)]
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._cursor = 0
+        # eval id -> EvalUpdated(pending) wall stamp / PlanApplied stamp.
+        self._pending: "Dict[str, float]" = {}
+        self._placed: "Dict[str, float]" = {}
+        # Insertion-ordered dedup table (value unused): evals whose
+        # running transition is already counted. A dict, not a set, so
+        # overflow evicts oldest-first like the other tables — wiping it
+        # would let every later alloc ack of an already-counted eval
+        # re-record an inflated submit_to_running sample.
+        self._running_seen: "Dict[str, bool]" = {}
+        self.samples = {m: telemetry.AggregateSample() for m in METRICS}
+        self.truncated_gaps = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            self.poll()
+        self.poll()  # final drain so short-lived servers still account
+
+    def poll(self) -> None:
+        latest, events, truncated = self.broker.events_after(self._cursor)
+        if truncated and self._cursor:
+            self.truncated_gaps += 1
+            telemetry.incr_counter(("slo", "monitor", "truncated_gap"))
+        self._cursor = latest
+        if events:
+            self.observe(events)
+
+    # -- accounting ----------------------------------------------------------
+
+    def observe(self, events: Iterable) -> None:
+        """Feed a batch of events (Event objects) through the lifecycle
+        accounting. Separated from the thread loop so tests drive it
+        synchronously with synthetic streams."""
+        with self._lock:
+            for e in events:
+                if e.topic == "Eval" and e.type == "EvalUpdated":
+                    if (e.payload.get("status")
+                            == structs.EVAL_STATUS_PENDING
+                            and e.key not in self._pending
+                            and e.key not in self._placed):
+                        self._pending[e.key] = e.time
+                        self._evict_locked(self._pending)
+                elif e.topic == "Plan" and e.type == "PlanApplied":
+                    t0 = self._pending.pop(e.key, None)
+                    if t0 is not None and e.key not in self._placed:
+                        self._placed[e.key] = t0
+                        self._evict_locked(self._placed)
+                        self._record_locked(
+                            "submit_to_placed", (e.time - t0) * 1000.0
+                        )
+                elif e.topic == "Alloc" and e.type == "AllocClientUpdated":
+                    ev_id = e.payload.get("eval_id", "")
+                    if (ev_id
+                            and e.payload.get("client_status")
+                            == structs.ALLOC_CLIENT_STATUS_RUNNING
+                            and ev_id not in self._running_seen):
+                        t0 = self._placed.get(ev_id)
+                        if t0 is not None:
+                            self._running_seen[ev_id] = True
+                            self._evict_locked(self._running_seen)
+                            self._record_locked(
+                                "submit_to_running", (e.time - t0) * 1000.0
+                            )
+            self._publish_gauges_locked()
+
+    def _evict_locked(self, table: Dict[str, Any]) -> None:
+        # Oldest-inserted eviction (dict preserves insertion order): an
+        # abandoned eval costs one slot, never unbounded growth.
+        while len(table) > self.MAX_TRACKED:
+            table.pop(next(iter(table)))
+
+    def _record_locked(self, metric: str, value_ms: float) -> None:
+        self.samples[metric].ingest(value_ms)
+        telemetry.add_sample(("slo", metric), value_ms)
+        for tr in self.trackers:
+            if tr.objective.metric == metric:
+                if not tr.record(value_ms):
+                    telemetry.incr_counter(
+                        ("slo", tr.objective.name, "breach")
+                    )
+
+    def _publish_gauges_locked(self) -> None:
+        for tr in self.trackers:
+            stats = tr.window.stats()
+            telemetry.set_gauge(
+                ("slo", tr.objective.name, "burn_rate"),
+                stats["burn_rate"],
+            )
+            telemetry.set_gauge(
+                ("slo", tr.objective.name, "budget_remaining"),
+                stats["budget_remaining_fraction"],
+            )
+
+    # -- exposition ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/v1/agent/slo`` body: every objective's target vs
+        observed percentiles, budget state, burn rate; plus the raw
+        per-metric sample aggregates."""
+        with self._lock:
+            objectives = [tr.snapshot() for tr in self.trackers]
+            samples = {
+                m: {
+                    "count": agg.count,
+                    "mean_ms": round(agg.mean, 2),
+                    "max_ms": round(agg.max, 2),
+                    **{k: round(v, 2) for k, v in agg.quantiles().items()},
+                }
+                for m, agg in self.samples.items()
+            }
+            return {
+                "objectives": objectives,
+                "samples": samples,
+                "pending_evals": len(self._pending),
+                "truncated_gaps": self.truncated_gaps,
+            }
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact agent-info line: objective name -> met/burn_rate."""
+        with self._lock:
+            return {
+                tr.objective.name: {
+                    "met": tr.window.stats()["burn_rate"] <= 1.0,
+                    "burn_rate": tr.window.stats()["burn_rate"],
+                    "count": tr.sample.count,
+                }
+                for tr in self.trackers
+            }
+
+
+def evaluate_artifact(attribution: Dict[str, Any],
+                      objectives: Optional[Dict[str, float]] = None,
+                      ) -> List[Dict[str, Any]]:
+    """Offline check of a SIMLOAD ``latency_attribution`` section against
+    objectives (the bench_watch / CI gate path): for each objective,
+    compare the artifact's observed percentile of the metric against the
+    threshold. Artifact percentiles come at fixed cuts (p50/p95/p99) —
+    an objective at another percentile is checked against the next
+    STRICTER recorded cut (conservative, never lenient)."""
+    out: List[Dict[str, Any]] = []
+    cuts = (0.50, 0.95, 0.99)
+    for o in parse_objectives(objectives):
+        block = attribution.get(o.metric + "_ms") or {}
+        stricter = [c for c in cuts if c >= o.percentile]
+        cut = min(stricter) if stricter else max(cuts)
+        observed = block.get(f"p{int(cut * 100)}_ms")
+        n = block.get("n", 0)
+        met = None if (observed is None or not n) else observed <= o.threshold_ms
+        out.append({
+            "objective": o.name,
+            "threshold_ms": o.threshold_ms,
+            "checked_percentile": cut,
+            "observed_ms": observed,
+            "n": n,
+            "met": met,
+        })
+    return out

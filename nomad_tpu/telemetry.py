@@ -15,6 +15,8 @@ set_gauge / incr_counter / add_sample / measure_since, an interval-aggregated
 
 from __future__ import annotations
 
+import bisect
+import collections
 import math
 import random as _rand
 import signal
@@ -23,9 +25,20 @@ import sys
 import threading
 import time
 import traceback
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 Key = Tuple[str, ...]
+
+# Fixed histogram buckets (milliseconds) for latency timers. Summaries
+# carry reservoir quantiles, but summary quantiles CANNOT be aggregated
+# across servers — PromQL's histogram_quantile() needs bucket counts with
+# identical bounds on every server. Spanning 0.5ms (warm device solves)
+# to 60s (cold compiles, quiesce waits); override per deployment via the
+# ``telemetry { histogram_buckets = [...] }`` agent-config knob.
+DEFAULT_HISTOGRAM_BUCKETS_MS: Tuple[float, ...] = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 15000.0, 60000.0,
+)
 
 
 _FLAT_CACHE: Dict[Key, str] = {}
@@ -137,10 +150,20 @@ class InmemSink:
     """Ring of aggregation intervals (go-metrics inmem.go), dumpable on
     SIGUSR1 via :func:`setup_signal_dump`."""
 
-    def __init__(self, interval: float = 10.0, retain: float = 60.0):
+    def __init__(self, interval: float = 10.0, retain: float = 60.0,
+                 histogram_buckets: Optional[Sequence[float]] = None):
         self.interval = interval
         self.max_intervals = max(1, int(retain / interval))
         self.intervals: List[IntervalMetrics] = []
+        # Fixed bucket bounds for the histogram exposition: shared by
+        # every sample series (cross-server aggregability is the point).
+        self.buckets: Tuple[float, ...] = tuple(
+            sorted(histogram_buckets)
+        ) if histogram_buckets else DEFAULT_HISTOGRAM_BUCKETS_MS
+        # name -> per-bucket observation counts, one extra slot for +Inf.
+        # Process-lifetime cumulative, like _cum_counters: bucket counts
+        # must be monotonic for rate()/histogram_quantile().
+        self._cum_hist: Dict[str, List[int]] = {}
         # Process-lifetime cumulative totals, never evicted (the key
         # vocabulary is finite): the Prometheus exposition needs
         # monotonic counters — a rolling-window sum DECREASES as
@@ -197,6 +220,10 @@ class InmemSink:
             if cum is None:
                 cum = self._cum_samples[name] = AggregateSample()
             cum.ingest(value)
+            hist = self._cum_hist.get(name)
+            if hist is None:
+                hist = self._cum_hist[name] = [0] * (len(self.buckets) + 1)
+            hist[bisect.bisect_left(self.buckets, value)] += 1
 
     def cumulative(self) -> Tuple[Dict[str, List[float]],
                                   Dict[str, Dict[str, float]]]:
@@ -213,6 +240,14 @@ class InmemSink:
                     for k, a in self._cum_samples.items()
                 },
             )
+
+    def histograms(self) -> Tuple[Tuple[float, ...], Dict[str, List[int]]]:
+        """(bucket bounds, {name: per-bucket counts + overflow slot})
+        over the process lifetime — the aggregatable companion to the
+        summary quantiles."""
+        with self._lock:
+            return self.buckets, {k: list(v)
+                                  for k, v in self._cum_hist.items()}
 
     def data(self) -> List[dict]:
         """Structured dump of all retained intervals — the JSON body of
@@ -450,6 +485,7 @@ def prometheus_text(inmem: InmemSink) -> str:
     for ivl in intervals:
         gauges.update(ivl["gauges"])  # later intervals win
     counters, samples = inmem.cumulative()
+    bounds, hists = inmem.histograms()
 
     def _fmt(v: float) -> str:
         # Shortest-exact float (.17g), NOT %g: %g truncates to 6
@@ -481,6 +517,24 @@ def prometheus_text(inmem: InmemSink) -> str:
         lines.append(f"{name}_count {int(s['count'])}")
         lines.append(f"# TYPE {name}_max gauge")
         lines.append(f"{name}_max {_fmt(s['max'])}")
+        # Fixed-bucket histogram companion (``_hist`` family): summary
+        # quantiles can't be aggregated across servers, but bucket
+        # counts with identical bounds can —
+        # histogram_quantile(0.95, sum by (le) (rate(..._hist_bucket[5m]))).
+        hist = hists.get(key)
+        if hist is not None:
+            hname = name + "_hist"
+            lines.append(f"# TYPE {hname} histogram")
+            running = 0
+            for bound, count in zip(bounds, hist):
+                running += count
+                lines.append(
+                    f'{hname}_bucket{{le="{_fmt(bound)}"}} {running}'
+                )
+            running += hist[-1]
+            lines.append(f'{hname}_bucket{{le="+Inf"}} {running}')
+            lines.append(f"{hname}_sum {_fmt(s['sum'])}")
+            lines.append(f"{hname}_count {running}")
     return "\n".join(lines) + "\n"
 
 
@@ -499,11 +553,13 @@ def build_sink(
     statsd_addr: str = "",
     interval: float = 10.0,
     retain: float = 60.0,
+    histogram_buckets: Optional[Sequence[float]] = None,
 ) -> Tuple[InmemSink, object]:
     """Agent telemetry wiring (command/agent/command.go:486-520): always an
     in-memory sink; fan out to statsite/statsd when configured. Returns
     (inmem, sink-to-use)."""
-    inmem = InmemSink(interval=interval, retain=retain)
+    inmem = InmemSink(interval=interval, retain=retain,
+                      histogram_buckets=histogram_buckets)
     sinks: List = []
     if statsite_addr:
         sinks.append(StatsiteSink(statsite_addr))
@@ -513,6 +569,78 @@ def build_sink(
         sinks.append(inmem)
         return inmem, FanoutSink(sinks)
     return inmem, inmem
+
+
+# ---------------------------------------------------------------------------
+# BurnRateWindow: rolling error-budget accounting for SLO objectives
+# ---------------------------------------------------------------------------
+
+
+class BurnRateWindow:
+    """Rolling-window error-budget math for one SLO objective
+    (Google SRE workbook chapter 5 shape, consumed by nomad_tpu.slo).
+
+    An objective like "95% of placements land under 250ms" grants an
+    error budget of 5% bad samples over the window. ``record(good)``
+    appends one sample; ``stats()`` reports the bad fraction, the
+    fraction of budget spent, and the **burn rate** — bad_fraction /
+    budget_fraction, so 1.0 means the budget exactly runs out at the end
+    of the window and >1 pages before it.
+
+    Timestamps are monotonic (window pruning is interval arithmetic —
+    wall clock would make an NTP step eat or resurrect budget); thread-
+    safe; bounded at ``max_samples`` with oldest-first eviction, evicted
+    samples counted so saturation is visible rather than silent."""
+
+    __slots__ = ("window_s", "objective", "max_samples", "_lock",
+                 "_samples", "evicted")
+
+    def __init__(self, window_s: float = 3600.0, objective: float = 0.95,
+                 max_samples: int = 8192):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1), got {objective}")
+        self.window_s = float(window_s)
+        self.objective = float(objective)
+        self.max_samples = int(max_samples)
+        self._lock = threading.Lock()
+        self._samples: "collections.deque" = collections.deque()  # (t, good)
+        self.evicted = 0
+
+    def record(self, good: bool, t: Optional[float] = None) -> None:
+        t = time.monotonic() if t is None else t
+        with self._lock:
+            self._samples.append((t, bool(good)))
+            self._prune_locked(t)
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._samples and self._samples[0][0] < horizon:
+            self._samples.popleft()
+        while len(self._samples) > self.max_samples:
+            self._samples.popleft()
+            self.evicted += 1
+
+    def stats(self, now: Optional[float] = None) -> Dict[str, float]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune_locked(now)
+            total = len(self._samples)
+            bad = sum(1 for _, good in self._samples if not good)
+            evicted = self.evicted
+        budget_fraction = 1.0 - self.objective
+        bad_fraction = bad / total if total else 0.0
+        burn = bad_fraction / budget_fraction
+        return {
+            "window_s": self.window_s,
+            "objective": self.objective,
+            "total": total,
+            "bad": bad,
+            "good_fraction": round(1.0 - bad_fraction, 6),
+            "budget_spent_fraction": round(min(burn, 1.0), 6),
+            "budget_remaining_fraction": round(max(0.0, 1.0 - burn), 6),
+            "burn_rate": round(burn, 4),
+            "evicted": evicted,
+        }
 
 
 # ---------------------------------------------------------------------------

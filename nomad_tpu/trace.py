@@ -182,6 +182,12 @@ class Tracer:
         self._traces: "collections.OrderedDict[str, _Trace]" = (
             collections.OrderedDict()
         )
+        # Process-wide loss accounting (mutated under the lock): per-trace
+        # ``dropped`` says one eval's trace is partial, but without an
+        # aggregate, silent trace loss under 10k-node load is invisible
+        # until someone opens the one trace that happens to be truncated.
+        self.spans_dropped = 0
+        self.traces_evicted = 0
 
     # -- producing ---------------------------------------------------------
 
@@ -220,6 +226,7 @@ class Tracer:
             tr.open.pop(span.span_id, None)
             if len(tr.spans) >= self.max_spans:
                 tr.dropped += 1
+                self.spans_dropped += 1
             else:
                 tr.spans.append(span)
             tr.updated = now()
@@ -243,6 +250,7 @@ class Tracer:
             for s in spans:
                 if len(tr.spans) >= self.max_spans:
                     tr.dropped += 1
+                    self.spans_dropped += 1
                 else:
                     tr.spans.append(s)
             tr.updated = now()
@@ -276,7 +284,23 @@ class Tracer:
             self._traces[trace_id] = tr
             while len(self._traces) > self.max_traces:
                 self._traces.popitem(last=False)
+                self.traces_evicted += 1
         return tr
+
+    def stats(self) -> Dict[str, Any]:
+        """Aggregate tracer health for /v1/agent/metrics: retained count
+        plus the process-wide loss counters — a 10k-node run silently
+        evicting traces (or truncating span rings) shows up here, not
+        only inside whichever single trace got clipped."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "retained": len(self._traces),
+                "max_traces": self.max_traces,
+                "max_spans": self.max_spans,
+                "spans_dropped": self.spans_dropped,
+                "traces_evicted": self.traces_evicted,
+            }
 
     # -- querying ----------------------------------------------------------
 

@@ -8,6 +8,7 @@ resets. Those invariants are asserted here without touching sockets,
 subprocesses, git, or the real bench.
 """
 
+import json
 import os
 import sys
 
@@ -160,8 +161,115 @@ def test_missing_proof_file_skips_proof_stage(tmp_path):
 @pytest.fixture(autouse=True)
 def _no_repo_writes(monkeypatch, tmp_path):
     """Belt-and-braces: if a regression routes a stubbed watcher at the
-    real log/capture helpers, write into tmp instead of the repo."""
+    real log/capture helpers, write into tmp instead of the repo; the
+    post-capture SLO gate scan must not read the real banked artifacts
+    either."""
     monkeypatch.setattr(bench_watch, "WATCH_LOG",
                         str(tmp_path / "watch.jsonl"))
     monkeypatch.setattr(bench_watch, "CAPTURE_FILE",
                         str(tmp_path / "self.json"))
+    monkeypatch.setattr(bench_watch, "_banked_simload_pairs", lambda: [])
+
+
+# ---------------------------------------------------------------------------
+# SLO regression gate (tools/bench_watch.slo_gate)
+# ---------------------------------------------------------------------------
+
+# The autouse fixture stubs _banked_simload_pairs (watcher tests must not
+# read the real banked artifacts); the discovery test needs the original.
+_REAL_BANKED_PAIRS = bench_watch._banked_simload_pairs
+
+
+def _artifact(p50=20.0, p95=80.0, n=100, attribution=True):
+    block = {"n": n, "p50_ms": p50, "p95_ms": p95, "p99_ms": p95 * 2,
+             "max_ms": p95 * 3}
+    if attribution:
+        return {"latency_attribution": {"submit_to_placed_ms": block,
+                                        "submit_to_running_ms": {"n": 0}}}
+    # Pre-r08 shape: plan latency only (same event anchors).
+    return {"plan_latency_ms": block}
+
+
+def test_slo_gate_passes_inside_threshold():
+    """Inside the objective, the gate never fails — even 2x slower than
+    the baseline (latency headroom is the SLO's to spend)."""
+    verdict = bench_watch.slo_gate(_artifact(p95=200.0),
+                                   _artifact(p95=80.0))
+    assert verdict["ok"] is True
+    placed = next(c for c in verdict["checks"]
+                  if c["objective"] == "submit_to_placed_p95_ms")
+    assert placed["met"] is True and placed["regressed"] is False
+    assert placed["baseline_ms"] == 80.0
+
+
+def test_slo_gate_fails_newly_broken_objective():
+    """An objective the baseline met that the new run misses is a
+    regression, full stop."""
+    verdict = bench_watch.slo_gate(_artifact(p95=300.0),
+                                   _artifact(p95=200.0))
+    assert verdict["ok"] is False
+    placed = next(c for c in verdict["checks"]
+                  if c["objective"] == "submit_to_placed_p95_ms")
+    assert placed["regressed"] is True
+
+
+def test_slo_gate_tolerance_when_both_outside():
+    """Both runs outside the objective: only a >tolerance worsening
+    fails (the gate hunts regressions, not pre-existing debt)."""
+    base = _artifact(p95=400.0)
+    within = bench_watch.slo_gate(_artifact(p95=450.0), base)
+    assert within["ok"] is True  # 12.5% worse, inside the 25% tolerance
+    beyond = bench_watch.slo_gate(_artifact(p95=600.0), base)
+    assert beyond["ok"] is False  # 50% worse
+
+
+def test_slo_gate_pre_r08_baseline_fallback():
+    """A banked r07 artifact has no latency_attribution; its
+    plan_latency_ms (the same submit→placed event anchors) still gates
+    the placed objectives."""
+    verdict = bench_watch.slo_gate(
+        _artifact(p95=300.0), _artifact(p95=100.0, attribution=False))
+    placed = next(c for c in verdict["checks"]
+                  if c["objective"] == "submit_to_placed_p95_ms")
+    assert placed["baseline_ms"] == 100.0
+    assert placed["regressed"] is True
+    # Unobservable objectives (no running samples either side) are
+    # reported, never failed.
+    running = next(c for c in verdict["checks"]
+                   if c["objective"] == "submit_to_running_p95_ms")
+    assert running["met"] is None and running["regressed"] is False
+
+
+def test_slo_gate_scan_logs_per_family(tmp_path, monkeypatch):
+    new = tmp_path / "SIMLOAD_x_s42_r08.json"
+    old = tmp_path / "SIMLOAD_x_s42_r07.json"
+    new.write_text(json.dumps(_artifact(p95=300.0)))
+    old.write_text(json.dumps(_artifact(p95=100.0)))
+    monkeypatch.setattr(
+        bench_watch, "_banked_simload_pairs",
+        lambda: [("x_s42", str(new), str(old))])
+    logged = []
+
+    def fake_log(event, **kw):
+        logged.append({"event": event, **kw})
+
+    assert bench_watch.slo_gate_scan(log=fake_log) is False
+    assert logged == [{
+        "event": "slo-gate", "family": "x_s42",
+        "new": new.name, "baseline": old.name, "ok": False,
+        "regressed": ["submit_to_placed_p95_ms"],
+    }]
+
+
+def test_banked_pair_discovery_orders_rounds(tmp_path, monkeypatch):
+    for name in ("SIMLOAD_steady_s42.json", "SIMLOAD_steady_s42_r06.json",
+                 "SIMLOAD_steady_s42_r08.json", "SIMLOAD_lone_s7.json",
+                 "not_a_simload.json"):
+        (tmp_path / name).write_text("{}")
+    monkeypatch.setattr(bench_watch, "REPO", str(tmp_path))
+    pairs = _REAL_BANKED_PAIRS()
+    assert pairs == [(
+        "steady_s42",
+        str(tmp_path / "SIMLOAD_steady_s42_r08.json"),
+        str(tmp_path / "SIMLOAD_steady_s42_r06.json"),
+    )]

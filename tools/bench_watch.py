@@ -242,6 +242,111 @@ def run_capture(kind: str, argv: list, timeout: float,
     return entry
 
 
+# ---------------------------------------------------------------------------
+# SLO regression gate: banked SIMLOAD artifacts vs their previous round
+# ---------------------------------------------------------------------------
+
+# Latency-percentile regression tolerance: a new artifact that is inside
+# its SLO threshold never fails the gate; one outside it fails only when
+# it is ALSO >25% worse than the banked baseline (p50-scale numbers at
+# ~20ms jitter a few percent run-to-run; 25% is a real regression).
+SLO_GATE_TOLERANCE = 0.25
+
+
+def _attribution_of(artifact: dict) -> dict:
+    """A SIMLOAD artifact's latency percentiles in evaluate_artifact
+    shape. Pre-r08 artifacts carry no ``latency_attribution`` — but their
+    ``plan_latency_ms`` IS submit→placed (EvalUpdated(pending) → first
+    PlanApplied, the same event anchors), so a banked r07 baseline still
+    gates the placed-side objectives."""
+    att = artifact.get("latency_attribution")
+    if att:
+        return att
+    return {"submit_to_placed_ms": artifact.get("plan_latency_ms") or {}}
+
+
+def slo_gate(new_artifact: dict, baseline_artifact: dict,
+             objectives: dict | None = None,
+             tolerance: float = SLO_GATE_TOLERANCE) -> dict:
+    """Gate a fresh SIMLOAD artifact against a banked baseline: for each
+    SLO objective (nomad_tpu.slo; default set when ``objectives`` is
+    None), FAIL when the new run misses an objective the baseline met, or
+    when its observed percentile is outside the threshold AND more than
+    ``tolerance`` worse than the baseline. Objectives neither run can
+    observe (no samples) are reported, not failed."""
+    from nomad_tpu.slo import evaluate_artifact
+
+    new_checks = evaluate_artifact(_attribution_of(new_artifact), objectives)
+    base_checks = {
+        c["objective"]: c
+        for c in evaluate_artifact(_attribution_of(baseline_artifact),
+                                   objectives)
+    }
+    checks, ok = [], True
+    for c in new_checks:
+        base = base_checks.get(c["objective"], {})
+        verdict = dict(c)
+        verdict["baseline_ms"] = base.get("observed_ms")
+        regressed = False
+        if c["met"] is False:
+            if base.get("met"):
+                regressed = True          # objective newly broken
+            elif (base.get("observed_ms")
+                    and c["observed_ms"]
+                    > base["observed_ms"] * (1.0 + tolerance)):
+                regressed = True          # already-out objective worsened
+        verdict["regressed"] = regressed
+        ok = ok and not regressed
+        checks.append(verdict)
+    return {"ok": ok, "tolerance": tolerance, "checks": checks}
+
+
+def _banked_simload_pairs() -> list:
+    """(scenario, newest artifact path, previous-round path) for every
+    banked ``SIMLOAD_<scenario>_s<seed>[_rNN].json`` family with at least
+    two rounds on disk. Un-suffixed artifacts count as round 0."""
+    import re
+
+    fams: dict = {}
+    for f in sorted(os.listdir(REPO)):
+        m = re.match(r"SIMLOAD_(.+_s\d+?)(?:_r(\d+))?\.json$", f)
+        if m:
+            fams.setdefault(m.group(1), []).append(
+                (int(m.group(2) or 0), os.path.join(REPO, f))
+            )
+    out = []
+    for fam, rounds in sorted(fams.items()):
+        rounds.sort()
+        if len(rounds) >= 2:
+            out.append((fam, rounds[-1][1], rounds[-2][1]))
+    return out
+
+
+def slo_gate_scan(log=log) -> bool:
+    """Run the SLO gate over every banked artifact family's newest-vs-
+    previous pair; log one verdict per family. Returns overall pass."""
+    ok = True
+    for fam, new_path, base_path in _banked_simload_pairs():
+        try:
+            with open(new_path) as f:
+                new = json.load(f)
+            with open(base_path) as f:
+                base = json.load(f)
+            verdict = slo_gate(new, base)
+        except (OSError, ValueError, KeyError) as e:
+            log("slo-gate-error", family=fam, error=str(e))
+            ok = False
+            continue
+        log("slo-gate", family=fam,
+            new=os.path.basename(new_path),
+            baseline=os.path.basename(base_path),
+            ok=verdict["ok"],
+            regressed=[c["objective"] for c in verdict["checks"]
+                       if c["regressed"]])
+        ok = ok and verdict["ok"]
+    return ok
+
+
 PIDFILE = os.path.join(REPO, ".bench_watch.pid")
 
 
@@ -345,9 +450,22 @@ class CaptureWatcher:
         if bench["ok"]:
             self.last_capture_t = self.clock()
             self.last_capture_commit = commit
+            # A closed window is also the moment the banked SIMLOAD story
+            # gets re-checked: the SLO gate compares every artifact
+            # family's newest round against its previous one, so a
+            # capture session that banked a regressed r0N is flagged in
+            # the same log that proves the capture.
+            slo_gate_scan(log=self.log)
 
 
 def main() -> None:
+    # One-shot CI mode: `python tools/bench_watch.py --slo-gate` runs the
+    # SLO regression gate over the banked SIMLOAD families and exits —
+    # the path tools/tier1.py and release checks call, no watcher loop.
+    if "--slo-gate" in sys.argv[1:]:
+        def stdout_log(event: str, **kw) -> None:
+            print(json.dumps({"event": event, **kw}))
+        sys.exit(0 if slo_gate_scan(log=stdout_log) else 1)
     # Single-instance guard: two overlapping watchers would race the
     # capture file's read-modify-write and double-claim the device window.
     if os.path.exists(PIDFILE):

@@ -70,6 +70,18 @@ def capture_bundle(path: str) -> str:
                     bundle["simload_artifact"] = {
                         "path": latest, "data": json.load(f),
                     }
+                # SLO verdicts over the embedded artifact: the bundle's
+                # own `slo`/`timelines` sections capture THIS process
+                # (live state), while the artifact check records whether
+                # the last banked control-plane run was inside the
+                # objectives — both views ride a red run.
+                att = bundle["simload_artifact"]["data"].get(
+                    "latency_attribution")
+                if att:
+                    from nomad_tpu.slo import evaluate_artifact
+
+                    bundle["simload_artifact"]["slo_check"] = (
+                        evaluate_artifact(att))
             except (OSError, ValueError) as e:
                 bundle["simload_artifact"] = {"path": latest,
                                               "error": str(e)}
