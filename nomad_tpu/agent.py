@@ -40,6 +40,13 @@ class AgentConfig:
     num_schedulers: int = 0
     enabled_schedulers: List[str] = field(default_factory=list)
     bootstrap_expect: int = 0
+    # Admission control & backpressure (nomad_tpu/server/admission.py):
+    # bounded queues (0 = unbounded) + the admission front-door spec
+    # (per-client rate lanes, SLO-coupled shedding; None = permissive).
+    eval_pending_cap: int = 0
+    plan_queue_cap: int = 0
+    max_blocking_watchers: int = 0
+    admission: Optional[Dict] = None
     enable_debug: bool = False
     statsite_addr: str = ""
     statsd_addr: str = ""
@@ -122,6 +129,11 @@ class AgentConfig:
                             or fc.server.num_schedulers),
             enabled_schedulers=list(fc.server.enabled_schedulers),
             bootstrap_expect=fc.server.bootstrap_expect,
+            eval_pending_cap=fc.server.eval_pending_cap,
+            plan_queue_cap=fc.server.plan_queue_cap,
+            max_blocking_watchers=fc.server.max_blocking_watchers,
+            admission=(dict(fc.server.admission)
+                       if fc.server.admission is not None else None),
             enable_debug=fc.enable_debug,
             statsite_addr=fc.telemetry.statsite_address,
             statsd_addr=fc.telemetry.statsd_address,
@@ -208,6 +220,11 @@ class Agent:
             node_name=self.config.node_name or "server",
             scheduler_backend=self.config.scheduler_backend,
             tls=self.config.tls,
+            eval_pending_cap=self.config.eval_pending_cap,
+            plan_queue_cap=self.config.plan_queue_cap,
+            max_blocking_watchers=self.config.max_blocking_watchers,
+            admission=(dict(self.config.admission)
+                       if self.config.admission is not None else None),
         )
         if self.config.event_buffer_size:
             server_config.event_buffer_size = self.config.event_buffer_size

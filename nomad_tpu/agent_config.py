@@ -65,10 +65,26 @@ class ClientBlock:
 @dataclass
 class ServerBlock:
     """config.go ServerConfig block, extended with the optimistic
-    scheduling knob: ``scheduler_workers`` is the first-class spelling of
-    worker concurrency (N workers evaluate concurrently, the plan
-    pipeline resolves conflicts optimistically); ``num_schedulers`` is
-    the legacy alias. 0 = server default."""
+    scheduling knob (``scheduler_workers`` is the first-class spelling of
+    worker concurrency; ``num_schedulers`` the legacy alias; 0 = server
+    default) and the admission/backpressure knobs
+    (nomad_tpu/server/admission.py): ``eval_pending_cap`` bounds the
+    broker's pending evals, ``plan_queue_cap`` the plan queue,
+    ``max_blocking_watchers`` the blocking-query watcher registrations —
+    all 0 = unbounded — and the ``admission { }`` sub-block configures
+    per-client token-bucket rate lanes + SLO-coupled shedding::
+
+        server {
+          eval_pending_cap = 4096
+          plan_queue_cap = 512
+          max_blocking_watchers = 50000
+          admission {
+            client_rate = 10
+            client_burst = 50
+            shed_start_burn = 2.0
+          }
+        }
+    """
 
     enabled: bool = False
     bootstrap_expect: int = 0
@@ -76,6 +92,10 @@ class ServerBlock:
     protocol_version: int = 0
     num_schedulers: int = 0
     scheduler_workers: int = 0
+    eval_pending_cap: int = 0
+    plan_queue_cap: int = 0
+    max_blocking_watchers: int = 0
+    admission: Optional[Dict[str, object]] = None
     enabled_schedulers: List[str] = field(default_factory=list)
     start_join: List[str] = field(default_factory=list)
 
@@ -241,6 +261,24 @@ class FileConfig:
             scheduler_workers=(
                 other.server.scheduler_workers or self.server.scheduler_workers
             ),
+            eval_pending_cap=(
+                other.server.eval_pending_cap or self.server.eval_pending_cap
+            ),
+            plan_queue_cap=(
+                other.server.plan_queue_cap or self.server.plan_queue_cap
+            ),
+            max_blocking_watchers=(
+                other.server.max_blocking_watchers
+                or self.server.max_blocking_watchers
+            ),
+            # Admission knobs merge key-by-key like client.meta: a later
+            # file overrides one knob without dropping the rest; None
+            # means "no block here" and defers to the other layer.
+            admission=(
+                self.server.admission if other.server.admission is None
+                else other.server.admission if self.server.admission is None
+                else {**self.server.admission, **other.server.admission}
+            ),
             enabled_schedulers=(
                 other.server.enabled_schedulers or self.server.enabled_schedulers
             ),
@@ -392,6 +430,26 @@ def _from_mapping(data: dict) -> FileConfig:
                             f"server.{k} must be in [0, 128], got {n}"
                         )
                     setattr(cfg.server, k, n)
+                elif k in ("eval_pending_cap", "plan_queue_cap",
+                           "max_blocking_watchers"):
+                    # Queue/watcher bounds: parse-time validated like
+                    # scheduler_workers — a typo'd cap must fail config
+                    # load, not silently unbound a production queue.
+                    n = int(v)
+                    if not 0 <= n <= 10_000_000:
+                        raise ValueError(
+                            f"server.{k} must be in [0, 10000000], got {n}"
+                        )
+                    setattr(cfg.server, k, n)
+                elif k == "admission":
+                    if not isinstance(v, dict):
+                        raise ValueError("server.admission must be a mapping")
+                    # Parse-time validation: unknown keys / bad ranges
+                    # fail here (AdmissionConfig.parse), not agent start.
+                    from nomad_tpu.server.admission import AdmissionConfig
+
+                    AdmissionConfig.parse(dict(v))
+                    cfg.server.admission = dict(v)
                 elif k in ("bootstrap_expect", "protocol_version"):
                     setattr(cfg.server, k, int(v))
                 else:

@@ -20,10 +20,13 @@ from nomad_tpu.api.codec import from_dict, to_dict
 from nomad_tpu.structs import (
     MAX_QUERY_TIME,
     MAX_QUERY_TIME_PAD,
+    REJECT_RATE_LIMITED,
     Allocation,
     Evaluation,
     Job,
     Node,
+    RejectError,
+    parse_reject,
 )
 
 DEFAULT_ADDRESS = "http://127.0.0.1:4646"
@@ -33,6 +36,40 @@ class ApiError(Exception):
     def __init__(self, code: int, message: str):
         super().__init__(f"unexpected response code {code}: {message}")
         self.code = code
+
+
+def _rejection_from_http(code: int, body: str,
+                         retry_after_header: str) -> Optional[RejectError]:
+    """Recover the typed rejection from a 429/503 response. The JSON body
+    carries reason + float retry_after; the Retry-After header (integer
+    seconds) is the fallback when only it survived a proxy."""
+    if code not in (429, 503):
+        return None
+    rejection = None
+    try:
+        payload = json.loads(body)
+        # A proxy may rewrite the body to any JSON value; only an object
+        # can carry our reject shape.
+        reason = payload.get("reason") if isinstance(payload, dict) else None
+        if reason:
+            rejection = RejectError(
+                reason, payload.get("error", ""),
+                retry_after=float(payload.get("retry_after", 0.0)),
+            )
+    except (ValueError, TypeError):
+        rejection = parse_reject(body)
+    if rejection is None and retry_after_header:
+        # Body lost in transit, header survived: infer the reason class
+        # from the status code the server maps reasons onto (429 =
+        # client-paced RATE_LIMITED/SHED, 503 = capacity QUEUE_FULL) so
+        # the retry policy stays correct.
+        try:
+            return RejectError(
+                REJECT_RATE_LIMITED if code == 429 else "QUEUE_FULL",
+                body.strip(), retry_after=float(retry_after_header))
+        except ValueError:
+            return None
+    return rejection
 
 
 @dataclass
@@ -56,11 +93,23 @@ class QueryMeta:
 
 
 class ApiClient:
-    """api.go:157-241"""
+    """api.go:157-241
 
-    def __init__(self, address: str = DEFAULT_ADDRESS, region: str = ""):
+    ``client_id`` stamps every request's X-Nomad-Client header so the
+    server's admission rate lanes can attribute load per caller.
+    ``reject_retries`` bounds the SDK's automatic handling of typed
+    RATE_LIMITED rejections: the retry sleeps max(server retry-after
+    hint, jittered backoff) — honoring the hint instead of hot-looping —
+    then surfaces a typed RejectError (never a bare HTTP error) once the
+    budget is spent. Rejections are raised BEFORE any server-side effect
+    (the admission contract), so replaying even writes is safe."""
+
+    def __init__(self, address: str = DEFAULT_ADDRESS, region: str = "",
+                 client_id: str = "", reject_retries: int = 2):
         self.address = address.rstrip("/")
         self.region = region
+        self.client_id = client_id
+        self.reject_retries = max(0, int(reject_retries))
 
     # -- raw verbs (api.go:243-376) -----------------------------------------
 
@@ -85,31 +134,58 @@ class ApiClient:
     def _do(self, method: str, path: str, body: Any = None,
             q: Optional[QueryOptions] = None,
             params: Optional[Dict] = None) -> Tuple[Any, QueryMeta]:
+        from nomad_tpu.backoff import MAX_RETRY_AFTER_SLEEP, Backoff
+
         url = self._url(path, q, params or {})
         data = json.dumps(to_dict(body)).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
-        try:
-            with urllib.request.urlopen(
-                req, timeout=MAX_QUERY_TIME + MAX_QUERY_TIME_PAD
-            ) as resp:
-                meta = QueryMeta(
-                    last_index=int(resp.headers.get("X-Nomad-Index", 0)),
-                    last_contact=float(
-                        resp.headers.get("X-Nomad-LastContact", 0)
-                    ),
-                    known_leader=resp.headers.get("X-Nomad-KnownLeader")
-                    == "true",
-                )
-                payload = resp.read()
-                return (json.loads(payload) if payload else None), meta
-        except urllib.error.HTTPError as e:
-            raise ApiError(e.code, e.read().decode(errors="replace")) from e
-        except urllib.error.URLError as e:
-            raise ApiError(
-                0, f"failed to reach agent at {self.address}: {e.reason}"
-            ) from e
+        bo = Backoff(base=0.05, max_delay=1.0)
+        attempt = 0
+        while True:
+            req = urllib.request.Request(url, data=data, method=method)
+            if data is not None:
+                req.add_header("Content-Type", "application/json")
+            if self.client_id:
+                req.add_header("X-Nomad-Client", self.client_id)
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=MAX_QUERY_TIME + MAX_QUERY_TIME_PAD
+                ) as resp:
+                    meta = QueryMeta(
+                        last_index=int(resp.headers.get("X-Nomad-Index", 0)),
+                        last_contact=float(
+                            resp.headers.get("X-Nomad-LastContact", 0)
+                        ),
+                        known_leader=resp.headers.get("X-Nomad-KnownLeader")
+                        == "true",
+                    )
+                    payload = resp.read()
+                    return (json.loads(payload) if payload else None), meta
+            except urllib.error.HTTPError as e:
+                text = e.read().decode(errors="replace")
+                rejection = _rejection_from_http(
+                    e.code, text, e.headers.get("Retry-After", ""))
+                if rejection is None:
+                    raise ApiError(e.code, text) from e
+                # Typed rejection: provably no server-side effect, so a
+                # replay is always safe. Only RATE_LIMITED auto-retries
+                # (pacing is the client's job); capacity rejections
+                # (QUEUE_FULL/SHED/WATCH_LIMIT) surface typed at once —
+                # retrying into an overload is the loop backpressure
+                # exists to break. A hint past the sleep ceiling also
+                # surfaces: sleeping a clamped slice of it guarantees
+                # another rejection — the caller owns waits that long.
+                if (rejection.reason != REJECT_RATE_LIMITED
+                        or attempt >= self.reject_retries
+                        or rejection.retry_after > MAX_RETRY_AFTER_SLEEP):
+                    raise rejection from e
+                attempt += 1
+                import time as _time
+
+                _time.sleep(max(rejection.retry_after, bo.next_delay()))
+            except urllib.error.URLError as e:
+                raise ApiError(
+                    0, f"failed to reach agent at {self.address}: {e.reason}"
+                ) from e
 
     def query(self, path: str, q: Optional[QueryOptions] = None,
               params: Optional[Dict] = None) -> Tuple[Any, QueryMeta]:
@@ -324,6 +400,13 @@ class AgentApi:
         percentiles, rolling error budgets, and burn rates
         (nomad_tpu.slo)."""
         out, _ = self.client.query("/v1/agent/slo")
+        return out
+
+    def admission(self) -> Dict:
+        """Admission front-door state (/v1/agent/admission): decision
+        counters, per-client rate lanes, recent typed rejections, and
+        the bounded-queue posture (nomad_tpu/server/admission.py)."""
+        out, _ = self.client.query("/v1/agent/admission")
         return out
 
     def debug_bundle(self, events: int = 0) -> Dict:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -25,7 +26,14 @@ from nomad_tpu.server.blocking import blocking_query
 from nomad_tpu.state.store import (
     item_table,
 )
-from nomad_tpu.structs import MAX_QUERY_TIME, Job, ValidationError
+from nomad_tpu.structs import (
+    MAX_QUERY_TIME,
+    REJECT_QUEUE_FULL,
+    REJECT_WATCH_LIMIT,
+    Job,
+    RejectError,
+    ValidationError,
+)
 
 
 def _prefix_filter(items, query):
@@ -191,6 +199,7 @@ class HTTPServer:
             (r"^/v1/event/stream$", self.event_stream),
             (r"^/v1/agent/self$", self.agent_self),
             (r"^/v1/agent/slo$", self.agent_slo),
+            (r"^/v1/agent/admission$", self.agent_admission),
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
             (r"^/v1/agent/debug$", self.agent_debug),
@@ -226,6 +235,8 @@ class HTTPServer:
                 out, index = handler(req, query, **m.groupdict())
             except HTTPCodedError as e:
                 self._respond_error(req, e.code, str(e))
+            except RejectError as e:
+                self._respond_reject(req, e)
             except KeyError as e:
                 # Endpoints raise KeyError for missing resources
                 self._respond_error(req, 404, str(e).strip("'\""))
@@ -272,6 +283,28 @@ class HTTPServer:
         req.end_headers()
         req.wfile.write(body)
 
+    def _respond_reject(self, req, e: RejectError) -> None:
+        """Typed admission/backpressure rejection: 429 for client-paced
+        reasons (rate lane empty, SLO shed — 'you, slow down'), 503 for
+        server-capacity reasons (queue/watcher caps — 'everyone, later').
+        The Retry-After header carries the hint in whole seconds (RFC
+        7231 grammar); the JSON body keeps the float and the typed reason
+        so the SDK retries with full precision."""
+        code = 503 if e.reason in (REJECT_QUEUE_FULL,
+                                   REJECT_WATCH_LIMIT) else 429
+        body = json.dumps({
+            "error": str(e),
+            "reason": e.reason,
+            "retry_after": e.retry_after,
+        }).encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Retry-After",
+                        str(max(1, math.ceil(e.retry_after))))
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
     def _read_body(self, req) -> Dict:
         length = int(req.headers.get("Content-Length", 0))
         if length == 0:
@@ -304,17 +337,18 @@ class HTTPServer:
             remaining = end - _time.monotonic()
             if remaining <= 0:
                 return
-            event = threading.Event()
-            store.watch.watch([item_table(table)], event)
+            # register may raise a typed RejectError(WATCH_LIMIT) — the
+            # dispatcher maps it to a 503 with Retry-After.
+            ticket = store.watch.register([item_table(table)])
             try:
                 # Identity re-check closes the register-vs-rebind race; a
                 # rebind after registration fires notify_all on the old
                 # store, so a full-length wait is safe.
                 if (self.agent.server.state_store is store
                         and store.get_index(table) <= min_index):
-                    event.wait(remaining)
+                    store.watch.wait(ticket, timeout=remaining)
             finally:
-                store.watch.stop_watch([item_table(table)], event)
+                store.watch.unregister(ticket)
 
     def _srv(self):
         if self.agent.server is None:
@@ -325,6 +359,14 @@ class HTTPServer:
     def _require_write(req) -> None:
         if req.command not in ("PUT", "POST"):
             raise HTTPCodedError(405, "method not allowed")
+
+    @staticmethod
+    def _client_id(req, query: Dict[str, str]) -> str:
+        """Caller identity for per-client admission rate lanes: the
+        ``X-Nomad-Client`` header (the SDK sets it) or ``?client_id=``.
+        Empty = the shared anonymous lane."""
+        return (req.headers.get("X-Nomad-Client")
+                or query.get("client_id", "") or "")
 
     # -- job endpoints (command/agent/job_endpoint.go) -----------------------
 
@@ -338,7 +380,8 @@ class HTTPServer:
         if req.command in ("PUT", "POST"):
             payload = self._read_body(req)
             job = from_dict(Job, payload.get("job", payload))
-            eval_id, index = srv.job_register(job)
+            eval_id, index = srv.job_register(
+                job, client_id=self._client_id(req, query))
             return {"eval_id": eval_id, "eval_create_index": index,
                     "job_modify_index": index, "index": index}, index
         raise HTTPCodedError(405, "method not allowed")
@@ -356,7 +399,8 @@ class HTTPServer:
             job = from_dict(Job, payload.get("job", payload))
             if job.id != job_id:
                 raise HTTPCodedError(400, "job ID does not match request path")
-            eval_id, index = srv.job_register(job)
+            eval_id, index = srv.job_register(
+                job, client_id=self._client_id(req, query))
             return {"eval_id": eval_id, "index": index}, index
         if req.command == "DELETE":
             eval_id, index = srv.job_deregister(job_id)
@@ -380,7 +424,8 @@ class HTTPServer:
     def job_evaluate(self, req, query, job_id: str) -> Tuple[Any, int]:
         self._require_write(req)
         srv = self._srv()
-        eval_id, index = srv.job_evaluate(job_id)
+        eval_id, index = srv.job_evaluate(
+            job_id, client_id=self._client_id(req, query))
         return {"eval_id": eval_id, "index": index}, index
 
     # -- node endpoints ------------------------------------------------------
@@ -618,16 +663,20 @@ class HTTPServer:
                 )
                 if deadline is not None and remaining <= 0:
                     return
-                woke = threading.Event()
-                items = tfilter.watch_items()
-                broker.watch.watch(items, woke)
+                try:
+                    ticket = broker.watch.register(tfilter.watch_items())
+                except RejectError:
+                    # Watcher cap mid-stream: the 200 already went out, so
+                    # closing the tail is the only honest backpressure.
+                    return
                 try:
                     if broker.index_for(tfilter) <= cursor:
-                        fired = woke.wait(timeout=min(15.0, remaining))
+                        fired = broker.watch.wait(
+                            ticket, timeout=min(15.0, remaining))
                     else:
                         fired = True
                 finally:
-                    broker.watch.stop_watch(items, woke)
+                    broker.watch.unregister(ticket)
                 if not fired:
                     # Keep-alive comment; also how a dead client is
                     # detected while the stream is idle.
@@ -653,6 +702,27 @@ class HTTPServer:
                                       "(empty slo_objectives)")
         return monitor.snapshot(), None
 
+    def agent_admission(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Admission front-door state (nomad_tpu/server/admission.py):
+        decision counters per lane/reason, per-client rate-lane table
+        summary, the recent-rejection ring, current SLO burn coupling,
+        and the bounded-queue/watcher-cap posture — what an operator
+        reads when clients report 429/503s."""
+        srv = self._srv()
+        admission = getattr(srv, "admission", None)
+        if admission is None:
+            raise HTTPCodedError(404, "admission controller not running")
+        out = admission.snapshot()
+        out["queues"] = {
+            "eval_pending": srv.eval_broker.pending_total(),
+            "eval_pending_cap": srv.config.eval_pending_cap,
+            "plan_queue_depth": srv.plan_queue.depth(),
+            "plan_queue_cap": srv.config.plan_queue_cap,
+            "watchers": srv.state_store.watch.stats(),
+            "event_watchers": srv.fsm.events.watch.stats(),
+        }
+        return out, None
+
     def agent_metrics(self, req, query) -> Tuple[Any, Optional[int]]:
         """Live InmemSink aggregates. Default JSON (all retained
         intervals, plus the device-mirror cache's delta economy);
@@ -667,13 +737,40 @@ class HTTPServer:
                 (telemetry.prometheus_text(sink)
                  + _mirror_prometheus_text()
                  + _plan_pipeline_prometheus_text()
-                 + _trace_prometheus_text()).encode(),
+                 + _trace_prometheus_text()
+                 + self._admission_prometheus_text()).encode(),
                 "text/plain; version=0.0.4",
             ), None
         return {"timestamp": trace.now(), "intervals": sink.data(),
                 "mirror_cache": _mirror_cache_stats(),
                 "plan_pipeline": _plan_pipeline_stats(),
+                "admission": self._admission_stats(),
                 "trace": trace.get_tracer().stats()}, None
+
+    def _admission_stats(self) -> Optional[Dict[str, Any]]:
+        """Admission decision totals for the metrics JSON body (None when
+        no server / controller runs — the metrics endpoint must answer on
+        a client-only agent too)."""
+        server = getattr(self.agent, "server", None)
+        admission = getattr(server, "admission", None)
+        return admission.summary() if admission is not None else None
+
+    def _admission_prometheus_text(self) -> str:
+        """Admission counters as Prometheus lines: admitted/rejected
+        totals per lane plus the typed-rejection split."""
+        stats = self._admission_stats()
+        if not stats:
+            return ""
+        lines = []
+        for k in ("admitted", "rejected"):
+            name = f"nomad_admission_{k}_total"
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {stats[k]}")
+        name = "nomad_admission_rejected_reason_total"
+        lines.append(f"# TYPE {name} counter")
+        for reason, n in sorted(stats.get("by_reason", {}).items()):
+            lines.append(f'{name}{{reason="{reason}"}} {n}')
+        return "\n".join(lines) + "\n" if lines else ""
 
     def agent_traces(self, req, query) -> Tuple[Any, Optional[int]]:
         """Summaries of the tracer's retained traces, newest first

@@ -109,19 +109,42 @@ class Backoff:
         return not self.expired
 
 
-def retry_undelivered(fn: Callable, retries: int = 2,
-                      backoff: Optional[Backoff] = None):
-    """Run ``fn`` retrying ONLY provably-undelivered transport failures.
+# Ceiling on honoring a server's retry-after hint in one sleep: a hint of
+# minutes is the server's honest schedule, but a synchronous caller
+# blocked that long has usually out-lived its own deadline — surface the
+# typed rejection instead and let the caller decide.
+MAX_RETRY_AFTER_SLEEP = 30.0
 
-    The distinction this encodes (rpc.py:78-88): RPCUndeliveredError means
-    the handler never ran — safe to replay even non-idempotent RPCs;
-    anything else (RemoteError, RPCTimeoutError, plain RPCError) may have
-    executed remotely and surfaces to the caller immediately.
+
+def retry_undelivered(fn: Callable, retries: int = 2,
+                      backoff: Optional[Backoff] = None,
+                      rate_limit_retries: int = 2):
+    """Run ``fn`` retrying only failures that are PROVABLY side-effect
+    free to replay.
+
+    Two such classes exist (rpc.py:78-88 + structs.RejectError):
+
+    - RPCUndeliveredError: the frame never reached the peer — the handler
+      never ran, so even non-idempotent RPCs replay safely.
+    - A typed ``RATE_LIMITED`` rejection (the admission front door,
+      server/admission.py): raised BEFORE any raft apply, so nothing
+      executed; the retry sleeps max(the server's retry-after hint,
+      the jittered backoff) — honoring the hint instead of hot-looping,
+      bounded by ``rate_limit_retries``.
+
+    Every other rejection reason (QUEUE_FULL, SHED, WATCH_LIMIT)
+    surfaces immediately as a typed RejectError — still retry-SAFE, but
+    retrying into a full queue or an overloaded cluster is exactly the
+    feedback loop backpressure exists to break; the caller owns that
+    decision. Anything else (RemoteError, RPCTimeoutError, plain
+    RPCError) may have executed remotely and surfaces unchanged.
     """
-    from nomad_tpu.rpc import RPCUndeliveredError
+    from nomad_tpu.rpc import RemoteError, RPCUndeliveredError
+    from nomad_tpu.structs import REJECT_RATE_LIMITED, parse_reject
 
     bo = backoff or Backoff(base=0.05, max_delay=0.5)
     attempt = 0
+    rl_attempt = 0
     while True:
         try:
             return fn()
@@ -132,6 +155,31 @@ def retry_undelivered(fn: Callable, retries: int = 2,
             telemetry.incr_counter(("rpc", "client", "retry_undelivered"))
             if not bo.sleep():
                 raise
+        except RemoteError as e:
+            rejection = parse_reject(str(e))
+            if rejection is None:
+                raise
+            if (rejection.reason != REJECT_RATE_LIMITED
+                    or rl_attempt >= rate_limit_retries
+                    # A hint past the ceiling means the server scheduled
+                    # the next token far out: sleeping a clamped slice
+                    # and replaying is a GUARANTEED re-rejection —
+                    # surface the typed rejection and let the caller
+                    # decide (the ceiling's whole point).
+                    or rejection.retry_after > MAX_RETRY_AFTER_SLEEP
+                    # Ditto when the caller's own deadline has expired
+                    # (or would expire mid-sleep): never sleep past a
+                    # budget just to raise afterwards.
+                    or bo.expired):
+                raise rejection from e
+            delay = max(rejection.retry_after, bo.next_delay())
+            if bo.deadline is not None:
+                remaining = bo.deadline - time.monotonic()
+                if delay > remaining:
+                    raise rejection from e
+            rl_attempt += 1
+            telemetry.incr_counter(("rpc", "client", "retry_rate_limited"))
+            time.sleep(delay)
 
 
 # Circuit breaker states. Gauge values chosen so "bigger = less healthy".

@@ -25,6 +25,9 @@ everything the observability stack retains at the moment of capture —
                   the optimistic concurrency is
 - ``slo``         the live SLO snapshot (nomad_tpu.slo): objectives vs
                   observed percentiles, error budgets, burn rates
+- ``admission``   the admission front door (nomad_tpu/server/admission):
+                  decision counters, per-client rate lanes, recent typed
+                  rejections, SLO-shed coupling
 - ``timelines``   the worst-K slowest submit→placed lifecycle timelines
                   (nomad_tpu.lifecycle) stitched from the retained spans
                   and event ring — where the tail's time went
@@ -56,8 +59,8 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 # value is then None or an {"error": ...} stub, never absent).
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
-    "faults", "breaker", "mirror", "plan_pipeline", "slo", "timelines",
-    "nomadlint", "threads",
+    "faults", "breaker", "mirror", "plan_pipeline", "slo", "admission",
+    "timelines", "nomadlint", "threads",
 )
 
 # Every `python -m tools.nomadlint` run writes its full report here; the
@@ -188,6 +191,15 @@ def _slo_section(agent) -> Optional[Dict[str, Any]]:
     return monitor.snapshot() if monitor is not None else None
 
 
+def _admission_section(agent) -> Optional[Dict[str, Any]]:
+    """Admission front-door snapshot (nomad_tpu/server/admission.py):
+    decision counters, rate-lane table, recent typed rejections — where
+    a 'clients are getting 429s' report starts. None without a server."""
+    server = getattr(agent, "server", None) if agent is not None else None
+    admission = getattr(server, "admission", None)
+    return admission.snapshot() if admission is not None else None
+
+
 # Worst-K slowest timelines embedded per bundle: summaries of the tail,
 # not the whole run — a red tier-1 bundle must stay one readable JSON.
 TIMELINE_WORST_K = 8
@@ -242,6 +254,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "mirror": None,
         "plan_pipeline": None,
         "slo": None,
+        "admission": None,
         "timelines": [],
         "nomadlint": None,
         "threads": None,
@@ -255,6 +268,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("mirror", _mirror_section),
         ("plan_pipeline", _plan_pipeline_section),
         ("slo", lambda: _slo_section(agent)),
+        ("admission", lambda: _admission_section(agent)),
         ("timelines", lambda: _timelines_section(agent, last_events)),
         ("nomadlint", _nomadlint_section),
         ("threads", thread_stacks),

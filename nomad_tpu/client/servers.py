@@ -15,7 +15,6 @@ Node.GetAllocs with MinQueryIndex, node_endpoint.go:328).
 from __future__ import annotations
 
 import random
-import threading
 from typing import List, Optional, Tuple
 
 from nomad_tpu.api.codec import from_dict, to_dict
@@ -61,11 +60,11 @@ class InProcessEndpoint:
         while True:
             # Re-read the store each pass: a raft snapshot install rebinds
             # fsm.state, and a watch parked on the orphaned store would
-            # never fire again. Register before reading so a write between
-            # read and wait still fires the event.
+            # never fire again. Register (sampling the coalesced
+            # registry's bucket generations) before reading so a write
+            # between read and wait still wakes us.
             store = self.server.state_store
-            event = threading.Event()
-            store.watch.watch([item], event)
+            ticket = store.watch.register([item])
             try:
                 allocs = store.allocs_by_node(node_id)
                 view = frozenset((a.id, a.modify_index) for a in allocs)
@@ -78,9 +77,9 @@ class InProcessEndpoint:
                 # rebind after registration fires notify_all on the old
                 # store, so a full-length wait is safe.
                 if self.server.state_store is store:
-                    event.wait(timeout=remaining)
+                    store.watch.wait(ticket, timeout=remaining)
             finally:
-                store.watch.stop_watch([item], event)
+                store.watch.unregister(ticket)
 
 
 class RemoteEndpoint:

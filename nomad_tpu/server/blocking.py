@@ -6,6 +6,19 @@ query, retry until the result index passes the caller's MinQueryIndex or
 the timeout lapses. This is that mechanism for our RPC tier; the HTTP tier
 long-polls through the same store watch registry.
 
+Fan-out posture (the ~50k-watcher hardening): the watch registry behind
+this loop is the coalesced index-bucketed ``state.store._Watch`` —
+registration samples bucket generation counters and the writer's notify is
+O(touched items) regardless of how many watchers are parked (the old
+per-watcher ``Event.set()`` fan-out cost the FSM apply thread O(watchers)
+per write; tests/test_wake_storm.py pins the difference). A watcher woken
+by a bucket-sharing neighbor simply re-probes its index and re-parks —
+the loop below has always tolerated spurious wakes. Registrations are
+bounded (``_Watch.max_watchers``, the ``max_blocking_watchers`` server
+knob): past the cap ``register`` raises a typed
+``RejectError(WATCH_LIMIT)`` which propagates to the RPC/HTTP caller as a
+cheap 503-with-retry-after instead of unbounded registry growth.
+
 One subtlety the reference doesn't have: a raft snapshot install rebinds
 ``fsm.state`` to a fresh StateStore, so the live store must be re-read
 every pass and the watch registration raced against the rebind (the old
@@ -15,7 +28,6 @@ registration closes the remaining window).
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Iterable, Tuple
 
@@ -46,6 +58,9 @@ def blocking_query(
       result).
 
     Returns the final (index, result) — on timeout, the last read.
+    Raises ``structs.RejectError(WATCH_LIMIT)`` when the store's watcher
+    cap refuses the registration (typed, retry-after-hinted — never a
+    silent park).
     """
     if index_of is None:
         index_of = lambda store: run(store)[0]  # noqa: E731
@@ -58,16 +73,16 @@ def blocking_query(
         remaining = end - time.monotonic()
         if index_of(store) > min_index or remaining <= 0:
             return run(store)
-        event = threading.Event()
-        watch_items = list(items(store))
-        store.watch.watch(watch_items, event)
+        ticket = store.watch.register(list(items(store)))
         try:
             # Identity re-check closes the register-vs-rebind race; a
             # rebind after registration fires notify_all on the old store,
             # so a full-length wait is safe. The index re-check closes the
-            # write-between-run-and-register race the same way.
+            # write-between-run-and-register race the same way (the
+            # register-then-recheck protocol _Watch's coalesced buckets
+            # rely on for their no-lost-wakeup argument).
             if (get_store() is store
                     and index_of(store) <= min_index):
-                event.wait(timeout=remaining)
+                store.watch.wait(ticket, timeout=remaining)
         finally:
-            store.watch.stop_watch(watch_items, event)
+            store.watch.unregister(ticket)

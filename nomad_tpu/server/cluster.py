@@ -191,6 +191,7 @@ class ClusterServer(Server):
             name="failed-eval-reaper",
         )
         reaper.start()
+        self._start_readmission()
 
     def shutdown(self) -> None:
         super().shutdown()
@@ -285,6 +286,18 @@ class ClusterServer(Server):
                 try:
                     return self.pool.call(leader, method, args,
                                           timeout=timeout)
+                except RemoteError as e:
+                    # Recover typed admission rejections from the error
+                    # envelope: without this, a follower degrades the
+                    # leader's cheap 429/503-with-hint into a generic
+                    # 500 for every HTTP caller (the typed contract must
+                    # not depend on which server the client dialed).
+                    from nomad_tpu.structs import parse_reject
+
+                    rejection = parse_reject(str(e))
+                    if rejection is not None:
+                        raise rejection from e
+                    raise
                 except RPCUndeliveredError:
                     if undelivered_to.get(leader, 0) >= 1 or \
                             len(undelivered_to) >= 3:
@@ -364,17 +377,34 @@ class ClusterServer(Server):
         out = self._forward("Plan.Submit", {"plan": to_dict(plan)})
         return from_dict(PlanResult, out)
 
-    def job_register(self, job: Job):
+    def job_register(self, job: Job, client_id: str = ""):
         # Cross-region submissions route to the owning region first
         # (rpc.go:163-177 forward: region mismatch -> forwardRegion).
+        # client_id rides every hop so the LEADER's admission rate lanes
+        # see the true submitter, not the forwarding server.
         if job.region and job.region != self.config.region:
             out = self.forward_region(
-                job.region, "Job.Register", {"job": to_dict(job)}
+                job.region, "Job.Register",
+                {"job": to_dict(job), "client_id": client_id},
             )
             return out["eval_id"], out["index"]
         if self.raft.is_leader:
-            return super().job_register(job)
-        out = self._forward("Job.Register", {"job": to_dict(job)})
+            return super().job_register(job, client_id=client_id)
+        out = self._forward(
+            "Job.Register", {"job": to_dict(job), "client_id": client_id}
+        )
+        return out["eval_id"], out["index"]
+
+    def job_evaluate(self, job_id: str, client_id: str = ""):
+        # Eval ingress is admission-gated like registration — and the
+        # gate lives on the LEADER (its rate-lane table and live broker
+        # depth are the real ones; a follower's are vacuous). Forward
+        # before checking anything locally.
+        if self.raft.is_leader:
+            return super().job_evaluate(job_id, client_id=client_id)
+        out = self._forward(
+            "Job.Evaluate", {"job_id": job_id, "client_id": client_id}
+        )
         return out["eval_id"], out["index"]
 
     def job_deregister(self, job_id: str):
@@ -447,6 +477,7 @@ class ClusterServer(Server):
         ))
         r("Plan.Submit", self._rpc_plan_submit)
         r("Job.Register", self._rpc_job_register)
+        r("Job.Evaluate", self._rpc_job_evaluate)
         r("Job.Deregister", self._rpc_job_deregister)
         r("Node.Register", lambda a: self.node_register(from_dict(Node, a["node"])))
         r("Node.BatchRegister", lambda a: self.node_batch_register(
@@ -498,7 +529,17 @@ class ClusterServer(Server):
         return to_dict(self.plan_submit(plan))
 
     def _rpc_job_register(self, args: dict):
-        eval_id, index = self.job_register(from_dict(Job, args["job"]))
+        eval_id, index = self.job_register(
+            from_dict(Job, args["job"]),
+            client_id=str(args.get("client_id", "") or ""),
+        )
+        return {"eval_id": eval_id, "index": index}
+
+    def _rpc_job_evaluate(self, args: dict):
+        eval_id, index = self.job_evaluate(
+            args["job_id"],
+            client_id=str(args.get("client_id", "") or ""),
+        )
         return {"eval_id": eval_id, "index": index}
 
     def _rpc_job_deregister(self, args: dict):
@@ -815,6 +856,16 @@ class ClusterServer(Server):
         for addr in addrs:
             try:
                 return self.pool.call(addr, method, args)
+            except RemoteError as e:
+                # Typed rejection from the remote region's front door:
+                # surface it typed (and final — another server of the
+                # same region would consult the same leader).
+                from nomad_tpu.structs import parse_reject
+
+                rejection = parse_reject(str(e))
+                if rejection is not None:
+                    raise rejection from e
+                last = e
             except RPCError as e:
                 last = e
         raise last

@@ -37,10 +37,18 @@ class BrokerError(Exception):
     pass
 
 
+class BrokerFullError(BrokerError):
+    """Typed NACK for an enqueue past the broker's pending cap: the eval
+    stays durable in the state store (it was committed through raft) and
+    is NOT tracked by the broker — the server's readmission loop
+    re-enqueues it when capacity frees. Never silent growth."""
+
+
 ERR_NOT_OUTSTANDING = "evaluation is not outstanding"
 ERR_TOKEN_MISMATCH = "evaluation token does not match"
 ERR_NACK_TIMEOUT_REACHED = "evaluation nack timeout reached"
 ERR_DISABLED = "eval broker disabled"
+ERR_QUEUE_FULL = "eval broker pending cap reached"
 
 
 @dataclass
@@ -104,7 +112,7 @@ class EvalBroker:
     """At-least-once evaluation broker (reference: eval_broker.go:43-111)."""
 
     def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
-                 seed: int = 0):
+                 seed: int = 0, pending_cap: int = 0):
         if nack_timeout < 0:
             raise ValueError("timeout cannot be negative")
         import logging as _logging
@@ -112,6 +120,14 @@ class EvalBroker:
         self.logger = _logging.getLogger("nomad_tpu.eval_broker")
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        # Enforced bound on pending work (ready + blocked + waiting).
+        # 0 = unbounded (the historical posture). An enqueue past the cap
+        # raises BrokerFullError — typed NACK, counted as
+        # broker.depth_limit_breach — and sets the spill flag the
+        # server's readmission loop polls (spilled evals stay durable in
+        # state; the broker never silently grows past the cap).
+        self.pending_cap = int(pending_cap)
+        self._spilled = False
         # Scheduler-queue tie-break stream: seeded per broker (name-salted,
         # the faults.py pattern) so the choice among equal-priority queues
         # never couples to the process-global random cursor.
@@ -170,27 +186,80 @@ class EvalBroker:
     # -- enqueue -----------------------------------------------------------
 
     def enqueue(self, ev: Evaluation, wait_index: int = 0) -> None:
-        """eval_broker.go:131-155"""
+        """eval_broker.go:131-155. Raises BrokerFullError past the
+        pending cap (the eval stays durable in state; see pending_cap)."""
         with self._lock:
             self._enqueue_one_locked(ev, wait_index)
 
-    def enqueue_many(self, evals, wait_index: int = 0) -> None:
+    def enqueue_many(self, evals, wait_index: int = 0) -> int:
         """Atomic multi-enqueue: every eval of one raft entry becomes
         ready under a single lock hold. Without this, the first eval's
         notify races the rest into the queue and a coalescing batch
         dequeuer (dequeue_batch) wakes to a fragment — the burst then
-        solves as several small dispatches instead of one stacked one."""
+        solves as several small dispatches instead of one stacked one.
+
+        The FSM path: a committed entry cannot fail, so over-cap evals
+        SPILL (counted, flag set for the readmission loop) instead of
+        raising; returns how many spilled."""
+        spilled = 0
         with self._lock:
             for ev in evals:
-                self._enqueue_one_locked(ev, wait_index)
+                try:
+                    self._enqueue_one_locked(ev, wait_index)
+                except BrokerFullError:
+                    spilled += 1
+        if spilled:
+            self.logger.debug(
+                "broker %x: SPILL %d evals past pending cap %d",
+                id(self), spilled, self.pending_cap)
+        return spilled
+
+    def pending_total(self) -> int:
+        """Current pending depth (ready + blocked + waiting) — the
+        quantity pending_cap bounds; the admission front door's
+        acceptance-queue probe."""
+        with self._lock:
+            return self._pending_total_locked()
+
+    def _pending_total_locked(self) -> int:
+        return (self.stats.total_ready + self.stats.total_blocked
+                + self.stats.total_waiting)
+
+    def reclaim_spilled(self) -> bool:
+        """The readmission handshake: True exactly once per spill episode
+        once capacity has freed (the server then re-enqueues pending
+        evals from state). The flag re-arms on the next over-cap
+        enqueue."""
+        with self._lock:
+            if not self._spilled:
+                return False
+            if (self.pending_cap
+                    and self._pending_total_locked() >= self.pending_cap):
+                return False
+            self._spilled = False
+            return True
 
     def _enqueue_one_locked(self, ev: Evaluation, wait_index: int) -> None:
+        if ev.id in self._evals:
+            # Already tracked (redelivery bookkeeping): only refresh the
+            # wait index — never counts against the cap.
+            if wait_index:
+                self._wait_index[ev.id] = max(
+                    wait_index, self._wait_index.get(ev.id, 0)
+                )
+            return
+        if (self._enabled and self.pending_cap
+                and self._pending_total_locked() >= self.pending_cap):
+            # Typed NACK before ANY tracking state mutates: a spilled
+            # eval leaves zero residue here (its wait-index floor is
+            # re-derived from the leader's applied index at readmission).
+            self._spilled = True
+            telemetry.incr_counter(("broker", "depth_limit_breach"))
+            raise BrokerFullError(ERR_QUEUE_FULL)
         if wait_index:
             self._wait_index[ev.id] = max(
                 wait_index, self._wait_index.get(ev.id, 0)
             )
-        if ev.id in self._evals:
-            return
         if self._enabled:
             self._evals[ev.id] = 0
             telemetry.incr_counter(("broker", "enqueue"))
@@ -582,6 +651,7 @@ class EvalBroker:
             self._time_wait = {}
             self._wait_index = {}
             self._inflight_plans = {}
+            self._spilled = False
             self.logger.debug("broker %x: FLUSH", id(self))
             self._work_available.notify_all()
 

@@ -163,7 +163,15 @@ class FSM:
             # to whichever prefix the per-eval notify race exposed.
             pending = [ev for ev in evals if ev.should_enqueue()]
             if pending:
-                self.eval_broker.enqueue_many(pending, wait_index=index)
+                # A committed entry cannot fail: past the broker's
+                # pending cap enqueue_many SPILLS (typed, counted) and
+                # the server's readmission loop re-enqueues from state
+                # as capacity frees — bounded queue, no lost evals.
+                spilled = self.eval_broker.enqueue_many(
+                    pending, wait_index=index)
+                if spilled:
+                    telemetry.incr_counter(
+                        ("broker", "enqueue_spilled"), spilled)
 
     def _apply_eval_delete(self, index: int, payload: dict) -> None:
         self.state.delete_eval(index, payload["evals"], payload["allocs"])
@@ -262,6 +270,9 @@ class FSM:
         payload = pickle.loads(data)
         old_store = self.state
         self.state = StateStore()
+        # The watcher-registration cap is configuration, not state: a
+        # snapshot install must not silently unbound the fresh registry.
+        self.state.watch.max_watchers = old_store.watch.max_watchers
         restore = self.state.restore()
         for node in payload["nodes"]:
             restore.node_restore(node)

@@ -22,6 +22,7 @@ class PlanQueueError(Exception):
 
 
 ERR_QUEUE_DISABLED = "plan queue is disabled"
+ERR_QUEUE_FULL = "plan queue depth cap reached"
 
 
 class PendingPlan:
@@ -56,10 +57,16 @@ class PlanQueue:
 
     _counter = itertools.count()
 
-    def __init__(self) -> None:
+    def __init__(self, max_depth: int = 0) -> None:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._enabled = False
+        # Enforced depth cap (0 = unbounded): an enqueue past it raises a
+        # typed PlanQueueError(ERR_QUEUE_FULL) — the submitting worker
+        # fails its eval into the nack/redelivery machinery instead of
+        # the queue growing without bound. Counted as
+        # plan.queue_limit_breach.
+        self.max_depth = int(max_depth)
         self._heap: List[Tuple[int, int, PendingPlan]] = []
 
     @property
@@ -78,6 +85,9 @@ class PlanQueue:
         with self._lock:
             if not self._enabled:
                 raise PlanQueueError(ERR_QUEUE_DISABLED)
+            if self.max_depth and len(self._heap) >= self.max_depth:
+                telemetry.incr_counter(("plan", "queue_limit_breach"))
+                raise PlanQueueError(ERR_QUEUE_FULL)
             pending = PendingPlan(plan)
             heapq.heappush(
                 self._heap, (-plan.priority, next(self._counter), pending)

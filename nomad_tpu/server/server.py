@@ -111,6 +111,23 @@ class ServerConfig:
     slo_objectives: Optional[Dict[str, float]] = None
     # Rolling error-budget window for the SLO burn-rate accounting.
     slo_window_s: float = 3600.0
+    # -- admission control & backpressure (nomad_tpu/server/admission.py).
+    # Enforced bound on the broker's pending evals (ready + blocked +
+    # waiting): the admission front door rejects QUEUE_FULL at it, and
+    # the broker itself spills (typed NACK + readmission) past it for
+    # internally generated evals. 0 = unbounded (historical posture).
+    eval_pending_cap: int = 0
+    # Enforced plan-queue depth cap: enqueue past it is a typed
+    # PlanQueueError(ERR_QUEUE_FULL) -> worker nack. 0 = unbounded.
+    plan_queue_cap: int = 0
+    # Bound on blocking-query watcher registrations (state store + event
+    # stream): past it register raises RejectError(WATCH_LIMIT) -> fast
+    # 503 instead of unbounded registry growth. 0 = unbounded.
+    max_blocking_watchers: int = 0
+    # Admission front-door spec (AdmissionConfig.parse mapping): per-
+    # client token-bucket rate lanes + SLO-coupled shedding. None =
+    # permissive defaults (admit everything — decision-invariant).
+    admission: Optional[Dict] = None
 
     def __post_init__(self) -> None:
         if self.num_schedulers is not None:
@@ -131,6 +148,20 @@ class ServerConfig:
                 "plan_batch_size must be an integer in [1, 256], got "
                 f"{self.plan_batch_size!r}"
             )
+        for knob in ("eval_pending_cap", "plan_queue_cap",
+                     "max_blocking_watchers"):
+            v = getattr(self, knob)
+            if (not isinstance(v, int) or isinstance(v, bool)
+                    or not 0 <= v <= 10_000_000):
+                raise ValueError(
+                    f"{knob} must be an integer in [0, 10000000], got {v!r}"
+                )
+        # Parse-time validation of the admission block (typo'd keys and
+        # out-of-range values fail config load, like scheduler_workers);
+        # the parsed config is what Server consumes.
+        from nomad_tpu.server.admission import AdmissionConfig
+
+        self.admission_config = AdmissionConfig.parse(self.admission)
 
     def scheduler_factory(self, eval_type: str) -> str:
         if self.scheduler_backend == "tpu" and eval_type in (
@@ -154,14 +185,23 @@ class Server:
         self.eval_broker = EvalBroker(
             self.config.eval_nack_timeout, self.config.eval_delivery_limit,
             seed=self.config.seed,
+            pending_cap=self.config.eval_pending_cap,
         )
         self.fsm = FSM(
             eval_broker=self.eval_broker, logger=self.logger,
             events=EventBroker(capacity=self.config.event_buffer_size,
                                emitter=self.config.node_name),
         )
+        # Bounded blocking-query fan-out: the watcher-registration caps
+        # ride the watch registries themselves (typed WATCH_LIMIT
+        # rejection past them, server/blocking.py).
+        if self.config.max_blocking_watchers:
+            self.fsm.state.watch.max_watchers = \
+                self.config.max_blocking_watchers
+            self.fsm.events.watch.max_watchers = \
+                self.config.max_blocking_watchers
         self.raft = InProcRaft(self.fsm)
-        self.plan_queue = PlanQueue()
+        self.plan_queue = PlanQueue(max_depth=self.config.plan_queue_cap)
         self.time_table = TimeTable()
         self.heartbeat = HeartbeatManager(self)
         self.plan_applier = PlanPipeline(
@@ -181,6 +221,22 @@ class Server:
                 self.fsm.events, self.config.slo_objectives,
                 window_s=self.config.slo_window_s,
             )
+        # The bounded front door (server/admission.py): consulted by
+        # job_register/job_evaluate BEFORE any raft apply. Default-
+        # permissive — with no caps/rates configured it admits on a
+        # no-lock fast path (decision-invariant with the banked digests).
+        from nomad_tpu.server.admission import AdmissionController
+
+        monitor = self.slo_monitor
+        self.admission = AdmissionController(
+            self.config.admission_config,
+            seed=self.config.seed,
+            queue_depth=self.eval_broker.pending_total,
+            queue_cap=self.config.eval_pending_cap,
+            burn_rate=(monitor.burn_rate if monitor is not None
+                       else None),
+            events=self.fsm.events,
+        )
         self._periodic_stop = threading.Event()
         self._started = False
 
@@ -221,6 +277,7 @@ class Server:
             name="failed-eval-reaper",
         )
         reaper.start()
+        self._start_readmission()
         emitter = threading.Thread(
             target=self._emit_stats, daemon=True, name="stats-emitter",
         )
@@ -327,6 +384,19 @@ class Server:
             telemetry.set_gauge(
                 ("heartbeat", "active"), self.heartbeat.num_timers()
             )
+            # Blocking-query fan-out health: parked watcher counts and
+            # typed WATCH_LIMIT rejections per registry (store + event
+            # stream) — the 50k-watcher story's live gauges.
+            for name, registry in (("state", self.state_store.watch),
+                                   ("events", self.fsm.events.watch)):
+                wstats = registry.stats()
+                telemetry.set_gauge(
+                    ("blocking", name, "watchers"), wstats["watchers"]
+                )
+                telemetry.set_gauge(
+                    ("blocking", name, "watch_rejected"),
+                    wstats["rejected"],
+                )
             solver = self.solver_stats()
             device = solver.get("device", {})
             # probe state as a numeric gauge: 1 ready / 0 probing-unprobed /
@@ -346,10 +416,64 @@ class Server:
         an earlier delivery of a restored eval may have committed a plan
         right before the previous leader died, and the next worker's
         snapshot must contain that plan or the eval gets placed twice."""
+        from nomad_tpu.server.eval_broker import BrokerFullError
+
         wait_index = self.raft.applied_index
         for ev in self.state_store.evals():
             if ev.should_enqueue():
-                self.eval_broker.enqueue(ev, wait_index=wait_index)
+                try:
+                    self.eval_broker.enqueue(ev, wait_index=wait_index)
+                except BrokerFullError:
+                    # Cap reached mid-restore: the rest stays durable in
+                    # state; the readmission loop drains it as capacity
+                    # frees (the spill flag is already set).
+                    break
+
+    def _start_readmission(self) -> None:
+        """Arm the spill-readmission loop iff the broker is bounded (an
+        unbounded broker never spills; the thread would idle forever).
+        Shared by Server.start and ClusterServer.start."""
+        if not self.config.eval_pending_cap:
+            return
+        threading.Thread(
+            target=self._readmission_loop, daemon=True,
+            name="eval-readmit",
+        ).start()
+
+    def _readmission_loop(self) -> None:
+        """Drain spilled evals back into the bounded broker as capacity
+        frees. Spilling (eval_broker.pending_cap) keeps over-cap evals
+        durable in the state store only; this loop is the other half of
+        that contract — without it a spilled eval would be stuck pending
+        forever. Polling is cheap: the broker hands out one True per
+        spill episode (reclaim_spilled), so the state scan runs only
+        when there is actually something to readmit."""
+        from nomad_tpu import telemetry
+        from nomad_tpu.server.eval_broker import BrokerError, BrokerFullError
+
+        while not self._periodic_stop.wait(0.5):
+            if not self.eval_broker.reclaim_spilled():
+                continue
+            wait_index = self.raft.applied_index
+            pending = [ev for ev in self.state_store.evals()
+                       if ev.should_enqueue()]
+            # Highest priority first, then oldest — the order the broker
+            # itself would have served them in.
+            pending.sort(key=lambda e: (-e.priority, e.create_index, e.id))
+            readmitted = 0
+            for ev in pending:
+                try:
+                    self.eval_broker.enqueue(
+                        ev, wait_index=wait_index)
+                    readmitted += 1
+                except BrokerFullError:
+                    break  # flag re-armed by the broker; next episode
+                except BrokerError:
+                    break  # disabled (leadership lost) — moot
+            if readmitted:
+                telemetry.incr_counter(("broker", "readmitted"), readmitted)
+                self.logger.debug(
+                    "readmitted %d spilled evals", readmitted)
 
     def _periodic_dispatcher(self) -> None:
         """Dispatch GC core evals periodically (leader.go:170-200)."""
@@ -395,6 +519,8 @@ class Server:
                 self.logger.exception("failed to reap evaluation %s", ev.id)
 
     def _dispatch_core_job(self, job_id: str) -> None:
+        from nomad_tpu.server.eval_broker import BrokerFullError
+
         ev = Evaluation(
             id=generate_uuid(),
             priority=CORE_JOB_PRIORITY,
@@ -403,13 +529,24 @@ class Server:
             job_id=job_id,
             status=structs.EVAL_STATUS_PENDING,
         )
-        self.eval_broker.enqueue(ev)
+        try:
+            self.eval_broker.enqueue(ev)
+        except BrokerFullError:
+            # GC is periodic: the next tick retries after the overload
+            # passes; the breach itself is already counted by the broker.
+            self.logger.debug("core job %s dispatch spilled at cap", job_id)
 
     # -- Job endpoint (job_endpoint.go) -------------------------------------
 
-    def job_register(self, job: Job) -> Tuple[str, int]:
+    def job_register(self, job: Job, client_id: str = "") -> Tuple[str, int]:
         """Register/update a job and create its evaluation
-        (job_endpoint.go:18-72). Returns (eval_id, index)."""
+        (job_endpoint.go:18-72). Returns (eval_id, index).
+
+        The admission front door is checked FIRST — before validation
+        even, so an overload rejection stays cheap — and before any raft
+        apply, so a raised RejectError proves zero side effects (the
+        typed-retry safety contract)."""
+        self.admission.admit_job(job, client_id)
         job.validate()
         if job.type == JOB_TYPE_CORE:
             raise ValueError("job type cannot be core")
@@ -427,11 +564,14 @@ class Server:
         eval_index = self.eval_upsert([ev])
         return ev.id, eval_index
 
-    def job_evaluate(self, job_id: str) -> Tuple[str, int]:
-        """Force re-evaluation (job_endpoint.go:75-128)."""
+    def job_evaluate(self, job_id: str, client_id: str = "") -> Tuple[str, int]:
+        """Force re-evaluation (job_endpoint.go:75-128). Eval ingress is
+        admission-gated like registration (same front door, same typed
+        rejection)."""
         job = self.state_store.job_by_id(job_id)
         if job is None:
             raise KeyError("job not found")
+        self.admission.admit_job(job, client_id)
         ev = Evaluation(
             id=generate_uuid(),
             priority=job.priority,
@@ -761,6 +901,7 @@ class Server:
             "scheduler": self.solver_stats(),
             "slo": (self.slo_monitor.summary()
                     if self.slo_monitor is not None else None),
+            "admission": self.admission.summary(),
         }
 
     @staticmethod

@@ -57,10 +57,12 @@ from nomad_tpu.simcluster.workload import (
     BatchBurstInjector,
     NodeChurnInjector,
     NodeRefreshInjector,
+    OverdriveInjector,
     SteadyServiceInjector,
     UpdateChurnInjector,
     build_job,
 )
+from nomad_tpu.structs import parse_reject
 
 SCHEMA_VERSION = 1
 
@@ -86,6 +88,11 @@ class ScenarioSpec:
     # event digest (node-failure churn depends on which nodes host
     # allocs, which concurrent placement does not pin).
     deterministic: bool = True
+    # Optional CONTRAST arm: server-override deltas for a second run
+    # whose trimmed summary lands in the artifact's "contrast" section
+    # (the overdrive scenarios' admission-OFF arm — same offered load,
+    # front door disabled, documenting the unbounded-growth cliff).
+    contrast_overrides: Optional[Dict] = None
     description: str = ""
 
 
@@ -130,6 +137,85 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
             description="one 100k-task burst (8 batch jobs x12.5k) at 10k "
                         "nodes — the BASELINE config-3 ask through the "
                         "whole pipeline",
+        ),
+        "overdrive-1k": ScenarioSpec(
+            name="overdrive-1k", n_nodes=400,
+            injectors=lambda seed: [OverdriveInjector(
+                seed, clients=6, jobs_per_client=8, tasks_per_job=20,
+            )],
+            server_overrides={
+                # Rate so low a sub-second blast can never mint a token
+                # (refill over the whole window << 1): exactly `burst`
+                # jobs admitted per client, deterministically.
+                "admission": {"client_rate": 0.05, "client_burst": 2},
+                "eval_pending_cap": 128,
+                "plan_queue_cap": 64,
+                "event_buffer_size": 8192,
+                # Long TTLs (400/2 = 200s): a loaded-box beat lag must
+                # not expire a LIVE node mid-run — expiry fan-out is
+                # timing noise the digest contract can't absorb.
+                "max_heartbeats_per_second": 2.0,
+            },
+            quiesce_timeout=120.0, ack_cap=0, warmup_count=100,
+            description="tier-1 overdrive smoke: 6 impolite clients x8 "
+                        "batch jobs x20 tasks blast a 400-node cell; "
+                        "admission rate lanes admit 2/client (burst), "
+                        "the rest reject RATE_LIMITED typed",
+        ),
+        "overdrive-100k": ScenarioSpec(
+            name="overdrive-100k", n_nodes=10_000,
+            injectors=lambda seed: [OverdriveInjector(
+                seed, clients=5, jobs_per_client=50, tasks_per_job=400,
+            )],
+            server_overrides={
+                # burst=1, glacial refill: exactly ONE admission per
+                # client lane, deterministically (refill over the whole
+                # blast << 1 token). The admitted spike (5 evals x 400
+                # tasks, the columnar device path) is sized to what the
+                # box drains inside the 250ms placed-latency SLO —
+                # that's the POINT of the front door: admitted work
+                # keeps its promise, the overload is turned away typed.
+                "admission": {"client_rate": 0.02, "client_burst": 1},
+                "eval_pending_cap": 128,
+                "plan_queue_cap": 64,
+                # The rejection storm's Admission events plus the
+                # admitted work's lifecycle must fit the watcher's poll
+                # stride without ring truncation.
+                "event_buffer_size": 16384,
+                # 10k/10 = 1000s TTLs: beats never come due inside the
+                # run, so loaded-box beat starvation can't expire live
+                # nodes (nondeterministic fan-out; the r09 bank's first
+                # attempt caught exactly that).
+                "max_heartbeats_per_second": 10.0,
+                "scheduler_workers": 8,
+                # Independent solves, no coalescer burst-hold: with only
+                # ~5 admitted evals in flight the hold window (waiting
+                # for announced batch members to stack) adds 50-150ms of
+                # run-to-run jitter to the tail — batching pays at
+                # hundreds of evals (the contrast arm), not five.
+                "eval_batch_size": 1,
+            },
+            # The admission-OFF arm: identical offered load, front door
+            # disabled and queues unbounded — the documented cliff.
+            contrast_overrides={
+                "admission": {"enabled": False},
+                "eval_pending_cap": 0,
+                "plan_queue_cap": 0,
+                "event_buffer_size": 16384,
+                "max_heartbeats_per_second": 10.0,
+                "scheduler_workers": 8,
+                "eval_batch_size": 4,
+            },
+            quiesce_timeout=600.0, ack_cap=0,
+            description="the impolite front-door proof: 5 clients blast "
+                        "250 batch jobs (100k tasks offered) at a 10k-"
+                        "node cell with no self-throttling; admission ON "
+                        "admits 1/client (5 jobs, 2000 tasks) and "
+                        "rejects the rest RATE_LIMITED typed, keeping "
+                        "admitted p95 submit-to-placed under the 250ms "
+                        "SLO with every queue bounded; the contrast arm "
+                        "re-runs with admission OFF and documents the "
+                        "unbounded-queue latency cliff",
         ),
         "churn": ScenarioSpec(
             name="churn", n_nodes=2000,
@@ -224,6 +310,13 @@ class ScenarioRunner:
         self._pipe_samples: List = []
         self._srv: Optional[ClusterServer] = None
         self._jobs: Dict[str, object] = {}
+        # Front-door accounting as the INJECTOR experiences it: offered
+        # registrations, admitted (eval ids returned), and typed
+        # rejections by reason (the artifact's admission.injector view,
+        # cross-checkable against the controller's own counters).
+        self._offer_lock = threading.Lock()
+        self._offered = 0
+        self._rejected: Dict[str, int] = {}
 
     # -- observation --------------------------------------------------------
 
@@ -252,6 +345,13 @@ class ScenarioRunner:
                 self.peaks["broker_unacked"], stats.total_unacked)
             self.peaks["broker_blocked"] = max(
                 self.peaks["broker_blocked"], stats.total_blocked)
+            # The quantity eval_pending_cap bounds (ready+blocked+waiting)
+            # — the artifact's caps_respected verdict compares THIS peak
+            # against the configured cap.
+            self.peaks["broker_pending"] = max(
+                self.peaks.get("broker_pending", 0),
+                stats.total_ready + stats.total_blocked
+                + stats.total_waiting)
             self.peaks["plan_queue_depth"] = max(
                 self.peaks["plan_queue_depth"], srv.plan_queue.depth())
             # Conflict-rate-vs-load raw series (the Omega evaluation,
@@ -265,13 +365,35 @@ class ScenarioRunner:
 
     # -- actions ------------------------------------------------------------
 
-    def _register_job(self, fleet: SimFleet, payload: Dict) -> str:
+    def _register_job(self, fleet: SimFleet, payload: Dict) -> Optional[str]:
+        """One Job.Register through the real RPC front door. Returns the
+        eval id, or None when the admission layer rejected typed — the
+        rejection is counted by reason, never retried (the overdrive
+        injector is IMPOLITE by contract: it measures the door, it does
+        not back off for it)."""
+        from nomad_tpu.rpc import RemoteError
+
         job = payload["build"]()
+        with self._offer_lock:
+            self._offered += 1
+        args = {"job": to_dict(job)}
+        if payload.get("client_id"):
+            args["client_id"] = payload["client_id"]
+        try:
+            out = fleet._pool().call(
+                self._srv.rpc_addr, "Job.Register", args,
+                timeout=fleet.rpc_timeout,
+            )
+        except RemoteError as e:
+            rejection = parse_reject(str(e))
+            if rejection is None:
+                raise
+            with self._offer_lock:
+                self._rejected[rejection.reason] = (
+                    self._rejected.get(rejection.reason, 0) + 1
+                )
+            return None
         self._jobs[payload["job_key"]] = job
-        out = fleet._pool().call(
-            self._srv.rpc_addr, "Job.Register", {"job": to_dict(job)},
-            timeout=fleet.rpc_timeout,
-        )
         return out["eval_id"]
 
     def _update_job(self, fleet: SimFleet, payload: Dict) -> Optional[str]:
@@ -447,13 +569,55 @@ class ScenarioRunner:
             t0 = time.monotonic()
             expected_evals: List[str] = []
             failed_tranche: List[str] = []
+            # IMPOLITE registrations (OverdriveInjector): each client's
+            # sequence runs IN ORDER on its own thread, next request the
+            # instant the previous response returns — concurrent
+            # front-door pressure with no pacing. Per-client ordering is
+            # what keeps per-client token-bucket decisions seed-
+            # deterministic; cross-client interleaving is scheduling
+            # noise the canonical digest ignores.
+            impolite: Dict[str, List[Action]] = {}
+            paced: List[Action] = []
             for action in actions:
+                if (action.kind == "register_job"
+                        and action.payload.get("impolite")):
+                    impolite.setdefault(
+                        action.payload.get("client_id", ""), []
+                    ).append(action)
+                else:
+                    paced.append(action)
+            blasters: List[threading.Thread] = []
+            blasted: List[List[Optional[str]]] = []
+            blast_errors: List[BaseException] = []
+
+            def blast(client_actions, out):
+                try:
+                    for a in client_actions:
+                        out.append(self._register_job(fleet, a.payload))
+                except BaseException as e:  # surfaced after join
+                    # A non-reject failure (RPC timeout, transport error)
+                    # must FAIL the run loudly — a daemon thread dying
+                    # silently would let the artifact count the errored
+                    # requests as admitted and mis-assert downstream.
+                    blast_errors.append(e)
+
+            for client, client_actions in sorted(impolite.items()):
+                out: List[Optional[str]] = []
+                blasted.append(out)
+                t = threading.Thread(
+                    target=blast, args=(client_actions, out),
+                    daemon=True, name=f"sim-blast-{client}",
+                )
+                blasters.append(t)
+                t.start()
+            for action in paced:
                 delay = t0 + action.at - time.monotonic()
                 if delay > 0:
                     time.sleep(delay)
                 if action.kind == "register_job":
-                    expected_evals.append(
-                        self._register_job(fleet, action.payload))
+                    ev_id = self._register_job(fleet, action.payload)
+                    if ev_id:
+                        expected_evals.append(ev_id)
                 elif action.kind == "update_job":
                     ev_id = self._update_job(fleet, action.payload)
                     if ev_id:
@@ -462,6 +626,15 @@ class ScenarioRunner:
                     self._refresh_nodes(fleet, action.payload)
                 elif action.kind == "fail_nodes":
                     failed_tranche = self._fail_nodes(fleet, action.payload)
+            for t in blasters:
+                t.join()
+            if blast_errors:
+                raise RuntimeError(
+                    f"{len(blast_errors)} impolite blast thread(s) "
+                    "failed on a non-reject error"
+                ) from blast_errors[0]
+            for out in blasted:
+                expected_evals.extend(ev_id for ev_id in out if ev_id)
 
             self._wait_quiesced(srv, expected_evals, failed_tranche,
                                 time.monotonic() + spec.quiesce_timeout)
@@ -716,6 +889,36 @@ class ScenarioRunner:
             },
             "deterministic_contract": self.spec.deterministic,
         }
+        # Admission front door over the run: the controller's own books
+        # next to the injector's experience of the door (offered vs
+        # admitted vs typed rejections), plus the bounded-queue verdict —
+        # sampled peaks vs configured caps (enforcement is at enqueue, so
+        # a true breach is impossible; the verdict documents it).
+        controller = srv.admission.snapshot()
+        controller["recent_rejections"] = \
+            controller.get("recent_rejections", [])[-20:]
+        rejected_total = sum(self._rejected.values())
+        caps = {
+            "eval_pending_cap": srv.config.eval_pending_cap,
+            "plan_queue_cap": srv.config.plan_queue_cap,
+        }
+        artifact["admission"] = {
+            "controller": controller,
+            "injector": {
+                "offered": self._offered,
+                "admitted": self._offered - rejected_total,
+                "rejected": dict(sorted(self._rejected.items())),
+            },
+            "caps": caps,
+            "caps_respected": (
+                (not caps["eval_pending_cap"]
+                 or self.peaks.get("broker_pending", 0)
+                 <= caps["eval_pending_cap"])
+                and (not caps["plan_queue_cap"]
+                     or self.peaks["plan_queue_depth"]
+                     <= caps["plan_queue_cap"])
+            ),
+        }
         # End-to-end latency attribution (nomad_tpu.lifecycle): stitch a
         # timeline per eval the measured window submitted — spans from
         # the process tracer, anchors from the same events digested
@@ -767,10 +970,17 @@ def _backend_name() -> str:
 def run_scenario(name: str, seed: int = 42, out_path: Optional[str] = None,
                  n_nodes: Optional[int] = None,
                  logger: Optional[logging.Logger] = None,
-                 attribution_layer: bool = True) -> Dict:
+                 attribution_layer: bool = True,
+                 contrast: bool = True) -> Dict:
     """Run one named scenario; optionally write the JSON artifact.
     ``attribution_layer=False`` is the tracing-overhead arm: same
-    scenario, tracer + SLO monitor off."""
+    scenario, tracer + SLO monitor off. When the spec declares a
+    contrast arm (overdrive's admission-OFF run), it runs after the main
+    arm and a trimmed summary lands in ``artifact["contrast"]``;
+    ``contrast=False`` skips it (determinism re-verification compares
+    main arms only)."""
+    import dataclasses
+
     spec = SCENARIOS.get(name)
     if spec is None:
         raise KeyError(
@@ -780,6 +990,28 @@ def run_scenario(name: str, seed: int = 42, out_path: Optional[str] = None,
         spec, seed=seed, n_nodes=n_nodes, logger=logger,
         attribution_layer=attribution_layer,
     ).run()
+    if contrast and spec.contrast_overrides is not None:
+        overrides = dict(spec.server_overrides)
+        overrides.update(spec.contrast_overrides)
+        contrast_spec = dataclasses.replace(
+            spec, server_overrides=overrides, contrast_overrides=None,
+        )
+        full = ScenarioRunner(
+            contrast_spec, seed=seed, n_nodes=n_nodes, logger=logger,
+            attribution_layer=attribution_layer,
+        ).run()
+        att = full.get("latency_attribution") or {}
+        artifact["contrast"] = {
+            "server_overrides": overrides,
+            "placements": full["placements"],
+            "peaks": full["peaks"],
+            "plan_latency_ms": full["plan_latency_ms"],
+            "submit_to_placed_ms": att.get("submit_to_placed_ms"),
+            "slo_check": att.get("slo_check"),
+            "admission": full.get("admission"),
+            "events": {"observed": full["events"]["observed"],
+                       "truncated": full["events"]["truncated"]},
+        }
     if out_path:
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=2, sort_keys=True)

@@ -12,7 +12,11 @@ An injector emits :class:`Action` records; the scenario runner executes
 them against the server at their offsets. Kinds:
 
 ``register_job``   payload: the Job to register (built lazily so every
-                   run constructs fresh object graphs).
+                   run constructs fresh object graphs); optional
+                   ``client_id`` (admission rate-lane identity) and
+                   ``impolite`` (no-self-throttling pacing: the runner
+                   blasts each client's sequence on its own thread —
+                   OverdriveInjector).
 ``update_job``     payload: job key + mutation ("inplace" bumps cpu by 1
                    — tasks_updated() false, the in-place path;
                    "destructive" changes task env — evict+place).
@@ -237,6 +241,58 @@ class NodeRefreshInjector(Injector):
             ))
             t += self.every
         return out
+
+
+class OverdriveInjector(Injector):
+    """IMPOLITE offered load: ``clients`` independent clients each blast
+    ``jobs_per_client`` batch jobs at t=0 with NO self-throttling — the
+    runner executes each client's sequence on its own thread, firing the
+    next registration the instant the previous response (admit OR typed
+    rejection) returns, instead of pacing actions on the shared clock.
+    This is the pacing mode the polite injectors lack: steady/burst
+    arrivals serialize on one action loop, so the server never sees more
+    concurrent front-door pressure than one RPC at a time. Overdrive
+    offers clients x jobs x tasks work far beyond capacity and lets the
+    admission layer (nomad_tpu/server/admission.py) be the only thing
+    standing.
+
+    Determinism posture: the action list (client ids, job ids, shapes)
+    is fully seed-determined, and each client's registrations run IN
+    ORDER on its own thread — so per-client admission decisions against
+    per-client token buckets replay exactly (burst admitted, the rest
+    RATE_LIMITED: refill over a sub-second blast at the scenario's tiny
+    rates can never mint a token). Cross-client interleaving is
+    scheduling noise the canonical event digest already ignores."""
+
+    name = "overdrive"
+    pacing = "impolite"
+
+    def __init__(self, seed: int, clients: int, jobs_per_client: int,
+                 tasks_per_job: int, cpu: int = 100, memory_mb: int = 128):
+        super().__init__(seed)
+        self.clients = clients
+        self.jobs_per_client = jobs_per_client
+        self.tasks_per_job = tasks_per_job
+        self.cpu = cpu
+        self.memory_mb = memory_mb
+
+    def actions(self) -> List[Action]:
+        out = []
+        for c in range(self.clients):
+            client_id = f"sim-client-{c:03d}"
+            for k in range(self.jobs_per_client):
+                jid = f"sim-ovr-{c:03d}-{k:03d}"
+                out.append(Action(
+                    at=0.0, kind="register_job",
+                    payload={"job_key": jid, "build": self._builder(jid),
+                             "client_id": client_id, "impolite": True},
+                ))
+        return out
+
+    def _builder(self, jid: str) -> Callable[[], Job]:
+        count, cpu, mem = self.tasks_per_job, self.cpu, self.memory_mb
+        return lambda: build_job(jid, structs.JOB_TYPE_BATCH, count,
+                                 cpu=cpu, memory_mb=mem)
 
 
 class NodeChurnInjector(Injector):

@@ -279,6 +279,18 @@ class SLOMonitor(threading.Thread):
                 "truncated_gaps": self.truncated_gaps,
             }
 
+    def burn_rate(self, metric: str = "submit_to_placed") -> float:
+        """Worst (max) error-budget burn rate over the objectives bound
+        to ``metric`` — the admission front door's shed signal
+        (server/admission.py): >1.0 means the budget runs out before the
+        window does. 0.0 with no matching objective."""
+        with self._lock:
+            return max(
+                (tr.window.stats()["burn_rate"] for tr in self.trackers
+                 if tr.objective.metric == metric),
+                default=0.0,
+            )
+
     def summary(self) -> Dict[str, Any]:
         """Compact agent-info line: objective name -> met/burn_rate."""
         with self._lock:

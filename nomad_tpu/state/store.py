@@ -20,6 +20,7 @@ enforces, state_store.go:25-27).
 from __future__ import annotations
 
 import threading
+import zlib
 from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
 from nomad_tpu.state.blocks import StoredAllocBlock
@@ -161,40 +162,196 @@ def item_alloc_eval(eval_id: str) -> WatchItem:
     return ("alloc_eval", eval_id)
 
 
+class _WatchTicket:
+    """One registration's receipt: the items watched and the bucket
+    generations sampled at registration time. ``_Watch.wait`` returns once
+    any of the buckets moves past its sampled generation (or on timeout).
+    Opaque to callers; built by ``_Watch.register``."""
+
+    __slots__ = ("items", "buckets", "gens", "multi", "multi_gen")
+
+    def __init__(self, items, buckets, gens, multi, multi_gen):
+        self.items = items
+        self.buckets = buckets
+        self.gens = gens
+        self.multi = multi
+        self.multi_gen = multi_gen
+
+
 class _Watch:
-    """Watch registry: condition-variable fan-out keyed by WatchItem
-    (reference: nomad/state/notify.go)."""
+    """Coalesced index-bucketed watch registry (reference analog:
+    nomad/state/notify.go — but redesigned for 50k-watcher fan-out).
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._waiters: Dict[WatchItem, Set[threading.Event]] = {}
-        # Parked-waiter count per item kind ("alloc_node", "table", ...):
-        # lets bulk writers skip building per-member items for kinds
-        # nobody watches (a block commit touches thousands of nodes).
+    The original design kept one ``threading.Event`` per watcher per item;
+    a publish then iterated and ``set()`` every parked event under one
+    registry lock — O(watchers) Python work on the WRITER (often the FSM
+    apply thread). At 50k blocking watchers of a hot item that is a
+    multi-millisecond wake storm per write, paid by the control plane's
+    hottest path (measured in tests/test_wake_storm.py).
+
+    Here every WatchItem hashes into one of ``NUM_BUCKETS`` buckets, each
+    a (generation counter, Condition) pair. A publish bumps the touched
+    buckets' generations and ``notify_all``s their conditions — O(touched
+    items), independent of watcher count. Watchers sample their buckets'
+    generations at registration and park on the bucket condition; a
+    generation moving past the sample is the wake. Items sharing a bucket
+    cause spurious wakes (the waiter re-probes its index and re-parks —
+    the blocking_query loop already does exactly that), never missed
+    ones.
+
+    No-lost-wakeup protocol (the same register-then-recheck discipline
+    blocking.py always carried): a waiter must ``register`` (sampling
+    generations) BEFORE its final index probe. A writer mutates state
+    BEFORE notifying. Then either the writer's notify lands after the
+    sample (generation moves, waiter wakes) or it landed before (so the
+    mutation is visible to the post-sample probe and the waiter never
+    parks).
+
+    Multi-item registrations spanning several buckets (rare: multi-topic
+    event filters) cannot park on several conditions at once; they park
+    on one shared side channel (``_multi_cond``) which every notify also
+    bumps while such waiters exist.
+
+    Registrations are BOUNDED: ``max_watchers`` > 0 makes ``register``
+    raise a typed ``RejectError(WATCH_LIMIT)`` past the cap — the same
+    cheap-rejection machinery the admission front door uses
+    (nomad_tpu/server/admission.py), so a watcher flood degrades into
+    fast 503s instead of unbounded registry growth.
+    """
+
+    NUM_BUCKETS = 64
+
+    def __init__(self, max_watchers: int = 0) -> None:
+        self._conds = tuple(
+            threading.Condition() for _ in range(self.NUM_BUCKETS)
+        )
+        self._gens = [0] * self.NUM_BUCKETS
+        self._multi_cond = threading.Condition()
+        self._multi_gen = 0
+        self._multi_waiters = 0
+        # Registration metadata (watcher count, kind counts, cap).
+        self._meta_lock = threading.Lock()
         self._kind_counts: Dict[str, int] = {}
+        self._watchers = 0
+        self.max_watchers = int(max_watchers)
+        # Loss-free counters (ints under the GIL; read for stats/gauges).
+        self.rejected = 0
+        self.notifies = 0
+        self.peak_watchers = 0
 
-    def watch(self, items: Iterable[WatchItem], event: threading.Event) -> None:
-        with self._lock:
-            for item in items:
-                waiters = self._waiters.setdefault(item, set())
-                if event not in waiters:
-                    waiters.add(event)
-                    self._kind_counts[item[0]] = (
-                        self._kind_counts.get(item[0], 0) + 1
-                    )
+    @staticmethod
+    def _bucket(item: WatchItem) -> int:
+        # crc32, not hash(): per-process salted str hashing would make
+        # bucket spread (and thus spurious-wake behavior) vary run to run.
+        return zlib.crc32(
+            ("%s\x00%s" % item).encode()
+        ) % _Watch.NUM_BUCKETS
 
-    def stop_watch(self, items: Iterable[WatchItem], event: threading.Event) -> None:
-        with self._lock:
+    # -- registration ------------------------------------------------------
+
+    def register(self, items: Iterable[WatchItem]) -> _WatchTicket:
+        """Register a watcher on ``items``; returns the ticket ``wait``
+        consumes. Must be called BEFORE the caller's final index probe
+        (see the class protocol note). Raises RejectError(WATCH_LIMIT)
+        when the registration cap is reached."""
+        items = list(items)
+        with self._meta_lock:
+            if self.max_watchers and self._watchers >= self.max_watchers:
+                self.rejected += 1
+                from nomad_tpu.structs import REJECT_WATCH_LIMIT, RejectError
+
+                raise RejectError(
+                    REJECT_WATCH_LIMIT,
+                    f"blocking-watcher cap reached "
+                    f"({self._watchers}/{self.max_watchers})",
+                    retry_after=0.5,
+                )
+            self._watchers += 1
+            if self._watchers > self.peak_watchers:
+                self.peak_watchers = self._watchers
             for item in items:
-                waiters = self._waiters.get(item)
-                if waiters is not None and event in waiters:
-                    waiters.discard(event)
-                    self._kind_counts[item[0]] -= 1
-                    if not waiters:
-                        del self._waiters[item]
+                self._kind_counts[item[0]] = (
+                    self._kind_counts.get(item[0], 0) + 1
+                )
+        buckets = sorted({self._bucket(item) for item in items})
+        multi = len(buckets) > 1
+        multi_gen = 0
+        if multi:
+            # Count BEFORE sampling generations: a writer reads the count
+            # after bumping bucket gens, so it either sees us (and bumps
+            # the side channel) or bumped before our sample (and the
+            # mutation is visible to our post-sample probe).
+            with self._multi_cond:
+                self._multi_waiters += 1
+                multi_gen = self._multi_gen
+        gens = []
+        for b in buckets:
+            with self._conds[b]:
+                gens.append(self._gens[b])
+        return _WatchTicket(items, buckets, gens, multi, multi_gen)
+
+    def unregister(self, ticket: _WatchTicket) -> None:
+        with self._meta_lock:
+            self._watchers -= 1
+            for item in ticket.items:
+                n = self._kind_counts.get(item[0], 0) - 1
+                if n <= 0:
+                    self._kind_counts.pop(item[0], None)
+                else:
+                    self._kind_counts[item[0]] = n
+        if ticket.multi:
+            with self._multi_cond:
+                self._multi_waiters -= 1
+
+    def wait(self, ticket: _WatchTicket,
+             timeout: Optional[float] = None) -> bool:
+        """Park until any of the ticket's buckets is notified past its
+        sampled generation, or ``timeout`` lapses. Returns True when a
+        (possibly spurious, bucket-shared) notification woke us, False on
+        timeout. Callers re-probe their index either way."""
+        import time as _time
+
+        deadline = (
+            _time.monotonic() + timeout if timeout is not None else None
+        )
+        if not ticket.multi:
+            b = ticket.buckets[0]
+            gen0 = ticket.gens[0]
+            cond = self._conds[b]
+            with cond:
+                while self._gens[b] == gen0:
+                    if deadline is None:
+                        cond.wait()
+                        continue
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    cond.wait(remaining)
+            return True
+        with self._multi_cond:
+            while True:
+                if self._multi_gen != ticket.multi_gen:
+                    return True
+                # Bucket generations read without their locks: plain int
+                # reads under the GIL; the registration protocol covers
+                # the race (see class docstring).
+                if any(
+                    self._gens[b] != g
+                    for b, g in zip(ticket.buckets, ticket.gens)
+                ):
+                    return True
+                if deadline is None:
+                    self._multi_cond.wait()
+                    continue
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._multi_cond.wait(remaining)
+
+    # -- introspection ------------------------------------------------------
 
     def has_waiters_for(self, kind: str) -> bool:
-        """True when any waiter is parked on an item of ``kind``.
+        """True when any waiter is registered on an item of ``kind``.
 
         ORDERING CONTRACT for writers using this to skip item building:
         sample it AFTER the table mutation is visible. Then a waiter that
@@ -204,29 +361,57 @@ class _Watch:
         during it."""
         return self._kind_counts.get(kind, 0) > 0
 
+    def stats(self) -> Dict[str, int]:
+        return {
+            "watchers": self._watchers,
+            "peak_watchers": self.peak_watchers,
+            "max_watchers": self.max_watchers,
+            "rejected": self.rejected,
+            "notifies": self.notifies,
+            "buckets": self.NUM_BUCKETS,
+        }
+
+    # -- notification -------------------------------------------------------
+
     def notify(self, items: Iterable[WatchItem]) -> None:
         # Unlocked emptiness probe: safe ONLY because blocking queries
         # re-check the index after registering (register-then-recheck in
         # blocking.py), so a waiter that races this read never depends on
         # the missed wakeup. A free-threaded build keeping that protocol
-        # keeps the safety; move the check under the lock if the protocol
-        # ever changes.
-        if not self._waiters:
+        # keeps the safety; move the check under the meta lock if the
+        # protocol ever changes.
+        if not self._watchers:
             return
-        with self._lock:
-            for item in items:
-                for event in self._waiters.get(item, ()):
-                    event.set()
+        self.notifies += 1
+        seen = 0
+        for item in items:
+            b = self._bucket(item)
+            bit = 1 << b
+            if seen & bit:
+                continue
+            seen |= bit
+            cond = self._conds[b]
+            with cond:
+                self._gens[b] += 1
+                cond.notify_all()
+        if self._multi_waiters:
+            with self._multi_cond:
+                self._multi_gen += 1
+                self._multi_cond.notify_all()
 
     def notify_all(self) -> None:
         """Wake every parked watcher. Fired when this store is replaced
         wholesale (raft snapshot install rebinds fsm.state) so blocking
         queries re-check against the live store instead of sleeping out
         their timeout on an orphaned one."""
-        with self._lock:
-            for waiters in self._waiters.values():
-                for event in waiters:
-                    event.set()
+        for b in range(self.NUM_BUCKETS):
+            cond = self._conds[b]
+            with cond:
+                self._gens[b] += 1
+                cond.notify_all()
+        with self._multi_cond:
+            self._multi_gen += 1
+            self._multi_cond.notify_all()
 
 
 class _Tables:

@@ -122,6 +122,65 @@ class ValidationError(Exception):
 
 
 # ---------------------------------------------------------------------------
+# Typed admission/backpressure rejection (nomad_tpu/server/admission.py)
+# ---------------------------------------------------------------------------
+
+# Rejection reasons. The front door's whole contract is that a rejection
+# is CHEAP and TYPED: the caller learns why it was turned away and when to
+# come back, and — critically — that the request provably executed NO
+# server-side side effect, so replaying it is always safe.
+REJECT_QUEUE_FULL = "QUEUE_FULL"      # acceptance queue at its cap
+REJECT_RATE_LIMITED = "RATE_LIMITED"  # per-client token-bucket lane empty
+REJECT_SHED = "SHED"                  # SLO-coupled load shedding
+REJECT_WATCH_LIMIT = "WATCH_LIMIT"    # blocking-query watcher cap reached
+
+# The wire marker RejectError stringifies to. It must survive the RPC
+# error envelope (handlers' exceptions cross as "RejectError: <str(e)>"
+# inside a RemoteError) and nested forwarding prefixes, so parse_reject
+# regex-searches rather than anchors.
+_REJECT_RE = re.compile(
+    r"REJECT\[([A-Z_]+) retry_after=([0-9.]+)\](?::\s*(.*))?"
+)
+
+
+class RejectError(Exception):
+    """Typed, cheap rejection from the admission/backpressure machinery.
+
+    Carries the reason and a retry-after hint (seconds). Raised BEFORE any
+    raft apply / queue mutation, so a rejected request had zero side
+    effects and the client may replay it after the hint — the property the
+    SDK's retry discipline (backoff.retry_undelivered, api/client.py)
+    relies on. Stringifies to a greppable ``REJECT[...]`` marker that
+    ``parse_reject`` recovers on the far side of an RPC/HTTP boundary.
+    """
+
+    def __init__(self, reason: str, message: str = "",
+                 retry_after: float = 0.0):
+        self.reason = reason
+        self.retry_after = max(0.0, float(retry_after))
+        self.message = message
+        super().__init__(
+            f"REJECT[{reason} retry_after={self.retry_after:.3f}]"
+            + (f": {message}" if message else "")
+        )
+
+
+def parse_reject(text: str) -> Optional[RejectError]:
+    """Recover a typed RejectError from an error string that crossed a
+    transport boundary (RemoteError message, HTTP error body). Returns
+    None when the text carries no REJECT marker."""
+    m = _REJECT_RE.search(text or "")
+    if m is None:
+        return None
+    try:
+        retry_after = float(m.group(2))
+    except ValueError:
+        retry_after = 0.0
+    return RejectError(m.group(1), (m.group(3) or "").strip(),
+                       retry_after=retry_after)
+
+
+# ---------------------------------------------------------------------------
 # Resources & network
 # ---------------------------------------------------------------------------
 

@@ -268,8 +268,40 @@ def test_banked_pair_discovery_orders_rounds(tmp_path, monkeypatch):
         (tmp_path / name).write_text("{}")
     monkeypatch.setattr(bench_watch, "REPO", str(tmp_path))
     pairs = _REAL_BANKED_PAIRS()
-    assert pairs == [(
-        "steady_s42",
-        str(tmp_path / "SIMLOAD_steady_s42_r08.json"),
-        str(tmp_path / "SIMLOAD_steady_s42_r06.json"),
-    )]
+    # Single-round families (a freshly banked scenario) pair with None:
+    # the scan gates them absolutely instead of skipping them.
+    assert pairs == [
+        ("lone_s7", str(tmp_path / "SIMLOAD_lone_s7.json"), None),
+        ("steady_s42",
+         str(tmp_path / "SIMLOAD_steady_s42_r08.json"),
+         str(tmp_path / "SIMLOAD_steady_s42_r06.json")),
+    ]
+
+
+def test_slo_gate_absolute_for_first_round_family():
+    """A first-round family (no banked baseline — the overdrive-100k
+    introduction case) gates absolutely: observed objectives must be met
+    outright; unobserved ones are reported, not failed."""
+    good = bench_watch.slo_gate_absolute(_artifact(p95=200.0))
+    assert good["ok"] is True
+    bad = bench_watch.slo_gate_absolute(_artifact(p95=300.0))
+    assert bad["ok"] is False
+    placed = next(c for c in bad["checks"]
+                  if c["objective"] == "submit_to_placed_p95_ms")
+    assert placed["regressed"] is True and placed["baseline_ms"] is None
+    running = next(c for c in bad["checks"]
+                   if c["objective"] == "submit_to_running_p95_ms")
+    assert running["regressed"] is False  # unobserved (n=0)
+
+
+def test_slo_gate_scan_absolute_arm(tmp_path, monkeypatch):
+    lone = tmp_path / "SIMLOAD_over_s42_r09.json"
+    lone.write_text(json.dumps(_artifact(p95=100.0)))
+    monkeypatch.setattr(
+        bench_watch, "_banked_simload_pairs",
+        lambda: [("over_s42", str(lone), None)])
+    logged = []
+    assert bench_watch.slo_gate_scan(
+        log=lambda event, **kw: logged.append({"event": event, **kw}))
+    assert logged[0]["baseline"] == "<absolute>"
+    assert logged[0]["ok"] is True

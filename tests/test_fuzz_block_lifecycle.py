@@ -232,8 +232,7 @@ def test_no_lost_wakeup_under_concurrent_bulk_commits(seed):
         deadline = _time.monotonic() + 30.0
         last = 0
         while _time.monotonic() < deadline:
-            ev = threading.Event()
-            store.watch.watch([item_alloc_node(node.id)], ev)
+            ticket = store.watch.register([item_alloc_node(node.id)])
             try:
                 idx = store.snapshot().get_index("allocs")
                 if idx >= final_index:
@@ -242,10 +241,10 @@ def test_no_lost_wakeup_under_concurrent_bulk_commits(seed):
                 if idx == last:
                     # Park with a SHORT timeout: a lost wakeup shows up
                     # as systematically timing out instead of waking.
-                    ev.wait(0.5)
+                    store.watch.wait(ticket, timeout=0.5)
                 last = idx
             finally:
-                store.watch.stop_watch([item_alloc_node(node.id)], ev)
+                store.watch.unregister(ticket)
         errors.append(f"watcher {widx} never saw index {final_index}")
 
     threads = [threading.Thread(target=watcher, args=(i,)) for i in range(6)]

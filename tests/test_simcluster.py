@@ -33,6 +33,7 @@ from nomad_tpu.simcluster.simnode import SimFleet, sim_node
 from nomad_tpu.simcluster.workload import (
     BatchBurstInjector,
     NodeChurnInjector,
+    OverdriveInjector,
     SteadyServiceInjector,
     UpdateChurnInjector,
 )
@@ -231,6 +232,52 @@ def test_steady_1k_smoke(tmp_path):
     assert (art["heartbeat"]["equilibrium_renewals_per_sec"]
             <= art["heartbeat"]["rate_cap_per_sec"])
     assert art["heartbeat"]["scheduled_renewals_per_sec"] > 0
+
+
+def test_overdrive_1k_smoke(tmp_path):
+    """The impolite front door at smoke scale: 6 clients blast 8 batch
+    jobs each with no self-throttling; admission rate lanes (burst 2,
+    glacial refill) admit exactly 2 per client DETERMINISTICALLY, the
+    rest reject RATE_LIMITED typed, every queue stays under its cap, and
+    admitted work all places."""
+    out = tmp_path / "SIMLOAD_overdrive-1k_smoke.json"
+    art = run_scenario("overdrive-1k", seed=42, out_path=str(out))
+    adm = art["admission"]
+    assert adm["injector"]["offered"] == 6 * 8
+    assert adm["injector"]["admitted"] == 6 * 2
+    assert adm["injector"]["rejected"] == {"RATE_LIMITED": 6 * 6}
+    assert adm["caps_respected"] is True
+    assert adm["controller"]["rejected"] == 36
+    assert adm["controller"]["by_reason"]["RATE_LIMITED"] == 36
+    # Admitted work fully places (12 jobs x 20 tasks).
+    assert art["placements"]["placed"] == 12 * 20
+    assert art["events"]["by_type"]["AdmissionRejected"] == 36
+    assert art["events"]["by_type"]["JobRegistered"] == 12
+    assert art["events"]["truncated"] is False
+    # Peaks bounded by the configured caps (enforced at enqueue).
+    assert art["peaks"]["broker_pending"] <= 128
+    assert art["peaks"]["plan_queue_depth"] <= 64
+
+
+def test_overdrive_smoke_is_seed_deterministic():
+    """Per-client sequential blasting + per-client token buckets: the
+    canonical event digest (admission rejections included, keyed by
+    client) replays under the same seed."""
+    a = run_scenario("overdrive-1k", seed=11)
+    b = run_scenario("overdrive-1k", seed=11)
+    assert a["events"]["digest"] == b["events"]["digest"]
+    assert a["events"]["by_type"] == b["events"]["by_type"]
+
+
+def test_overdrive_injector_determinism():
+    a = [(x.at, x.kind, x.payload["job_key"], x.payload["client_id"])
+         for x in OverdriveInjector(3, clients=4, jobs_per_client=5,
+                                    tasks_per_job=10).actions()]
+    b = [(x.at, x.kind, x.payload["job_key"], x.payload["client_id"])
+         for x in OverdriveInjector(3, clients=4, jobs_per_client=5,
+                                    tasks_per_job=10).actions()]
+    assert a == b and len(a) == 20
+    assert all(x[1] == "register_job" for x in a)
 
 
 def test_same_seed_reproduces_canonical_event_sequence():

@@ -127,30 +127,35 @@ def test_watch_fires():
     store = StateStore()
     node = mock.node()
 
-    event = threading.Event()
-    store.watch.watch([item_table("nodes")], event)
+    # Coalesced watch (store._Watch): register samples bucket
+    # generations; a notify on the item moves them and wait() returns
+    # True (here without blocking — the write already landed).
+    ticket = store.watch.register([item_table("nodes")])
     store.upsert_node(1000, node)
-    assert event.wait(1.0)
+    assert store.watch.wait(ticket, timeout=1.0)
+    store.watch.unregister(ticket)
 
     # Per-item watch
-    event2 = threading.Event()
-    store.watch.watch([item_node(node.id)], event2)
+    ticket2 = store.watch.register([item_node(node.id)])
     store.update_node_status(1001, node.id, structs.NODE_STATUS_DOWN)
-    assert event2.wait(1.0)
+    assert store.watch.wait(ticket2, timeout=1.0)
+    store.watch.unregister(ticket2)
 
     # alloc_node watch fires for allocs placed on that node
-    event3 = threading.Event()
     alloc = mock.alloc()
-    store.watch.watch([item_alloc_node(alloc.node_id)], event3)
+    ticket3 = store.watch.register([item_alloc_node(alloc.node_id)])
     store.upsert_allocs(1002, [alloc])
-    assert event3.wait(1.0)
+    assert store.watch.wait(ticket3, timeout=1.0)
+    store.watch.unregister(ticket3)
 
-    # stop_watch deregisters
-    event4 = threading.Event()
-    store.watch.watch([item_table("jobs")], event4)
-    store.watch.stop_watch([item_table("jobs")], event4)
-    store.upsert_job(1003, mock.job())
-    assert not event4.wait(0.05)
+    # A fresh registration AFTER a write does not see stale wakeups from
+    # it (generation sampled at register time), unless a bucket-sharing
+    # write lands — so probe an item whose table stays untouched.
+    ticket4 = store.watch.register([item_table("jobs")])
+    assert not store.watch.wait(ticket4, timeout=0.05)
+    store.watch.unregister(ticket4)
+    # unregister drops the watcher count (stop_watch analog).
+    assert store.watch.stats()["watchers"] == 0
 
 
 def test_restore():

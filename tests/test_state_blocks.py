@@ -298,11 +298,11 @@ def test_small_batch_plan_on_deregistered_node_rejected():
 
 def test_block_commit_fires_node_watch():
     store, nodes, job = _seeded_store()
-    fired = threading.Event()
-    store.watch.watch([item_alloc_node(nodes[1].id)], fired)
+    ticket = store.watch.register([item_alloc_node(nodes[1].id)])
     batch = _mk_batch(job, [nodes[1].id], [2])
     store.upsert_alloc_blocks(100, [batch])
-    assert fired.wait(1.0)
+    assert store.watch.wait(ticket, timeout=1.0)
+    store.watch.unregister(ticket)
 
 
 def test_block_member_delete_fires_node_watch():
@@ -311,10 +311,10 @@ def test_block_member_delete_fires_node_watch():
     store, nodes, job = _seeded_store()
     batch = _mk_batch(job, [nodes[1].id], [2])
     store.upsert_alloc_blocks(100, [batch])
-    fired = threading.Event()
-    store.watch.watch([item_alloc_node(nodes[1].id)], fired)
+    ticket = store.watch.register([item_alloc_node(nodes[1].id)])
     store.delete_eval(101, [], [batch.alloc_id(0)])
-    assert fired.wait(1.0)
+    assert store.watch.wait(ticket, timeout=1.0)
+    store.watch.unregister(ticket)
     assert store.alloc_count() == 1
 
 
@@ -438,17 +438,17 @@ def test_block_commit_skips_member_items_only_when_unwatched(monkeypatch):
     # State is visible to a late-registering reader regardless.
     assert len(store.snapshot().allocs_by_node(nodes[0].id)) == 1
 
-    # A parked waiter on a node item fires on the next commit, and the
-    # per-node items were actually built.
-    fired = threading.Event()
-    store.watch.watch([real(nodes[1].id)], fired)
+    # A registered waiter on a node item fires on the next commit, and
+    # the per-node items were actually built.
+    ticket = store.watch.register([real(nodes[1].id)])
     calls["n"] = 0
     store.upsert_alloc_blocks(12, [batch_for(2)])
     assert calls["n"] == 3, "watched commit must build per-node items"
-    assert fired.wait(2.0), "node watch did not fire on watched commit"
+    assert store.watch.wait(ticket, timeout=2.0), \
+        "node watch did not fire on watched commit"
 
-    # stop_watch drops the kind count back to zero: fast path returns.
-    store.watch.stop_watch([real(nodes[1].id)], fired)
+    # unregister drops the kind count back to zero: fast path returns.
+    store.watch.unregister(ticket)
     calls["n"] = 0
     store.upsert_alloc_blocks(13, [batch_for(3)])
     assert calls["n"] == 0, "kind counter leaked a waiter"

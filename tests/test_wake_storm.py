@@ -1,0 +1,268 @@
+"""Blocking-query fan-out hardening (server/blocking.py +
+state.store._Watch): the coalesced index-bucketed watch registry.
+
+Pins three things toward the ~50k-watcher posture:
+
+1. **The wake-storm microbenchmark**: writer-side notify cost under 1k /
+   10k / 50k registered watchers of one hot item — coalesced (bucket
+   generation bump, O(touched items)) vs the retired per-watcher design
+   (one ``Event.set()`` per watcher, O(watchers), paid by the FSM apply
+   thread). The per-watcher baseline is reconstructed locally so the
+   comparison stays honest as the production code evolves.
+2. **Gapless-wake correctness**: concurrent watchers looping
+   register → probe → wait never miss their index after coalescing —
+   including watchers parked on bucket-SHARING items (spurious wakes
+   re-probe and re-park; lost wakes would time out).
+3. **Bounded registrations**: past ``max_watchers`` the registry raises
+   a typed ``RejectError(WATCH_LIMIT)`` with a retry hint — the same
+   cheap-rejection machinery as the admission front door.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.state.store import StateStore, _Watch, item_node, item_table
+from nomad_tpu.structs import REJECT_WATCH_LIMIT, RejectError
+
+
+class _PerWatcherWatch:
+    """The retired design, reconstructed as the benchmark baseline: one
+    Event per watcher per item; notify iterates and sets every parked
+    event under the registry lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._waiters = {}
+
+    def watch(self, items, event):
+        with self._lock:
+            for item in items:
+                self._waiters.setdefault(item, set()).add(event)
+
+    def notify(self, items):
+        if not self._waiters:
+            return
+        with self._lock:
+            for item in items:
+                for event in self._waiters.get(item, ()):
+                    event.set()
+
+
+def _time_notifies(registry, item, rounds):
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        registry.notify([item])
+    return time.perf_counter() - t0
+
+
+@pytest.mark.parametrize("n_watchers", [1_000, 10_000, 50_000])
+def test_wake_storm_coalesced_beats_per_watcher(n_watchers):
+    """Writer-side notify with N watchers parked on ONE hot item: the
+    coalesced registry's cost must not scale with N (it bumps one bucket
+    generation), while the per-watcher baseline pays N Event.set()s.
+    Margins are deliberately loose (the real gap is >50x at 50k) so a
+    noisy box can't flake this."""
+    item = item_table("allocs")
+    rounds = 50
+
+    legacy = _PerWatcherWatch()
+    for _ in range(n_watchers):
+        legacy.watch([item], threading.Event())
+    legacy_cost = _time_notifies(legacy, item, rounds)
+
+    coalesced = _Watch()
+    tickets = [coalesced.register([item]) for _ in range(n_watchers)]
+    coalesced_cost = _time_notifies(coalesced, item, rounds)
+
+    per_notify_legacy = legacy_cost / rounds
+    per_notify_coalesced = coalesced_cost / rounds
+    print(f"\nwake-storm @{n_watchers}: per-watcher "
+          f"{per_notify_legacy * 1e6:.1f}us/notify, coalesced "
+          f"{per_notify_coalesced * 1e6:.1f}us/notify "
+          f"({per_notify_legacy / max(per_notify_coalesced, 1e-9):.0f}x)")
+    # The storm: per-watcher scales with N; coalesced must beat it by a
+    # wide margin once N is large.
+    assert coalesced_cost * 5 < legacy_cost, (
+        f"coalesced notify ({per_notify_coalesced * 1e6:.1f}us) not "
+        f"clearly cheaper than per-watcher "
+        f"({per_notify_legacy * 1e6:.1f}us) at {n_watchers} watchers"
+    )
+    for t in tickets:
+        coalesced.unregister(t)
+    assert coalesced.stats()["watchers"] == 0
+
+
+def test_wake_storm_coalesced_cost_is_flat():
+    """Coalesced notify is O(1) in watcher count: 50x more watchers must
+    not make a notify anywhere near 50x slower (generous 10x slack for
+    timer noise — the real ratio is ~1x)."""
+    item = item_table("allocs")
+    rounds = 200
+
+    def cost_at(n):
+        w = _Watch()
+        tickets = [w.register([item]) for _ in range(n)]
+        try:
+            return _time_notifies(w, item, rounds)
+        finally:
+            for t in tickets:
+                w.unregister(t)
+
+    # Warm once (allocator noise), then measure.
+    cost_at(100)
+    small, big = cost_at(1_000), cost_at(50_000)
+    assert big < small * 10, (
+        f"coalesced notify scaled with watcher count: "
+        f"{small * 1e6 / rounds:.2f}us @1k vs "
+        f"{big * 1e6 / rounds:.2f}us @50k"
+    )
+
+
+def test_no_watcher_misses_its_index_after_coalescing():
+    """The gapless contract: concurrent watchers looping
+    register → probe → short wait all observe the final index. A lost
+    wakeup shows up as a watcher systematically timing out; bucket
+    collisions may wake the wrong watcher early (it re-probes and
+    re-parks) but never silence the right one."""
+    store = StateStore()
+    nodes = [f"node-{i:03d}" for i in range(40)]
+    final_index = 1000 + 60
+    errors = []
+    seen = []
+
+    def watcher(widx):
+        # Mix of items: the node items deliberately collide across the
+        # 64 buckets at 40 nodes, and the table item is white-hot.
+        item = (item_table("nodes") if widx % 3 == 0
+                else item_node(nodes[widx % len(nodes)]))
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            ticket = store.watch.register([item])
+            try:
+                idx = store.get_index("nodes")
+                if idx >= final_index:
+                    seen.append(widx)
+                    return
+                store.watch.wait(ticket, timeout=0.5)
+            finally:
+                store.watch.unregister(ticket)
+        errors.append(f"watcher {widx} never saw index {final_index}")
+
+    threads = [threading.Thread(target=watcher, args=(i,))
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    from nomad_tpu import mock
+
+    for i in range(61):
+        n = mock.node()
+        n.id = n.name = nodes[i % len(nodes)]
+        store.upsert_node(1000 + i, n)
+        time.sleep(0.001)
+    for t in threads:
+        t.join(35.0)
+    assert not errors, errors
+    assert len(seen) == 24
+
+
+def test_multi_bucket_registration_wakes_on_any_item():
+    """Multi-item tickets (topic-filtered event watchers) span buckets
+    and park on the shared side channel: a notify on ANY of the items
+    must wake them."""
+    w = _Watch()
+    items = [item_node(f"n{i}") for i in range(8)]  # spans buckets
+    woke = []
+
+    def waiter():
+        ticket = w.register(items)
+        try:
+            woke.append(w.wait(ticket, timeout=5.0))
+        finally:
+            w.unregister(ticket)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    w.notify([items[-1]])
+    t.join(6.0)
+    assert woke == [True]
+
+
+def test_watcher_cap_typed_rejection():
+    w = _Watch(max_watchers=2)
+    t1 = w.register([item_table("nodes")])
+    t2 = w.register([item_table("allocs")])
+    with pytest.raises(RejectError) as exc:
+        w.register([item_table("jobs")])
+    assert exc.value.reason == REJECT_WATCH_LIMIT
+    assert exc.value.retry_after > 0
+    assert w.stats()["rejected"] == 1
+    # Unregistering frees capacity.
+    w.unregister(t1)
+    t3 = w.register([item_table("jobs")])
+    w.unregister(t2)
+    w.unregister(t3)
+    assert w.stats()["watchers"] == 0
+
+
+def test_blocking_query_surfaces_watch_limit_typed():
+    """server/blocking.py propagates the typed watcher-cap rejection
+    (it must never silently degrade into an unregistered busy-poll)."""
+    from nomad_tpu.server.blocking import blocking_query
+
+    store = StateStore()
+    store.watch.max_watchers = 1
+    blocker = store.watch.register([item_table("jobs")])  # eat the slot
+    try:
+        with pytest.raises(RejectError) as exc:
+            blocking_query(
+                get_store=lambda: store,
+                items=lambda s: [item_table("nodes")],
+                run=lambda s: (s.get_index("nodes"), []),
+                index_of=lambda s: s.get_index("nodes"),
+                min_index=10_000,
+                timeout=1.0,
+            )
+        assert exc.value.reason == REJECT_WATCH_LIMIT
+    finally:
+        store.watch.unregister(blocker)
+
+
+def test_http_blocking_poll_rejects_503_at_watcher_cap():
+    """End to end: an HTTP long-poll past the watcher cap gets a fast
+    503 with Retry-After, not a parked connection."""
+    import urllib.error
+    import urllib.request
+
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    # Server-only agent: a dev-mode CLIENT long-polls its own node's
+    # allocs through this same registry and would race the test for the
+    # single watcher slot.
+    config = AgentConfig(server_enabled=True, dev_mode=True,
+                         node_name="wake-storm-test")
+    config.http_port = 0
+    config.scheduler_backend = "host"
+    config.max_blocking_watchers = 1
+    agent = Agent(config)
+    agent.start()
+    try:
+        store = agent.server.state_store
+        assert store.watch.max_watchers == 1
+        blocker = store.watch.register([item_table("jobs")])
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"{agent.http.addr}/v1/nodes?index=999999&wait=5s",
+                    timeout=10,
+                )
+            assert exc.value.code == 503
+            assert int(exc.value.headers["Retry-After"]) >= 1
+            assert time.monotonic() - t0 < 3.0  # fast, not parked
+        finally:
+            store.watch.unregister(blocker)
+    finally:
+        agent.shutdown()

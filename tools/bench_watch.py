@@ -302,9 +302,12 @@ def slo_gate(new_artifact: dict, baseline_artifact: dict,
 
 
 def _banked_simload_pairs() -> list:
-    """(scenario, newest artifact path, previous-round path) for every
-    banked ``SIMLOAD_<scenario>_s<seed>[_rNN].json`` family with at least
-    two rounds on disk. Un-suffixed artifacts count as round 0."""
+    """(scenario, newest artifact path, previous-round path or None) for
+    every banked ``SIMLOAD_<scenario>_s<seed>[_rNN].json`` family.
+    Un-suffixed artifacts count as round 0. Single-round families (a
+    freshly introduced scenario — e.g. overdrive-100k's first bank) pair
+    with None: the gate then checks the artifact ABSOLUTELY against its
+    declared objectives instead of skipping it silently."""
     import re
 
     fams: dict = {}
@@ -317,29 +320,54 @@ def _banked_simload_pairs() -> list:
     out = []
     for fam, rounds in sorted(fams.items()):
         rounds.sort()
-        if len(rounds) >= 2:
-            out.append((fam, rounds[-1][1], rounds[-2][1]))
+        out.append((fam, rounds[-1][1],
+                    rounds[-2][1] if len(rounds) >= 2 else None))
     return out
 
 
+def slo_gate_absolute(new_artifact: dict,
+                      objectives: dict | None = None) -> dict:
+    """First-round gate (no banked baseline yet): every OBSERVED
+    objective must be met outright. Unobserved objectives (no samples —
+    e.g. no running acks in an ack_cap=0 scenario) are reported, not
+    failed."""
+    from nomad_tpu.slo import evaluate_artifact
+
+    checks = []
+    ok = True
+    for c in evaluate_artifact(_attribution_of(new_artifact), objectives):
+        verdict = dict(c)
+        verdict["baseline_ms"] = None
+        verdict["regressed"] = c["met"] is False
+        ok = ok and not verdict["regressed"]
+        checks.append(verdict)
+    return {"ok": ok, "tolerance": None, "checks": checks}
+
+
 def slo_gate_scan(log=log) -> bool:
-    """Run the SLO gate over every banked artifact family's newest-vs-
-    previous pair; log one verdict per family. Returns overall pass."""
+    """Run the SLO gate over every banked artifact family: newest-vs-
+    previous where a prior round exists, absolute-against-objectives for
+    first-round families; log one verdict per family. Returns overall
+    pass."""
     ok = True
     for fam, new_path, base_path in _banked_simload_pairs():
         try:
             with open(new_path) as f:
                 new = json.load(f)
-            with open(base_path) as f:
-                base = json.load(f)
-            verdict = slo_gate(new, base)
+            if base_path is None:
+                verdict = slo_gate_absolute(new)
+            else:
+                with open(base_path) as f:
+                    base = json.load(f)
+                verdict = slo_gate(new, base)
         except (OSError, ValueError, KeyError) as e:
             log("slo-gate-error", family=fam, error=str(e))
             ok = False
             continue
         log("slo-gate", family=fam,
             new=os.path.basename(new_path),
-            baseline=os.path.basename(base_path),
+            baseline=(os.path.basename(base_path) if base_path
+                      else "<absolute>"),
             ok=verdict["ok"],
             regressed=[c["objective"] for c in verdict["checks"]
                        if c["regressed"]])

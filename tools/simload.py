@@ -82,8 +82,11 @@ def main() -> int:
                   "(spec.deterministic=False); refusing a vacuous verify",
                   file=sys.stderr)
             return 2
+        # Main arms only: the contrast (admission-off) arm's digest is
+        # not part of the determinism contract and re-running it here
+        # would double the verification cost for nothing.
         second = run_scenario(args.scenario, seed=args.seed,
-                              n_nodes=args.nodes)
+                              n_nodes=args.nodes, contrast=False)
         match = (artifact["events"]["digest"] == second["events"]["digest"]
                  and artifact["events"]["by_type"]
                  == second["events"]["by_type"])
@@ -107,7 +110,8 @@ def main() -> int:
         # p50 sample at ~20ms jitters more than the <5% bar); every raw
         # number is recorded so the reduction is auditable.
         baseline = run_scenario(args.scenario, seed=args.seed,
-                                n_nodes=args.nodes, attribution_layer=False)
+                                n_nodes=args.nodes, attribution_layer=False,
+                                contrast=False)
         enabled_p50s = [artifact["plan_latency_ms"].get("p50_ms")]
         det = artifact.get("determinism")
         if args.verify_determinism and det and det.get("verified"):
@@ -130,10 +134,14 @@ def main() -> int:
         json.dump(artifact, f, indent=2, sort_keys=True)
         f.write("\n")
 
+    admission = artifact.get("admission", {})
     print(json.dumps({
         "metric": f"simload.{args.scenario}",
         "seed": args.seed,
         "n_nodes": artifact["n_nodes"],
+        "offered": admission.get("injector", {}).get("offered"),
+        "rejected": admission.get("injector", {}).get("rejected"),
+        "caps_respected": admission.get("caps_respected"),
         "placed": artifact["placements"]["placed"],
         "placements_per_sec": artifact["placements"]["placements_per_sec"],
         "plan_latency_ms_p50": artifact["plan_latency_ms"].get("p50_ms"),
