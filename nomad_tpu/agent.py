@@ -47,6 +47,9 @@ class AgentConfig:
     plan_queue_cap: int = 0
     max_blocking_watchers: int = 0
     admission: Optional[Dict] = None
+    # Express placement lane spec (nomad_tpu/server/express.py):
+    # None = lane off.
+    express: Optional[Dict] = None
     enable_debug: bool = False
     statsite_addr: str = ""
     statsd_addr: str = ""
@@ -134,6 +137,8 @@ class AgentConfig:
             max_blocking_watchers=fc.server.max_blocking_watchers,
             admission=(dict(fc.server.admission)
                        if fc.server.admission is not None else None),
+            express=(dict(fc.server.express)
+                     if fc.server.express is not None else None),
             enable_debug=fc.enable_debug,
             statsite_addr=fc.telemetry.statsite_address,
             statsd_addr=fc.telemetry.statsd_address,
@@ -225,6 +230,8 @@ class Agent:
             max_blocking_watchers=self.config.max_blocking_watchers,
             admission=(dict(self.config.admission)
                        if self.config.admission is not None else None),
+            express=(dict(self.config.express)
+                     if self.config.express is not None else None),
         )
         if self.config.event_buffer_size:
             server_config.event_buffer_size = self.config.event_buffer_size

@@ -96,6 +96,11 @@ class ServerBlock:
     plan_queue_cap: int = 0
     max_blocking_watchers: int = 0
     admission: Optional[Dict[str, object]] = None
+    # Express placement lane (nomad_tpu/server/express.py): the
+    # ``express { }`` sub-block enables leader-local sub-millisecond
+    # placement for express-flagged batch jobs under leased capacity
+    # reservations. None = lane off (the default posture).
+    express: Optional[Dict[str, object]] = None
     enabled_schedulers: List[str] = field(default_factory=list)
     start_join: List[str] = field(default_factory=list)
 
@@ -279,6 +284,13 @@ class FileConfig:
                 else other.server.admission if self.server.admission is None
                 else {**self.server.admission, **other.server.admission}
             ),
+            # Express knobs merge key-by-key like admission: a later file
+            # overrides one knob without dropping the rest.
+            express=(
+                self.server.express if other.server.express is None
+                else other.server.express if self.server.express is None
+                else {**self.server.express, **other.server.express}
+            ),
             enabled_schedulers=(
                 other.server.enabled_schedulers or self.server.enabled_schedulers
             ),
@@ -450,6 +462,15 @@ def _from_mapping(data: dict) -> FileConfig:
 
                     AdmissionConfig.parse(dict(v))
                     cfg.server.admission = dict(v)
+                elif k == "express":
+                    if not isinstance(v, dict):
+                        raise ValueError("server.express must be a mapping")
+                    # Same posture: a typo'd express knob fails config
+                    # load (ExpressConfig.parse), not agent start.
+                    from nomad_tpu.server.express import ExpressConfig
+
+                    ExpressConfig.parse(dict(v))
+                    cfg.server.express = dict(v)
                 elif k in ("bootstrap_expect", "protocol_version"):
                     setattr(cfg.server, k, int(v))
                 else:

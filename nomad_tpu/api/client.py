@@ -409,6 +409,13 @@ class AgentApi:
         out, _ = self.client.query("/v1/agent/admission")
         return out
 
+    def express(self) -> Dict:
+        """Express placement lane state (/v1/agent/express): placement/
+        commit/bounce books, the reservation ledger, and in-line
+        place-latency quantiles (nomad_tpu/server/express.py)."""
+        out, _ = self.client.query("/v1/agent/express")
+        return out
+
     def debug_bundle(self, events: int = 0) -> Dict:
         """One-shot flight recorder (/v1/agent/debug/bundle; requires the
         agent to run with enable_debug). ``events`` caps the included

@@ -200,6 +200,7 @@ class HTTPServer:
             (r"^/v1/agent/self$", self.agent_self),
             (r"^/v1/agent/slo$", self.agent_slo),
             (r"^/v1/agent/admission$", self.agent_admission),
+            (r"^/v1/agent/express$", self.agent_express),
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
             (r"^/v1/agent/debug$", self.agent_debug),
@@ -723,6 +724,19 @@ class HTTPServer:
         }
         return out, None
 
+    def agent_express(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Express placement lane state (nomad_tpu/server/express.py):
+        lane books (placed/committed/bounced/reconciled, fallbacks by
+        reason), the reservation ledger, in-line place-latency
+        quantiles, and the recent committer outcomes — what an operator
+        reads when express latency or bounce rates look wrong. Answers
+        lane-off too (enabled=false, zero books)."""
+        srv = self._srv()
+        express = getattr(srv, "express_lane", None)
+        if express is None:
+            raise HTTPCodedError(404, "express lane not available")
+        return express.snapshot(), None
+
     def agent_metrics(self, req, query) -> Tuple[Any, Optional[int]]:
         """Live InmemSink aggregates. Default JSON (all retained
         intervals, plus the device-mirror cache's delta economy);
@@ -738,13 +752,15 @@ class HTTPServer:
                  + _mirror_prometheus_text()
                  + _plan_pipeline_prometheus_text()
                  + _trace_prometheus_text()
-                 + self._admission_prometheus_text()).encode(),
+                 + self._admission_prometheus_text()
+                 + self._express_prometheus_text()).encode(),
                 "text/plain; version=0.0.4",
             ), None
         return {"timestamp": trace.now(), "intervals": sink.data(),
                 "mirror_cache": _mirror_cache_stats(),
                 "plan_pipeline": _plan_pipeline_stats(),
                 "admission": self._admission_stats(),
+                "express": self._express_stats(),
                 "trace": trace.get_tracer().stats()}, None
 
     def _admission_stats(self) -> Optional[Dict[str, Any]]:
@@ -771,6 +787,35 @@ class HTTPServer:
         for reason, n in sorted(stats.get("by_reason", {}).items()):
             lines.append(f'{name}{{reason="{reason}"}} {n}')
         return "\n".join(lines) + "\n" if lines else ""
+
+    def _express_stats(self) -> Optional[Dict[str, Any]]:
+        """Express-lane totals for the metrics JSON body (None when no
+        server runs — the endpoint must answer on a client-only agent)."""
+        server = getattr(self.agent, "server", None)
+        express = getattr(server, "express_lane", None)
+        return express.summary() if express is not None else None
+
+    def _express_prometheus_text(self) -> str:
+        """Express-lane counters as Prometheus lines: placement/commit/
+        bounce totals plus outstanding-lease and backlog gauges."""
+        stats = self._express_stats()
+        if not stats:
+            return ""
+        lines = []
+        for k in ("placed", "tasks_placed", "committed", "bounces",
+                  "conflicts", "reconciled"):
+            name = f"nomad_express_{k}_total"
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {stats[k]}")
+        name = "nomad_express_fallback_total"
+        lines.append(f"# TYPE {name} counter")
+        for why, n in sorted(stats.get("fallbacks", {}).items()):
+            lines.append(f'{name}{{reason="{why}"}} {n}')
+        lines.append("# TYPE nomad_express_leases gauge")
+        lines.append(f"nomad_express_leases {stats['leases']}")
+        lines.append("# TYPE nomad_express_backlog gauge")
+        lines.append(f"nomad_express_backlog {stats['backlog']}")
+        return "\n".join(lines) + "\n"
 
     def agent_traces(self, req, query) -> Tuple[Any, Optional[int]]:
         """Summaries of the tracer's retained traces, newest first

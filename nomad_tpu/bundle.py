@@ -60,7 +60,7 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
     "faults", "breaker", "mirror", "plan_pipeline", "slo", "admission",
-    "timelines", "nomadlint", "threads",
+    "express", "timelines", "nomadlint", "threads",
 )
 
 # Every `python -m tools.nomadlint` run writes its full report here; the
@@ -200,6 +200,15 @@ def _admission_section(agent) -> Optional[Dict[str, Any]]:
     return admission.snapshot() if admission is not None else None
 
 
+def _express_section(agent) -> Optional[Dict[str, Any]]:
+    """Express-lane snapshot (nomad_tpu/server/express.py): placement/
+    commit/bounce books, the reservation ledger, place-latency
+    quantiles, recent committer outcomes. None without a server."""
+    server = getattr(agent, "server", None) if agent is not None else None
+    express = getattr(server, "express_lane", None)
+    return express.snapshot() if express is not None else None
+
+
 # Worst-K slowest timelines embedded per bundle: summaries of the tail,
 # not the whole run — a red tier-1 bundle must stay one readable JSON.
 TIMELINE_WORST_K = 8
@@ -255,6 +264,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "plan_pipeline": None,
         "slo": None,
         "admission": None,
+        "express": None,
         "timelines": [],
         "nomadlint": None,
         "threads": None,
@@ -269,6 +279,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("plan_pipeline", _plan_pipeline_section),
         ("slo", lambda: _slo_section(agent)),
         ("admission", lambda: _admission_section(agent)),
+        ("express", lambda: _express_section(agent)),
         ("timelines", lambda: _timelines_section(agent, last_events)),
         ("nomadlint", _nomadlint_section),
         ("threads", thread_stacks),

@@ -43,6 +43,10 @@ Alloc      AllocUpserted, AllocClientUpdated (alloc id; columnar blocks
            fan-out would cost O(placements) per commit, the same
            granularity contract as the state store's watch items)
 Plan       PlanApplied (eval id)
+Express    ExpressPlaced (eval id; ONE deterministic event per express
+           submission, payload carries the in-line placed_ms — commit/
+           bounce outcomes are counters + the lane's decision ring, so
+           the canonical digest never depends on commit timing)
 Leader     LeaderAcquired, LeaderLost (server node id)
 Breaker    BreakerStateChanged (breaker name)
 Fault      FaultInjected (site)

@@ -120,6 +120,10 @@ def _parse_job(block: Block) -> Job:
         type=str(body.get("type", "service")),
         priority=int(body.get("priority", 50)),
         all_at_once=bool(body.get("all_at_once", False)),
+        # Express-lane opt-in (nomad_tpu/server/express.py; tpu-native
+        # extension, no reference analog): `express = true` on a batch
+        # job requests leader-local sub-millisecond placement.
+        express=bool(body.get("express", False)),
         datacenters=[str(d) for d in body.get("datacenters", [])],
     )
 

@@ -61,6 +61,20 @@ STAGES = (
     "unattributed",
 )
 
+# Express-lane stages (server/express.py): a separate taxonomy — the
+# express path skips broker/worker/plan-queue entirely, so its timeline
+# is the in-line pick + lease (submit→placed) with the async raft commit
+# OUTSIDE submit→placed (it happens after the caller was answered).
+# Surfaced in the waterfall only when express timelines are present.
+EXPRESS_STAGES = (
+    "express_pick",
+    "express_lease",
+)
+
+# Async-commit stage: informative (how long until the placement became
+# durable), deliberately NOT part of the submit→placed partition.
+EXPRESS_ASYNC_STAGES = ("express_commit",)
+
 STAGE_KINDS = {
     "broker_wait": "queue",
     "raft_catchup": "service",
@@ -71,6 +85,9 @@ STAGE_KINDS = {
     "raft_commit": "service",
     "unattributed": "gap",
     "client_ack": "service",
+    "express_pick": "service",
+    "express_lease": "service",
+    "express_commit": "async",
 }
 
 # Span name -> stage for the directly-mapped spans. schedule_solve and
@@ -81,6 +98,9 @@ _SPAN_STAGE = {
     "plan.queue_wait": "plan_queue_wait",
     "plan.evaluate": "plan_verify",
     "plan.apply": "raft_commit",
+    "express.pick": "express_pick",
+    "express.lease": "express_lease",
+    "express.commit": "express_commit",
 }
 
 
@@ -199,6 +219,18 @@ def scan_events(events: Iterable) -> Dict[str, Dict[str, Any]]:
             rec = _rec(key)
             if rec["placed"] is None:
                 rec["placed"] = etime
+        elif topic == "Express" and etype == "ExpressPlaced":
+            # Express evals never publish a pending EvalUpdated (they
+            # commit COMPLETE, asynchronously); the placement event
+            # carries the in-line latency, so the anchors derive from it:
+            # placed = event time, submitted = placed - placed_ms.
+            rec = _rec(key)
+            if rec["submitted"] is None:
+                ms = float(payload.get("placed_ms", 0.0))
+                rec["placed"] = etime
+                rec["submitted"] = etime - ms / 1000.0
+                rec["job_id"] = payload.get("job_id", "")
+                rec["triggered_by"] = "express"
         elif topic == "Alloc" and etype == "AllocClientUpdated":
             ev_id = payload.get("eval_id", "")
             if (ev_id
@@ -302,7 +334,17 @@ def stitch_eval(eval_id: str, spans: Optional[List[Dict[str, Any]]],
     # keeps it absent rather than inventing one from the root span.
     e2e = tl.submit_to_placed_ms
     if e2e is not None:
-        attributed = sum(stage_ms.values())
+        if tl.triggered_by == "express":
+            # Express submit→placed is the in-line path: only the
+            # express stages partition it. The async-commit machinery's
+            # spans (express_commit and the plan stages nested under it)
+            # run AFTER the caller was answered and must not charge it.
+            attributed = sum(stage_ms.get(s, 0.0) for s in EXPRESS_STAGES)
+        else:
+            attributed = sum(
+                v for k, v in stage_ms.items()
+                if STAGE_KINDS.get(k) != "async"
+            )
         stage_ms["unattributed"] = max(0.0, e2e - attributed)
     if (tl.placed_at is not None and tl.running_at is not None
             and tl.running_at >= tl.placed_at):
@@ -406,7 +448,12 @@ def attribution(timelines: Iterable[Timeline]) -> Dict[str, Any]:
 
     waterfall = []
     stage_sum_all = 0.0
-    for stage in STAGES:
+    stages = list(STAGES)
+    if any(t.stage_ms.get(s) for t in tls for s in EXPRESS_STAGES):
+        # Express timelines present: their stages join the waterfall
+        # (before the unattributed gap, which stays last).
+        stages = stages[:-1] + list(EXPRESS_STAGES) + stages[-1:]
+    for stage in stages:
         per_tl = [t.stage_ms.get(stage, 0.0) for t in tls]
         total = sum(per_tl)
         stage_sum_all += total

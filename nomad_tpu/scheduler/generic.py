@@ -33,6 +33,7 @@ from nomad_tpu.structs import (
     ALLOC_DESIRED_STATUS_STOP,
     EVAL_STATUS_COMPLETE,
     EVAL_STATUS_FAILED,
+    EVAL_TRIGGER_EXPRESS_RECONCILE,
     EVAL_TRIGGER_JOB_DEREGISTER,
     EVAL_TRIGGER_JOB_REGISTER,
     EVAL_TRIGGER_NODE_UPDATE,
@@ -83,6 +84,11 @@ class GenericScheduler:
             EVAL_TRIGGER_NODE_UPDATE,
             EVAL_TRIGGER_JOB_DEREGISTER,
             EVAL_TRIGGER_ROLLING_UPDATE,
+            # A bounced-out/failed-over express entry reconciling
+            # through the slow path (server/express.py): semantically a
+            # fresh job registration — the reconciler places the job's
+            # whole desired state.
+            EVAL_TRIGGER_EXPRESS_RECONCILE,
         ):
             desc = f"scheduler cannot handle '{ev.triggered_by}' evaluation reason"
             set_status(

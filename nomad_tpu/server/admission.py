@@ -66,6 +66,16 @@ from nomad_tpu.structs import (
 
 LANE_SERVICE = "service"
 LANE_BATCH = "batch"
+# Express submissions (Job.express, nomad_tpu/server/express.py) ride
+# their OWN rate lane — a client's express traffic and its bulk batch
+# traffic meter independently — but the SLO-coupled shedder treats the
+# lane as batch-yielding: express is a latency lane, not a rate-limit
+# (or shed) bypass.
+LANE_EXPRESS = "express"
+
+# Lanes the SLO-coupled shedder turns away when the placed-latency
+# budget burns hot; service keeps flowing (Borg's priority posture).
+SHED_LANES = (LANE_BATCH, LANE_EXPRESS)
 
 # Decision-ring depth: enough to see a rejection storm's shape, bounded
 # so the controller can never become its own unbounded queue.
@@ -76,6 +86,15 @@ def lane_for(job_type: str) -> str:
     """Rate/shed lane for a job: batch yields first (Borg posture);
     service and system ride the protected lane."""
     return LANE_BATCH if job_type == structs.JOB_TYPE_BATCH else LANE_SERVICE
+
+
+def lane_for_job(job) -> str:
+    """Lane classification off the job model: express-flagged batch work
+    gets the express lane; everything else classifies by type."""
+    if getattr(job, "express", False) \
+            and job.type == structs.JOB_TYPE_BATCH:
+        return LANE_EXPRESS
+    return lane_for(job.type)
 
 
 @dataclass
@@ -231,7 +250,7 @@ class AdmissionController:
         """Front-door check for one job registration / evaluation
         request. Raises RejectError (typed, retry-after-hinted) or
         returns with the request admitted."""
-        self.admit(client_id, lane_for(job.type), ref=job.id)
+        self.admit(client_id, lane_for_job(job), ref=job.id)
 
     def admit(self, client_id: str, lane: str, ref: str = "") -> None:
         cfg = self.config
@@ -258,9 +277,11 @@ class AdmissionController:
                 cfg.queue_full_retry_after, ref,
                 f"eval acceptance queue at cap ({self.queue_cap})",
             )
-        # Gate 2: SLO-coupled shedding — batch yields first; the service
-        # lane keeps flowing regardless of burn. Also token-free.
-        if cfg.shed_start_burn > 0 and lane == LANE_BATCH:
+        # Gate 2: SLO-coupled shedding — batch AND express yield first
+        # (a shed batch door must shed express too: express is a latency
+        # lane, not a rate-limit bypass); the service lane keeps flowing
+        # regardless of burn. Also token-free.
+        if cfg.shed_start_burn > 0 and lane in SHED_LANES:
             burn = self.burn_rate()
             if burn > cfg.shed_start_burn:
                 frac = min(1.0, (burn - cfg.shed_start_burn)

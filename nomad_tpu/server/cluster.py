@@ -180,6 +180,7 @@ class ClusterServer(Server):
         self.plan_applier.start()
         if self.slo_monitor is not None:
             self.slo_monitor.start()
+        self.express_lane.start()
         from nomad_tpu.server.worker import Worker
 
         for i in range(self.config.scheduler_workers):
@@ -246,6 +247,11 @@ class ClusterServer(Server):
             self.plan_queue.set_enabled(False)
             self.eval_broker.set_enabled(False)
             self.heartbeat.clear_all()
+            # Express leases are leader-local promises against a view
+            # this server no longer owns: drop them (counted). Pending
+            # express commits reconcile to the new leader via the
+            # committer's forward path.
+            self.express_lane.demote()
 
     # -- forwarding (rpc.go:163-228) ------------------------------------------
 
@@ -377,6 +383,17 @@ class ClusterServer(Server):
         out = self._forward("Plan.Submit", {"plan": to_dict(plan)})
         return from_dict(PlanResult, out)
 
+    def express_reconcile(self, job: Job, evals: List[Evaluation]) -> int:
+        """Express slow-path reconciliation rides to the CURRENT leader:
+        a deposed server's committer must be able to durably hand its
+        uncommitted express placements over (server/express.py)."""
+        if self.raft.is_leader:
+            return super().express_reconcile(job, evals)
+        return self._forward(
+            "Express.Reconcile",
+            {"job": to_dict(job), "evals": [to_dict(e) for e in evals]},
+        )
+
     def job_register(self, job: Job, client_id: str = ""):
         # Cross-region submissions route to the owning region first
         # (rpc.go:163-177 forward: region mismatch -> forwardRegion).
@@ -476,6 +493,10 @@ class ClusterServer(Server):
             [from_dict(Evaluation, e) for e in a["evals"]]
         ))
         r("Plan.Submit", self._rpc_plan_submit)
+        r("Express.Reconcile", lambda a: self.express_reconcile(
+            from_dict(Job, a["job"]),
+            [from_dict(Evaluation, e) for e in a["evals"]],
+        ))
         r("Job.Register", self._rpc_job_register)
         r("Job.Evaluate", self._rpc_job_evaluate)
         r("Job.Deregister", self._rpc_job_deregister)

@@ -855,13 +855,22 @@ def _prevaluate_nodes_bulk_dict(snap, plan: Plan, batch_ask=None):
     return out
 
 
-def evaluate_plan(snap, plan: Plan) -> PlanResult:
+def evaluate_plan(snap, plan: Plan, reservations=None) -> PlanResult:
     """Determine the committable subset of a plan (plan_apply.go:164-227).
 
     Columnar batches verify without expansion: each batch contributes
     ``count x resource-vector`` per node run, folded into the same per-node
     fit checks as the object placements; committed batches are the runs on
-    fitting nodes."""
+    fitting nodes.
+
+    ``reservations`` (optional) maps node id -> summed int64[4] debit of
+    ACTIVE express capacity leases (server/express.py ReservationLedger;
+    the caller excludes this plan's own lease). Debits fold into the ask
+    on every touched node, so a slow-path plan cannot verify into
+    capacity an uncommitted express placement holds — the
+    reservation-aware half of the express lane's capacity-safety
+    invariant. None/empty is decision-identical to the pre-express
+    verifier."""
     import numpy as np
 
     result = PlanResult(
@@ -927,6 +936,17 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
                 batch_ask.add_batch(
                     list(cnts.keys()), list(cnts.values()), delta
                 )
+
+    if reservations:
+        # Restricted to nodes this plan touches: a lease elsewhere in
+        # the cell must not drag untouched nodes into this plan's
+        # verification (or flip an untouched node's fit to False and
+        # bounce a plan that asked nothing of it).
+        touched = (set(plan.node_allocation) | set(plan.node_update)
+                   | set(batch_ask.node_ids) | upd_nodes)
+        for nid, vec in reservations.items():
+            if nid in touched:
+                batch_ask.add_delta(nid, vec)
 
     bulk_fit = {}
     n_placements = sum(len(v) for v in plan.node_allocation.values())

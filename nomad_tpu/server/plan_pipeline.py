@@ -155,7 +155,8 @@ def _fused_eligible(plan: Plan) -> bool:
     return all(not _block_has_net(b) for b in plan.alloc_batches)
 
 
-def _fused_prefix(snap, plans: List[Plan], table) -> Tuple[int, List[PlanResult]]:
+def _fused_prefix(snap, plans: List[Plan], table,
+                  reservations=None) -> Tuple[int, List[PlanResult]]:
     """Verify a leading run of fused-eligible plans in ONE batched tensor
     pass over the node table: stack the K per-plan asks, prefix-cumsum
     along K (each plan sees every earlier plan's ask as committed usage —
@@ -229,6 +230,19 @@ def _fused_prefix(snap, plans: List[Plan], table) -> Tuple[int, List[PlanResult]
     base = table.reserved[union].astype(np.int64)
     if block_usage is not None:
         base = base + block_usage[union]
+    if reservations:
+        # Active express capacity leases (server/express.py): charged as
+        # base usage so no fused-verified plan can take leased capacity.
+        # Fused-eligible plans are never express (express plans carry
+        # node_allocation, which disqualifies them above), so no
+        # own-lease exemption arises here.
+        res_rows = np.zeros((table.n, 4), dtype=np.int64)
+        rows_get = table.rows.get
+        for nid, vec in reservations.items():
+            row = rows_get(nid)
+            if row is not None:
+                res_rows[row] += vec
+        base = base + res_rows[union]
     # Same int32 clamp as the scalar verifier's native.fit_check feed —
     # decision identity must survive saturating asks.
     used = np.minimum(base[None, :, :] + cum, 2**31 - 1)
@@ -248,6 +262,7 @@ def _fused_prefix(snap, plans: List[Plan], table) -> Tuple[int, List[PlanResult]
 def evaluate_plans(snap, plans: List[Plan],
                    stamp_index: Callable[[], int] = lambda: 0,
                    totals: Optional[_PipelineTotals] = None,
+                   ledger=None,
                    ) -> List[PlanResult]:
     """Batched, sequential-equivalent plan verification: one PlanResult per
     plan, decision-identical to calling ``evaluate_plan(snap, plan)`` and
@@ -255,7 +270,16 @@ def evaluate_plans(snap, plans: List[Plan],
     before the next call. MUTATES ``snap`` the same way. The pure-columnar
     common case verifies whole runs of plans in one fused tensor pass;
     anything the fused pass can't prove falls to the exact scalar path for
-    that plan and re-fuses the remainder."""
+    that plan and re-fuses the remainder.
+
+    ``ledger`` (optional) is the express lane's ReservationLedger
+    (server/express.py): active lease debits charge as existing usage in
+    both the fused and scalar paths, with each express plan's OWN lease
+    exempted from its verification — the reservation-aware verify.
+    None (or an empty ledger) is decision-identical to before."""
+    full_debits = None
+    if ledger is not None:
+        full_debits = ledger.debit_map() or None
     results: List[PlanResult] = []
     i = 0
     n = len(plans)
@@ -265,7 +289,8 @@ def evaluate_plans(snap, plans: List[Plan],
             # A lone plan takes evaluate_plan directly — its own
             # pure-columnar fast path is the K=1 case of the fused pass.
             m, fused_results = _fused_prefix(
-                snap, plans[i:], _node_table(snap)
+                snap, plans[i:], _node_table(snap),
+                reservations=full_debits,
             )
         if m:
             for plan, result in zip(plans[i:i + m], fused_results):
@@ -277,7 +302,14 @@ def evaluate_plans(snap, plans: List[Plan],
             i += m
             continue
         plan = plans[i]
-        result = evaluate_plan(snap, plan)
+        reservations = full_debits
+        if ledger is not None and plan.express_lease:
+            # The express plan verifying its own async commit: exempt
+            # its own lease (its ask IS that reservation) while still
+            # charging every other outstanding lease.
+            reservations = ledger.debit_map(
+                exclude=(plan.express_lease,)) or None
+        result = evaluate_plan(snap, plan, reservations=reservations)
         if not result.is_noop():
             apply_result_to_snapshot(snap, result, stamp_index())
         results.append(result)
@@ -322,6 +354,11 @@ class PlanPipeline(threading.Thread):
         self._inflight: List = []
         self._opt_snap = None
         self.totals = PIPELINE_TOTALS
+        # Express reservation ledger (server/express.py), set by the
+        # server when the lane is enabled: active lease debits charge as
+        # usage during verification. None = lease-blind (identical to
+        # the pre-express pipeline).
+        self.ledger = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -384,6 +421,8 @@ class PlanPipeline(threading.Thread):
                 for pending in batch:
                     if not pending.future.done():
                         pending.respond(None, e)
+                        if pending.plan.express_lease:
+                            continue  # never marked the broker
                         # Clear the inflight mark outstanding_reset_and_mark
                         # set (the serial applier cleared it in EVERY
                         # respond path): a leaked mark makes nack defer on
@@ -417,6 +456,13 @@ class PlanPipeline(threading.Thread):
                 eval_id, "plan.queue_wait", parent=plan_ctx,
                 start=pending.enqueue_time,
             ).finish()
+            if pending.plan.express_lease:
+                # Express async-commit plans (server/express.py): the
+                # eval never rode the broker, so there is no outstanding
+                # delivery to re-token or mark — and nothing to plan_done
+                # later. They still verify/commit/bounce like any plan.
+                live.append(pending)
+                continue
             try:
                 self.eval_broker.outstanding_reset_and_mark(
                     eval_id, pending.plan.eval_token
@@ -480,10 +526,17 @@ class PlanPipeline(threading.Thread):
             commit_seq[0] += 1
             return base_index + commit_seq[0]
 
+        ledger = self.ledger
+        if ledger is not None and not ledger.active() \
+                and not any(p.plan.express_lease for p in live):
+            # Empty ledger and no express plans in the batch: skip the
+            # debit-map plumbing entirely (the lane-off steady state).
+            ledger = None
         results = evaluate_plans(
             snap, [p.plan for p in live],
             stamp_index=stamp_index,
             totals=self.totals,
+            ledger=ledger,
         )
         for span, result in zip(eval_spans, results):
             span.annotate("refresh_index", result.refresh_index)
@@ -509,7 +562,8 @@ class PlanPipeline(threading.Thread):
                 # Nothing to replicate (evict-nothing plans, whole-plan
                 # bounces): respond straight away — the worker refreshes
                 # and re-plans without waiting on this batch's commits.
-                self.eval_broker.plan_done(plan.eval_id)
+                if not plan.express_lease:
+                    self.eval_broker.plan_done(plan.eval_id)
                 pending.respond(result, None)
                 with self.totals._lock:
                     self.totals.noops += 1
@@ -617,7 +671,9 @@ class PlanPipeline(threading.Thread):
             finally:
                 # The commit is durable (or failed): redelivery may
                 # proceed, and a redelivered worker's wait_index now
-                # covers this plan.
-                self.eval_broker.plan_done(
-                    pending.plan.eval_id, commit_index=index
-                )
+                # covers this plan. Express plans never marked the
+                # broker, so there is nothing to clear.
+                if not pending.plan.express_lease:
+                    self.eval_broker.plan_done(
+                        pending.plan.eval_id, commit_index=index
+                    )

@@ -30,6 +30,7 @@ from nomad_tpu.simcluster.scenario import (  # noqa: F401
 from nomad_tpu.simcluster.simnode import SimFleet, sim_node  # noqa: F401
 from nomad_tpu.simcluster.workload import (  # noqa: F401
     BatchBurstInjector,
+    ExpressStreamInjector,
     NodeChurnInjector,
     SteadyServiceInjector,
     UpdateChurnInjector,

@@ -53,7 +53,7 @@ class Action:
 def build_job(job_id: str, jtype: str, count: int,
               cpu: int = 100, memory_mb: int = 128,
               datacenters: Optional[List[str]] = None,
-              priority: int = 50) -> Job:
+              priority: int = 50, express: bool = False) -> Job:
     """A mock.job()-shaped job with a deterministic id; network-free so
     scale runs stay on the columnar batch path (ports are a host-side
     sequential post-pass that only adds runtime, not control-plane
@@ -64,6 +64,7 @@ def build_job(job_id: str, jtype: str, count: int,
         name=job_id,
         type=jtype,
         priority=priority,
+        express=express,
         datacenters=datacenters or ["dc1", "dc2"],
         constraints=[Constraint(
             l_target="$attr.kernel.name", r_target="linux", operand="=",
@@ -293,6 +294,58 @@ class OverdriveInjector(Injector):
         count, cpu, mem = self.tasks_per_job, self.cpu, self.memory_mb
         return lambda: build_job(jid, structs.JOB_TYPE_BATCH, count,
                                  cpu=cpu, memory_mb=mem)
+
+
+class ExpressStreamInjector(Injector):
+    """A stream of express-eligible short tasks riding alongside a
+    service background (the express-mix scenario's latency probe): one
+    tiny express-flagged batch job every ``every`` seconds with jittered
+    gaps, from ``start`` until ``until``. Each submission exercises the
+    whole express path — admission's express lane, the leader-local
+    sampled pick under a leased reservation, the in-line placed answer,
+    and the asynchronous raft commit — and lands exactly one
+    ``ExpressPlaced`` event carrying the in-line latency, which is what
+    the artifact's ``express_placed_ms`` quantiles (and the
+    express_placed_p50_ms SLO gate) reduce."""
+
+    name = "express-stream"
+
+    def __init__(self, seed: int, tasks: int, every: float,
+                 start: float = 1.0, until: float = 10.0,
+                 tasks_per_job: int = 1, cpu: int = 50,
+                 memory_mb: int = 32, priority: int = 20):
+        super().__init__(seed)
+        self.tasks = tasks
+        self.every = every
+        self.start = start
+        self.until = until
+        self.tasks_per_job = tasks_per_job
+        self.cpu = cpu
+        self.memory_mb = memory_mb
+        self.priority = priority
+
+    def actions(self) -> List[Action]:
+        out = []
+        t = self.start
+        k = 0
+        while k < self.tasks and t < self.until:
+            jid = f"sim-express-{k:05d}"
+            out.append(Action(
+                at=t, kind="register_job",
+                payload={"job_key": jid, "build": self._builder(jid),
+                         "client_id": "sim-express-client",
+                         "express": True},
+            ))
+            k += 1
+            t += self.every * (0.5 + self.rng.random())
+        return out
+
+    def _builder(self, jid: str) -> Callable[[], Job]:
+        count, cpu, mem = self.tasks_per_job, self.cpu, self.memory_mb
+        prio = self.priority
+        return lambda: build_job(jid, structs.JOB_TYPE_BATCH, count,
+                                 cpu=cpu, memory_mb=mem, priority=prio,
+                                 express=True)
 
 
 class NodeChurnInjector(Injector):

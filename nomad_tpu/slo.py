@@ -37,14 +37,26 @@ from nomad_tpu import structs, telemetry
 
 # The metrics an objective may bind to. submit_to_placed is Sparrow's
 # headline cut to durable placement; submit_to_running extends through the
-# client ack (PAPERS.md).
-METRICS = ("submit_to_placed", "submit_to_running")
+# client ack (PAPERS.md); express_placed is the express lane's in-line
+# submit→placed latency (server/express.py — sampled from ExpressPlaced
+# events' placed_ms payload, the lane's own clock: PlanApplied lands
+# asynchronously and would measure the commit, not the placement).
+METRICS = ("submit_to_placed", "submit_to_running", "express_placed")
 
 # Default objectives when none are configured: the ROADMAP item-5 target
 # plus a looser end-to-end bound through the client ack.
 DEFAULT_OBJECTIVES: Dict[str, float] = {
     "submit_to_placed_p95_ms": 250.0,
     "submit_to_running_p95_ms": 1000.0,
+}
+
+# The express lane's target (ROADMAP item 4: p50 submit→placed < 1ms for
+# express-eligible tasks at steady-10k). Merged over the defaults when a
+# server runs with the lane enabled and no explicit objective set; NOT
+# part of DEFAULT_OBJECTIVES — a lane-off server must keep its exact
+# pre-express objective surface.
+EXPRESS_OBJECTIVES: Dict[str, float] = {
+    "express_placed_p50_ms": 1.0,
 }
 
 _NAME_RE = re.compile(r"^(?P<metric>[a-z_]+)_p(?P<pct>\d{1,2})_ms$")
@@ -113,6 +125,14 @@ class _Tracker:
         self.sample.ingest(value_ms)
         return good
 
+    def reset(self) -> None:
+        """Fresh window + reservoir (the monitor's warmup boundary)."""
+        o = self.objective
+        self.window = telemetry.BurnRateWindow(
+            window_s=o.window_s, objective=o.percentile,
+        )
+        self.sample = telemetry.AggregateSample()
+
     def snapshot(self) -> Dict[str, Any]:
         o = self.objective
         stats = self.window.stats()
@@ -157,6 +177,11 @@ class SLOMonitor(threading.Thread):
         self.poll_interval = poll_interval
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        # Serializes whole drain-and-record passes (poll) against the
+        # warmup-boundary wipe (reset): without it a concurrent poll
+        # could fetch warmup events BEFORE the wipe and record them
+        # AFTER, leaking exactly the sample reset() exists to exclude.
+        self._poll_lock = threading.Lock()
         self._cursor = 0
         # eval id -> EvalUpdated(pending) wall stamp / PlanApplied stamp.
         self._pending: "Dict[str, float]" = {}
@@ -169,6 +194,11 @@ class SLOMonitor(threading.Thread):
         self._running_seen: "Dict[str, bool]" = {}
         self.samples = {m: telemetry.AggregateSample() for m in METRICS}
         self.truncated_gaps = 0
+        # Warmup boundary accounting (reset()): how many times the books
+        # were wiped and how many samples each wipe discarded — honesty
+        # about what the live monitor is NOT counting.
+        self.resets = 0
+        self.reset_excluded = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -181,13 +211,15 @@ class SLOMonitor(threading.Thread):
         self.poll()  # final drain so short-lived servers still account
 
     def poll(self) -> None:
-        latest, events, truncated = self.broker.events_after(self._cursor)
-        if truncated and self._cursor:
-            self.truncated_gaps += 1
-            telemetry.incr_counter(("slo", "monitor", "truncated_gap"))
-        self._cursor = latest
-        if events:
-            self.observe(events)
+        with self._poll_lock:
+            latest, events, truncated = self.broker.events_after(
+                self._cursor)
+            if truncated and self._cursor:
+                self.truncated_gaps += 1
+                telemetry.incr_counter(("slo", "monitor", "truncated_gap"))
+            self._cursor = latest
+            if events:
+                self.observe(events)
 
     # -- accounting ----------------------------------------------------------
 
@@ -212,6 +244,13 @@ class SLOMonitor(threading.Thread):
                         self._record_locked(
                             "submit_to_placed", (e.time - t0) * 1000.0
                         )
+                elif e.topic == "Express" and e.type == "ExpressPlaced":
+                    # The express lane's in-line placement latency rides
+                    # the event payload (the async PlanApplied would
+                    # measure the commit, not the sub-ms placement).
+                    ms = e.payload.get("placed_ms")
+                    if ms is not None:
+                        self._record_locked("express_placed", float(ms))
                 elif e.topic == "Alloc" and e.type == "AllocClientUpdated":
                     ev_id = e.payload.get("eval_id", "")
                     if (ev_id
@@ -225,6 +264,41 @@ class SLOMonitor(threading.Thread):
                             self._record_locked(
                                 "submit_to_running", (e.time - t0) * 1000.0
                             )
+            self._publish_gauges_locked()
+
+    def reset(self) -> None:
+        """Drop every sample and error-budget window accumulated so far
+        (counted — ``resets``/``reset_excluded`` surface in snapshot()).
+        The scenario runner calls this at the warmup boundary so the
+        live monitor judges the measured window's steady state: without
+        it, warmup's cold-compile evaluations burn the error budget and
+        ``/v1/agent/slo`` reports a breach the steady state never had
+        (the PR 8 documented caveat). Drains the event ring first so a
+        warmup eval whose events are still unpolled can't leak across
+        the boundary; serialized with poll() so an in-flight drain can
+        never record pre-boundary events after the wipe."""
+        with self._poll_lock:
+            # Drain under the poll lock ONLY (the broker lock must not
+            # nest inside the monitor lock — poll()'s observe() orders
+            # them broker-then-monitor), then wipe under the monitor
+            # lock.
+            latest, _events, _trunc = self.broker.events_after(
+                self._cursor)
+            self._cursor = latest
+            self._reset_books_locked()
+
+    def _reset_books_locked(self) -> None:
+        with self._lock:
+            excluded = sum(agg.count for agg in self.samples.values())
+            self.resets += 1
+            self.reset_excluded += excluded
+            for tr in self.trackers:
+                tr.reset()
+            self.samples = {m: telemetry.AggregateSample()
+                            for m in METRICS}
+            self._pending.clear()
+            self._placed.clear()
+            self._running_seen.clear()
             self._publish_gauges_locked()
 
     def _evict_locked(self, table: Dict[str, Any]) -> None:
@@ -277,6 +351,8 @@ class SLOMonitor(threading.Thread):
                 "samples": samples,
                 "pending_evals": len(self._pending),
                 "truncated_gaps": self.truncated_gaps,
+                "resets": self.resets,
+                "reset_excluded": self.reset_excluded,
             }
 
     def burn_rate(self, metric: str = "submit_to_placed") -> float:

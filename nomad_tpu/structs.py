@@ -75,6 +75,11 @@ EVAL_TRIGGER_JOB_DEREGISTER = "job-deregister"
 EVAL_TRIGGER_NODE_UPDATE = "node-update"
 EVAL_TRIGGER_SCHEDULED = "scheduled"
 EVAL_TRIGGER_ROLLING_UPDATE = "rolling-update"
+# Express lane (nomad_tpu/server/express.py): the in-line placement's
+# COMPLETE eval, and the PENDING eval a bounced-out/failed-over entry
+# reconciles through (the generic scheduler accepts the latter).
+EVAL_TRIGGER_EXPRESS = "express"
+EVAL_TRIGGER_EXPRESS_RECONCILE = "express-reconcile"
 
 CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
 CONSTRAINT_REGEX = "regexp"
@@ -515,6 +520,13 @@ class Job:
     type: str = ""
     priority: int = JOB_DEFAULT_PRIORITY
     all_at_once: bool = False
+    # Express-lane opt-in (nomad_tpu/server/express.py): short-lived
+    # batch work that prefers sub-millisecond leader-local placement
+    # over globally-optimal solving. Eligibility is checked server-side
+    # (batch type, small count, no ports); ineligible or lane-off
+    # submissions take the ordinary path — the flag is a hint, not a
+    # contract change.
+    express: bool = False
     datacenters: List[str] = field(default_factory=list)
     constraints: List[Constraint] = field(default_factory=list)
     task_groups: List[TaskGroup] = field(default_factory=list)
@@ -1184,6 +1196,14 @@ class Plan:
     # plan's touched nodes. 0 = unknown (legacy/wire submitters): no
     # attribution, plain stale-data refresh semantics.
     snapshot_index: int = 0
+    # Express-lane provenance (nomad_tpu/server/express.py): the id of
+    # the leased capacity reservation this plan's placements were
+    # promised under. Non-empty marks an express async-commit plan: the
+    # pipeline skips broker bookkeeping for it (the eval never rode the
+    # broker) and plan verification exempts THIS lease from the ledger
+    # debits it folds in (a plan must not double-count its own
+    # reservation against itself).
+    express_lease: str = ""
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     failed_allocs: List[Allocation] = field(default_factory=list)

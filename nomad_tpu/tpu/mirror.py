@@ -170,6 +170,10 @@ class NodeMirror:
         # no-op single-device placement when no mesh is set), so sharded
         # solves pay no per-dispatch reshard of the big [N, .] inputs.
         self.total = put_node_sharded(total, 1)
+        # Host-side copy of the totals: the express lane's capacity view
+        # (capacity_view) fit-checks candidate rows without a device
+        # readback. Maintained through apply_delta like reserved_np.
+        self.totals_np = total
         self.reserved_np = reserved
         sched = (total - reserved)[:, :2].astype(np.float32)
         self.sched_cap = put_node_sharded(sched, 1)
@@ -212,6 +216,18 @@ class NodeMirror:
         # mirrors and mutated by concurrent scheduler workers.
         self._block_rows: Dict[int, Tuple] = {}
         self._block_rows_lock = threading.Lock()
+        # Express-lane private usage view (capacity_view): rolled IN
+        # PLACE through the alloc change log — unlike _base_usage (whose
+        # arrays are shared with build_usage callers and must copy per
+        # generation), this one is owned by the view and a 10k-row copy
+        # per express submission would be the dominant cost of the
+        # sub-millisecond path. (uid, allocs index, used, bw). The roll
+        # serializes on its own lock (NOT _usage_lock — the rebuild
+        # fallback calls _base_usage_for, which takes that): two
+        # concurrent rolls toward different generations would leave
+        # rows at mixed generations under a single cached index.
+        self._express_usage: Optional[Tuple] = None
+        self._express_roll_lock = threading.Lock()
 
     # -- delta maintenance -------------------------------------------------
 
@@ -292,6 +308,10 @@ class NodeMirror:
         new.n = new_n
         new.padded = self.padded
         new._usage_lock = threading.Lock()
+        # Node writes are the rare axis: the express view rebuilds lazily
+        # from the rolled base on its next read.
+        new._express_usage = None
+        new._express_roll_lock = threading.Lock()
         # Row numbering of resident nodes never moves on the delta path
         # (a departure forces the full rebuild above) and appends are
         # brand-new nodes no existing block can reference: cached block
@@ -321,6 +341,9 @@ class NodeMirror:
             sched_arr = (tot_arr - res_arr)[:, :2].astype(np.float32)
             bwa_arr = np.asarray(bwa_rows, dtype=np.int32)
             bwr_arr = np.asarray(bwr_rows, dtype=np.int32)
+            totals_np = self.totals_np.copy()
+            totals_np[rows_arr] = tot_arr
+            new.totals_np = totals_np
             reserved_np = self.reserved_np.copy()
             reserved_np[rows_arr] = res_arr
             new.reserved_np = reserved_np
@@ -338,6 +361,7 @@ class NodeMirror:
                 p_rows, p_tot, p_sched, p_bwa,
             )
         else:
+            new.totals_np = self.totals_np
             new.reserved_np = self.reserved_np
             new.bw_reserved = self.bw_reserved
             new.total = self.total
@@ -728,6 +752,47 @@ class NodeMirror:
             put_node_sharded(tg_count),
             put_node_sharded(bw_used),
         )
+
+    def capacity_view(self, state) -> Tuple[np.ndarray, np.ndarray]:
+        """(totals[padded,4] int32, used[padded,4] int32) — the express
+        lane's leader-local capacity view: per-row totals next to the
+        delta-rolled job-independent base usage (reserved + every
+        existing allocation) for ``state``'s alloc generation. The SAME
+        per-row accounting the solver's build_usage starts from
+        (_usage_rows_bulk / _compute_base_usage), so an express fit
+        check and a slow-path verify read one truth (reservation debits
+        ride the express ledger on top, not these arrays).
+
+        Unlike ``_base_usage_for`` this view is mirror-private and rolls
+        IN PLACE (no per-generation array copy — a 10k-row copy per
+        submission would dominate the sub-millisecond path). Arrays are
+        SHARED with the view — callers must not mutate, and concurrent
+        submissions serialize on the lane's own lock."""
+        uid = getattr(state, "store_uid", "")
+        aidx = state.get_index("allocs")
+        if not uid or getattr(state, "optimistic", False):
+            used, _bw = self._base_usage_for(state)
+            return self.totals_np, used
+        with self._express_roll_lock:
+            cached = self._express_usage
+            if (cached is not None and cached[0] == uid
+                    and cached[1] == aidx):
+                return self.totals_np, cached[2]
+            used = bw = None
+            if (cached is not None and cached[0] == uid
+                    and aidx > cached[1]
+                    and hasattr(state, "alloc_node_changes_since")):
+                dirty = state.alloc_node_changes_since(cached[1])
+                if dirty is not None and len(dirty) <= max(1024,
+                                                           self.n // 2):
+                    used, bw = cached[2], cached[3]
+                    if dirty:
+                        self._usage_rows_bulk(state, dirty, used, bw)
+            if used is None:
+                base_used, base_bw = self._base_usage_for(state)
+                used, bw = base_used.copy(), base_bw.copy()
+            self._express_usage = (uid, aidx, used, bw)
+        return self.totals_np, used
 
     def _base_usage_for(self, state) -> Tuple[np.ndarray, np.ndarray]:
         """The cached job-independent (used, bw_used) base for ``state``'s

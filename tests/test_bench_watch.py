@@ -294,6 +294,43 @@ def test_slo_gate_absolute_for_first_round_family():
     assert running["regressed"] is False  # unobserved (n=0)
 
 
+def test_slo_gate_express_family_absolute(tmp_path, monkeypatch):
+    """An artifact carrying express observations gates on the express
+    objective too (absolute: express_placed_p50_ms < 1ms), while
+    express-free families keep the default objective set."""
+    express_good = _artifact(p95=100.0)
+    express_good["latency_attribution"]["express_placed_ms"] = {
+        "n": 300, "p50_ms": 0.7, "p95_ms": 0.9, "max_ms": 1.4}
+    express_bad = _artifact(p95=100.0)
+    express_bad["latency_attribution"]["express_placed_ms"] = {
+        "n": 300, "p50_ms": 1.8, "p95_ms": 3.6, "max_ms": 80.0}
+
+    assert bench_watch._objectives_for(_artifact()) is None
+    objs = bench_watch._objectives_for(express_good)
+    assert objs is not None and "express_placed_p50_ms" in objs
+
+    good = bench_watch.slo_gate_absolute(
+        express_good, bench_watch._objectives_for(express_good))
+    assert good["ok"] is True
+    bad = bench_watch.slo_gate_absolute(
+        express_bad, bench_watch._objectives_for(express_bad))
+    assert bad["ok"] is False
+    check = next(c for c in bad["checks"]
+                 if c["objective"] == "express_placed_p50_ms")
+    assert check["observed_ms"] == 1.8 and check["regressed"] is True
+
+    # Through the scan: the express family picks up its objective.
+    lone = tmp_path / "SIMLOAD_express-mix_s42_r12.json"
+    lone.write_text(json.dumps(express_bad))
+    monkeypatch.setattr(
+        bench_watch, "_banked_simload_pairs",
+        lambda: [("express-mix_s42", str(lone), None)])
+    logged = []
+    assert bench_watch.slo_gate_scan(
+        log=lambda event, **kw: logged.append(kw)) is False
+    assert "express_placed_p50_ms" in logged[0]["regressed"]
+
+
 def test_slo_gate_scan_absolute_arm(tmp_path, monkeypatch):
     lone = tmp_path / "SIMLOAD_over_s42_r09.json"
     lone.write_text(json.dumps(_artifact(p95=100.0)))

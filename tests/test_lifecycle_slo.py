@@ -453,6 +453,68 @@ def test_slo_monitor_counts_ring_truncation():
     assert monitor.truncated_gaps == 2
 
 
+def test_slo_monitor_warmup_reset_windows_past_cold_compile():
+    """The PR 8 caveat, closed: a scenario's warmup eval (cold XLA
+    compile, seconds) used to burn the live error budget forever.
+    reset() at the warmup boundary wipes the books — counted — so the
+    steady-state verdict reflects only post-boundary samples."""
+    broker = events_mod.EventBroker(register=False)
+    monitor = slo.SLOMonitor(
+        broker, {"submit_to_placed_p95_ms": 250.0})
+    # Warmup: one catastrophic cold-compile sample (4s >> 250ms).
+    _lifecycle_events(broker, "ev-warmup", placed_dt=4.0)
+    monitor.poll()
+    assert monitor.snapshot()["objectives"][0]["met"] is False
+
+    monitor.reset()
+    snap = monitor.snapshot()
+    assert snap["resets"] == 1
+    assert snap["reset_excluded"] == 1
+    assert snap["samples"]["submit_to_placed"]["count"] == 0
+    assert snap["objectives"][0]["total"] == 0
+
+    # Steady state: fast samples only -> the objective is met, the
+    # warmup breach is gone from window AND reservoir.
+    for i in range(5):
+        _lifecycle_events(broker, f"ev-steady-{i}", placed_dt=0.020)
+    monitor.poll()
+    snap = monitor.snapshot()
+    obj = snap["objectives"][0]
+    assert obj["total"] == 5 and obj["bad"] == 0 and obj["met"] is True
+    assert snap["samples"]["submit_to_placed"]["count"] == 5
+    # A warmup eval whose placement lands only AFTER the boundary must
+    # not leak a cross-boundary sample: its pending anchor was wiped.
+    broker.publish("Plan", "PlanApplied", key="ev-warmup2", payload={})
+    monitor.poll()
+    assert monitor.snapshot()["samples"]["submit_to_placed"]["count"] == 5
+
+
+def test_slo_monitor_samples_express_placed_events():
+    """The express lane's in-line latency rides ExpressPlaced payloads
+    into the express_placed metric (the async PlanApplied never charges
+    it — express evals publish no pending EvalUpdated at all)."""
+    broker = events_mod.EventBroker(register=False)
+    monitor = slo.SLOMonitor(
+        broker, {**slo.DEFAULT_OBJECTIVES, **slo.EXPRESS_OBJECTIVES})
+    broker.publish("Express", "ExpressPlaced", key="ev-x",
+                   payload={"job_id": "j", "tasks": 1,
+                            "placed_ms": 0.42})
+    broker.publish("Express", "ExpressPlaced", key="ev-y",
+                   payload={"job_id": "j", "tasks": 1,
+                            "placed_ms": 3.5})
+    monitor.poll()
+    snap = monitor.snapshot()
+    assert snap["samples"]["express_placed"]["count"] == 2
+    obj = next(o for o in snap["objectives"]
+               if o["name"] == "express_placed_p50_ms")
+    # p50 objective at 1ms: one good, one bad of two -> budget 50%,
+    # burn rate 1.0, still met (<= 1.0).
+    assert obj["total"] == 2 and obj["bad"] == 1
+    assert obj["met"] is True
+    # submit_to_placed untouched by express events.
+    assert snap["samples"]["submit_to_placed"]["count"] == 0
+
+
 def test_evaluate_artifact_checks_stricter_cut():
     att = {"submit_to_placed_ms": {"n": 50, "p50_ms": 40.0,
                                    "p95_ms": 180.0, "p99_ms": 900.0}}

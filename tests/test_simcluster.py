@@ -259,6 +259,54 @@ def test_overdrive_1k_smoke(tmp_path):
     assert art["peaks"]["plan_queue_depth"] <= 64
 
 
+def test_express_1k_smoke(tmp_path):
+    """The express lane at smoke scale, through real RPC: a service
+    background plus a 40-task express stream. Every express submission
+    places in-line (ExpressPlaced events = submissions), every entry
+    commits asynchronously with nothing left on the ledger, and the
+    artifact carries the express quantiles + slo_check rows."""
+    out = tmp_path / "SIMLOAD_express-1k_smoke.json"
+    art = run_scenario("express-1k", seed=42, out_path=str(out))
+    lane = art["express"]["lane"]
+    assert lane["enabled"] is True
+    # 40 stream submissions (+1 warmup, excluded from the measured
+    # window's events but counted in the lane books).
+    assert art["express"]["placed_events"] == 40
+    assert lane["placed"] == 41
+    assert lane["committed"] == 41
+    assert lane["reconciled"] == 0
+    assert lane["fallbacks"] == {}
+    assert lane["backlog"] == 0 and lane["leases"] == 0
+    assert lane["ledger"]["granted"] == lane["ledger"]["released"]
+    # Express placements landed: 40 express evals, one object alloc each
+    # (express allocs commit as object rows; service placements stay
+    # columnar), and the service background placed in full.
+    assert art["events"]["by_type"]["ExpressPlaced"] == 40
+    assert art["placements"]["placed"] == 3 * 60 + 40
+    att = art["latency_attribution"]
+    assert att["express_placed_ms"]["n"] == 40
+    assert att["express_placed_ms"]["p50_ms"] > 0
+    by_obj = {c["objective"]: c for c in att["slo_check"]}
+    assert "express_placed_p50_ms" in by_obj
+    # The live monitor tracked the express metric past the warmup reset
+    # (its 0.25s poll may not have drained the very tail of the stream
+    # when the artifact snapshots it — presence, not exact count).
+    assert art["slo"]["resets"] == 1
+    assert 1 <= art["slo"]["samples"]["express_placed"]["count"] <= 40
+    assert art["events"]["truncated"] is False
+
+
+def test_express_smoke_is_seed_deterministic():
+    """Express placements ride seeded streams (express.pick /
+    express.lease_jitter) and publish ONE deterministic event per
+    submission: the canonical digest replays under the same seed even
+    with the async committer racing the service background."""
+    a = run_scenario("express-1k", seed=11)
+    b = run_scenario("express-1k", seed=11)
+    assert a["events"]["digest"] == b["events"]["digest"]
+    assert a["events"]["by_type"] == b["events"]["by_type"]
+
+
 def test_overdrive_smoke_is_seed_deterministic():
     """Per-client sequential blasting + per-client token buckets: the
     canonical event digest (admission rejections included, keyed by

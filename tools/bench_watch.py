@@ -265,6 +265,19 @@ def _attribution_of(artifact: dict) -> dict:
     return {"submit_to_placed_ms": artifact.get("plan_latency_ms") or {}}
 
 
+def _objectives_for(artifact: dict) -> dict | None:
+    """Objective set for one artifact family: the defaults, plus the
+    express lane's own target (express_placed_p50_ms < 1ms) when the
+    artifact carries express observations — the express-mix family gates
+    ABSOLUTELY on its headline number instead of skipping it. None =
+    the default set (evaluate_artifact's convention)."""
+    from nomad_tpu.slo import DEFAULT_OBJECTIVES, EXPRESS_OBJECTIVES
+
+    if _attribution_of(artifact).get("express_placed_ms"):
+        return {**DEFAULT_OBJECTIVES, **EXPRESS_OBJECTIVES}
+    return None
+
+
 def slo_gate(new_artifact: dict, baseline_artifact: dict,
              objectives: dict | None = None,
              tolerance: float = SLO_GATE_TOLERANCE) -> dict:
@@ -354,12 +367,13 @@ def slo_gate_scan(log=log) -> bool:
         try:
             with open(new_path) as f:
                 new = json.load(f)
+            objectives = _objectives_for(new)
             if base_path is None:
-                verdict = slo_gate_absolute(new)
+                verdict = slo_gate_absolute(new, objectives)
             else:
                 with open(base_path) as f:
                     base = json.load(f)
-                verdict = slo_gate(new, base)
+                verdict = slo_gate(new, base, objectives)
         except (OSError, ValueError, KeyError) as e:
             log("slo-gate-error", family=fam, error=str(e))
             ok = False
