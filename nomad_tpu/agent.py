@@ -50,6 +50,9 @@ class AgentConfig:
     # Express placement lane spec (nomad_tpu/server/express.py):
     # None = lane off.
     express: Optional[Dict] = None
+    # Capacity observatory spec (nomad_tpu/capacity.py): None = defaults
+    # (enabled; set {"enabled": False} to turn the accountant off).
+    capacity: Optional[Dict] = None
     enable_debug: bool = False
     statsite_addr: str = ""
     statsd_addr: str = ""
@@ -139,6 +142,8 @@ class AgentConfig:
                        if fc.server.admission is not None else None),
             express=(dict(fc.server.express)
                      if fc.server.express is not None else None),
+            capacity=(dict(fc.server.capacity)
+                      if fc.server.capacity is not None else None),
             enable_debug=fc.enable_debug,
             statsite_addr=fc.telemetry.statsite_address,
             statsd_addr=fc.telemetry.statsd_address,
@@ -232,6 +237,8 @@ class Agent:
                        if self.config.admission is not None else None),
             express=(dict(self.config.express)
                      if self.config.express is not None else None),
+            capacity=(dict(self.config.capacity)
+                      if self.config.capacity is not None else None),
         )
         if self.config.event_buffer_size:
             server_config.event_buffer_size = self.config.event_buffer_size

@@ -101,6 +101,11 @@ class ServerBlock:
     # placement for express-flagged batch jobs under leased capacity
     # reservations. None = lane off (the default posture).
     express: Optional[Dict[str, object]] = None
+    # Capacity observatory (nomad_tpu/capacity.py): the ``capacity { }``
+    # sub-block tunes the read-only accountant behind
+    # /v1/agent/capacity (poll/event cadence, reference shapes for the
+    # stranded-capacity yardstick). None = defaults (enabled).
+    capacity: Optional[Dict[str, object]] = None
     enabled_schedulers: List[str] = field(default_factory=list)
     start_join: List[str] = field(default_factory=list)
 
@@ -291,6 +296,12 @@ class FileConfig:
                 else other.server.express if self.server.express is None
                 else {**self.server.express, **other.server.express}
             ),
+            # Capacity knobs merge key-by-key like express/admission.
+            capacity=(
+                self.server.capacity if other.server.capacity is None
+                else other.server.capacity if self.server.capacity is None
+                else {**self.server.capacity, **other.server.capacity}
+            ),
             enabled_schedulers=(
                 other.server.enabled_schedulers or self.server.enabled_schedulers
             ),
@@ -471,6 +482,15 @@ def _from_mapping(data: dict) -> FileConfig:
 
                     ExpressConfig.parse(dict(v))
                     cfg.server.express = dict(v)
+                elif k == "capacity":
+                    if not isinstance(v, dict):
+                        raise ValueError("server.capacity must be a mapping")
+                    # Same posture: a typo'd capacity knob fails config
+                    # load (CapacityConfig.parse), not agent start.
+                    from nomad_tpu.capacity import CapacityConfig
+
+                    CapacityConfig.parse(dict(v))
+                    cfg.server.capacity = dict(v)
                 elif k in ("bootstrap_expect", "protocol_version"):
                     setattr(cfg.server, k, int(v))
                 else:

@@ -416,6 +416,54 @@ class AgentApi:
         out, _ = self.client.query("/v1/agent/express")
         return out
 
+    def capacity(self) -> Dict:
+        """Capacity observatory state (/v1/agent/capacity): per-dim
+        utilization, bin-pack density, per-lane usage, fragmentation
+        histograms, and stranded-capacity % against the seeded
+        reference shapes (nomad_tpu/capacity.py)."""
+        out, _ = self.client.query("/v1/agent/capacity")
+        return out
+
+    def solver(self) -> Dict:
+        """Device-solve efficiency panel (/v1/agent/solver): padding
+        economy, bucket occupancy, compile attribution, device time per
+        placement, plus the mirror delta-roll economy and jit retrace
+        counters (nomad_tpu/tpu/solver.py SOLVER_PANEL)."""
+        out, _ = self.client.query("/v1/agent/solver")
+        return out
+
+    def traces(self, n: int = 0) -> List[Dict]:
+        """Retained trace summaries (/v1/agent/traces), newest first;
+        ``n`` limits (0 = all retained)."""
+        params = {"n": str(n)} if n else None
+        out, _ = self.client.query("/v1/agent/traces", params=params)
+        return out
+
+    def debug(self) -> Dict:
+        """Runtime introspection (/v1/agent/debug; requires the agent to
+        run with enable_debug): thread stacks, gc stats, device probe /
+        pallas / coalescer / mirror state."""
+        out, _ = self.client.query("/v1/agent/debug")
+        return out
+
+    def faults(self) -> Dict:
+        """The armed fault-injection plan + per-rule fire counts
+        (/v1/agent/faults; debug-gated like /v1/agent/debug)."""
+        out, _ = self.client.query("/v1/agent/faults")
+        return out
+
+    def logs(self, n: int = 0) -> Dict:
+        """Tail of the agent's circular log buffer (/v1/agent/logs);
+        ``n`` limits the line count (0 = the whole buffer)."""
+        params = {"n": str(n)} if n else None
+        out, _ = self.client.query("/v1/agent/logs", params=params)
+        return out
+
+    def servers(self) -> List[str]:
+        """Known server RPC addresses (/v1/agent/servers)."""
+        out, _ = self.client.query("/v1/agent/servers")
+        return out
+
     def debug_bundle(self, events: int = 0) -> Dict:
         """One-shot flight recorder (/v1/agent/debug/bundle; requires the
         agent to run with enable_debug). ``events`` caps the included

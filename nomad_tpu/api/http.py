@@ -65,22 +65,18 @@ def _mirror_cache_stats() -> Dict[str, Any]:
         return {"error": str(e)}
 
 
-def _mirror_prometheus_text() -> str:
-    """Mirror-cache stats as Prometheus lines appended to the sink
-    exposition: monotonic counters for the roll economy, a gauge for
-    residency."""
+def _mirror_prometheus(b: "telemetry.PromText") -> None:
+    """Mirror-cache stats on the shared line-builder: monotonic counters
+    for the roll economy (counts AND wall cost), a gauge for residency."""
     stats = _mirror_cache_stats()
     if "error" in stats:
-        return ""
-    lines = []
+        return
     for k in ("hits", "misses", "delta_rolls", "full_rebuilds",
               "rows_restaged"):
-        name = f"nomad_mirror_cache_{k}_total"
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {stats[k]}")
-    lines.append("# TYPE nomad_mirror_cache_entries gauge")
-    lines.append(f"nomad_mirror_cache_entries {stats['entries']}")
-    return "\n".join(lines) + "\n"
+        b.counter(f"nomad_mirror_cache_{k}_total", stats[k])
+    b.counter("nomad_mirror_cache_roll_ms_total", stats["roll_ms"])
+    b.counter("nomad_mirror_cache_rebuild_ms_total", stats["rebuild_ms"])
+    b.gauge("nomad_mirror_cache_entries", stats["entries"])
 
 
 def _plan_pipeline_stats() -> Dict[str, Any]:
@@ -95,36 +91,65 @@ def _plan_pipeline_stats() -> Dict[str, Any]:
         return {"error": str(e)}
 
 
-def _plan_pipeline_prometheus_text() -> str:
-    """Pipeline totals as Prometheus lines: everything monotonic is a
-    counter; max_batch_seen is a high-watermark gauge."""
+def _plan_pipeline_prometheus(b: "telemetry.PromText") -> None:
+    """Pipeline totals: everything monotonic is a counter;
+    max_batch_seen is a high-watermark gauge."""
     stats = _plan_pipeline_stats()
     if "error" in stats:
-        return ""
-    lines = []
+        return
     for k in ("batches", "plans", "committed", "noops", "rejected",
               "conflicts", "refreshes", "fused_plans", "scalar_plans"):
-        name = f"nomad_plan_pipeline_{k}_total"
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {stats[k]}")
-    lines.append("# TYPE nomad_plan_pipeline_max_batch gauge")
-    lines.append(f"nomad_plan_pipeline_max_batch {stats['max_batch_seen']}")
-    return "\n".join(lines) + "\n"
+        b.counter(f"nomad_plan_pipeline_{k}_total", stats[k])
+    b.gauge("nomad_plan_pipeline_max_batch", stats["max_batch_seen"])
 
 
-def _trace_prometheus_text() -> str:
-    """Tracer loss accounting as Prometheus lines: without the aggregate
-    counters, silent span/trace loss under 10k-node load is invisible
-    until someone opens the one clipped trace."""
+def _trace_prometheus(b: "telemetry.PromText") -> None:
+    """Tracer loss accounting: without the aggregate counters, silent
+    span/trace loss under 10k-node load is invisible until someone opens
+    the one clipped trace."""
     stats = trace.get_tracer().stats()
-    lines = []
     for k in ("spans_dropped", "traces_evicted"):
-        name = f"nomad_trace_{k}_total"
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {stats[k]}")
-    lines.append("# TYPE nomad_trace_retained gauge")
-    lines.append(f"nomad_trace_retained {stats['retained']}")
-    return "\n".join(lines) + "\n"
+        b.counter(f"nomad_trace_{k}_total", stats[k])
+    b.gauge("nomad_trace_retained", stats["retained"])
+
+
+def _solver_panel_stats() -> Dict[str, Any]:
+    """Process-wide device-solve efficiency panel (tpu/solver.py
+    SOLVER_PANEL). Late import: the metrics endpoint must answer even if
+    the solver stack never initialized."""
+    try:
+        from nomad_tpu.tpu.solver import SOLVER_PANEL
+
+        return SOLVER_PANEL.snapshot()
+    except Exception as e:  # pragma: no cover - import-time breakage only
+        return {"error": str(e)}
+
+
+def _solver_prometheus(b: "telemetry.PromText") -> None:
+    """Solver efficiency panel: padding-waste and per-placement device
+    cost as gauges, solve/compile totals as counters with bucket/trigger
+    labels."""
+    stats = _solver_panel_stats()
+    if "error" in stats:
+        return
+    b.counter("nomad_solver_solves_total", stats["solves"])
+    b.counter("nomad_solver_requested_total", stats["requested"])
+    b.counter("nomad_solver_placed_total", stats["placed"])
+    b.counter("nomad_solver_device_ms_total", stats["device_ms"])
+    b.gauge("nomad_solver_node_padding_waste",
+            stats["node_padding_waste"])
+    b.gauge("nomad_solver_count_padding_waste",
+            stats["count_padding_waste"])
+    b.gauge("nomad_solver_device_ms_per_placement",
+            stats["device_ms_per_placement"])
+    for row in stats["node_buckets"]:
+        b.counter("nomad_solver_bucket_solves_total", row["solves"],
+                  labels={"bucket": row["bucket"]})
+        b.gauge("nomad_solver_bucket_occupancy", row["occupancy"],
+                labels={"bucket": row["bucket"]})
+    for trigger, n in stats["compiles"]["by_trigger"].items():
+        b.counter("nomad_solver_compiles_total", n,
+                  labels={"trigger": trigger})
 
 
 class RawResponse:
@@ -201,6 +226,8 @@ class HTTPServer:
             (r"^/v1/agent/slo$", self.agent_slo),
             (r"^/v1/agent/admission$", self.agent_admission),
             (r"^/v1/agent/express$", self.agent_express),
+            (r"^/v1/agent/capacity$", self.agent_capacity),
+            (r"^/v1/agent/solver$", self.agent_solver),
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
             (r"^/v1/agent/debug$", self.agent_debug),
@@ -737,23 +764,88 @@ class HTTPServer:
             raise HTTPCodedError(404, "express lane not available")
         return express.snapshot(), None
 
+    def agent_capacity(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Capacity observatory state (nomad_tpu/capacity.py): per-dim
+        utilization, bin-pack density, per-lane usage, fragmentation
+        histograms, and stranded-capacity % against the seeded reference
+        shapes. ``?format=prometheus`` serves just the capacity families
+        as text exposition. The handler rolls the accountant forward
+        before answering, so the body reflects the store NOW, not the
+        last poll tick — still read-only (the roll consumes the same
+        change logs the poll does)."""
+        acct = self._capacity_accountant()
+        if acct is None:
+            raise HTTPCodedError(404, "capacity observatory not running "
+                                      "(no server, or capacity "
+                                      "{ enabled = false })")
+        acct.refresh()
+        if query.get("format") == "prometheus":
+            b = telemetry.PromText()
+            self._capacity_prometheus(b)
+            return RawResponse(
+                b.text().encode(), "text/plain; version=0.0.4"
+            ), None
+        return acct.snapshot(), None
+
+    def agent_solver(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Device-solve efficiency panel (tpu/solver.py SOLVER_PANEL):
+        per-solve padding economy, bucket-occupancy histograms,
+        compile/recompile attribution (shape key + trigger + wall),
+        device-time-per-placement — next to the mirror cache's
+        delta-roll-vs-full-rebuild economy (now with wall costs), the
+        coalescer's dispatch stacking, and the jit retrace counters.
+        Answers on any agent with a telemetry sink; the panel zeroes
+        honestly when no solve ever dispatched."""
+        out: Dict[str, Any] = {
+            "panel": _solver_panel_stats(),
+            "mirror_cache": _mirror_cache_stats(),
+        }
+        try:
+            from nomad_tpu.ops.coalesce import GLOBAL_SOLVER
+
+            out["coalescer"] = {
+                "dispatches": GLOBAL_SOLVER.dispatches,
+                "coalesced": GLOBAL_SOLVER.coalesced,
+            }
+        except Exception as e:  # pragma: no cover - import breakage only
+            out["coalescer"] = {"error": str(e)}
+        # jit retrace counters (ops/fit.py): cumulative sink totals under
+        # the solver.jit_trace.* vocabulary — each count above 1 per name
+        # is a recompile the trace-hygiene pass exists to prevent.
+        sink = getattr(self.agent, "inmem_sink", None)
+        if sink is not None:
+            counters, _samples = sink.cumulative()
+            out["jit_trace"] = {
+                name: int(v[0]) for name, v in sorted(counters.items())
+                if "jit_trace" in name
+            }
+        else:
+            out["jit_trace"] = None
+        return out, None
+
     def agent_metrics(self, req, query) -> Tuple[Any, Optional[int]]:
         """Live InmemSink aggregates. Default JSON (all retained
         intervals, plus the device-mirror cache's delta economy);
         ``?format=prometheus`` serves text exposition for a Prometheus
         scrape (pull model — the reference only had the SIGUSR1 dump and
-        push sinks)."""
+        push sinks). Every subsystem appender rides ONE shared
+        telemetry.PromText builder, so names/labels sanitize in one
+        place and duplicate/conflicting TYPE lines are structurally
+        impossible."""
         sink = getattr(self.agent, "inmem_sink", None)
         if sink is None:
             raise HTTPCodedError(404, "telemetry sink not initialized")
         if query.get("format") == "prometheus":
+            b = telemetry.PromText()
+            _mirror_prometheus(b)
+            _plan_pipeline_prometheus(b)
+            _trace_prometheus(b)
+            self._admission_prometheus(b)
+            self._express_prometheus(b)
+            self._capacity_prometheus(b)
+            _solver_prometheus(b)
             return RawResponse(
-                (telemetry.prometheus_text(sink)
-                 + _mirror_prometheus_text()
-                 + _plan_pipeline_prometheus_text()
-                 + _trace_prometheus_text()
-                 + self._admission_prometheus_text()
-                 + self._express_prometheus_text()).encode(),
+                (telemetry.prometheus_text(sink) + b.text()).encode(),
                 "text/plain; version=0.0.4",
             ), None
         return {"timestamp": trace.now(), "intervals": sink.data(),
@@ -761,6 +853,8 @@ class HTTPServer:
                 "plan_pipeline": _plan_pipeline_stats(),
                 "admission": self._admission_stats(),
                 "express": self._express_stats(),
+                "capacity": self._capacity_summary(),
+                "solver_panel": _solver_panel_stats(),
                 "trace": trace.get_tracer().stats()}, None
 
     def _admission_stats(self) -> Optional[Dict[str, Any]]:
@@ -771,22 +865,17 @@ class HTTPServer:
         admission = getattr(server, "admission", None)
         return admission.summary() if admission is not None else None
 
-    def _admission_prometheus_text(self) -> str:
-        """Admission counters as Prometheus lines: admitted/rejected
-        totals per lane plus the typed-rejection split."""
+    def _admission_prometheus(self, b: "telemetry.PromText") -> None:
+        """Admission counters: admitted/rejected totals plus the
+        typed-rejection split."""
         stats = self._admission_stats()
         if not stats:
-            return ""
-        lines = []
+            return
         for k in ("admitted", "rejected"):
-            name = f"nomad_admission_{k}_total"
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {stats[k]}")
-        name = "nomad_admission_rejected_reason_total"
-        lines.append(f"# TYPE {name} counter")
+            b.counter(f"nomad_admission_{k}_total", stats[k])
         for reason, n in sorted(stats.get("by_reason", {}).items()):
-            lines.append(f'{name}{{reason="{reason}"}} {n}')
-        return "\n".join(lines) + "\n" if lines else ""
+            b.counter("nomad_admission_rejected_reason_total", n,
+                      labels={"reason": reason})
 
     def _express_stats(self) -> Optional[Dict[str, Any]]:
         """Express-lane totals for the metrics JSON body (None when no
@@ -795,27 +884,74 @@ class HTTPServer:
         express = getattr(server, "express_lane", None)
         return express.summary() if express is not None else None
 
-    def _express_prometheus_text(self) -> str:
-        """Express-lane counters as Prometheus lines: placement/commit/
-        bounce totals plus outstanding-lease and backlog gauges."""
+    def _express_prometheus(self, b: "telemetry.PromText") -> None:
+        """Express-lane counters: placement/commit/bounce totals plus
+        outstanding-lease and backlog gauges."""
         stats = self._express_stats()
         if not stats:
-            return ""
-        lines = []
+            return
         for k in ("placed", "tasks_placed", "committed", "bounces",
                   "conflicts", "reconciled"):
-            name = f"nomad_express_{k}_total"
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {stats[k]}")
-        name = "nomad_express_fallback_total"
-        lines.append(f"# TYPE {name} counter")
+            b.counter(f"nomad_express_{k}_total", stats[k])
         for why, n in sorted(stats.get("fallbacks", {}).items()):
-            lines.append(f'{name}{{reason="{why}"}} {n}')
-        lines.append("# TYPE nomad_express_leases gauge")
-        lines.append(f"nomad_express_leases {stats['leases']}")
-        lines.append("# TYPE nomad_express_backlog gauge")
-        lines.append(f"nomad_express_backlog {stats['backlog']}")
-        return "\n".join(lines) + "\n"
+            b.counter("nomad_express_fallback_total", n,
+                      labels={"reason": why})
+        b.gauge("nomad_express_leases", stats["leases"])
+        b.gauge("nomad_express_backlog", stats["backlog"])
+
+    def _capacity_accountant(self):
+        """The server's capacity accountant, or None (no server / the
+        observatory disabled) — the metrics endpoint must answer on a
+        client-only agent too."""
+        server = getattr(self.agent, "server", None)
+        acct = getattr(server, "capacity_accountant", None)
+        if acct is None or not acct.config.enabled:
+            return None
+        return acct
+
+    def _capacity_summary(self) -> Optional[Dict[str, Any]]:
+        acct = self._capacity_accountant()
+        return acct.summary() if acct is not None else None
+
+    def _capacity_prometheus(self, b: "telemetry.PromText") -> None:
+        """Capacity observatory: per-dim utilization/density gauges,
+        per-lane usage, fragmentation deciles, per-shape stranded %.
+        The accountant's own roll/rebuild counters ride the ordinary
+        sink (nomad.capacity.*); the ``nomad_capacity_*`` families here
+        are the labeled aggregates."""
+        acct = self._capacity_accountant()
+        if acct is None:
+            return
+        snap = acct.snapshot()
+        for state in ("total", "schedulable", "occupied"):
+            b.gauge("nomad_capacity_nodes", snap["nodes"][state],
+                    labels={"state": state})
+        for dim in snap["dims"]:
+            b.gauge("nomad_capacity_total", snap["total"][dim],
+                    labels={"dim": dim})
+            b.gauge("nomad_capacity_used", snap["used"][dim],
+                    labels={"dim": dim})
+            b.gauge("nomad_capacity_free", snap["free"][dim],
+                    labels={"dim": dim})
+            b.gauge("nomad_capacity_utilization",
+                    snap["utilization"][dim], labels={"dim": dim})
+            b.gauge("nomad_capacity_binpack_density",
+                    snap["binpack_density"][dim], labels={"dim": dim})
+            for i, n in enumerate(
+                    snap["fragmentation"]["free_fraction"][dim]):
+                b.gauge("nomad_capacity_frag_nodes", n,
+                        labels={"dim": dim, "decile": i})
+        for lane, row in snap["lanes"].items():
+            b.gauge("nomad_capacity_lane_allocs", row["allocs"],
+                    labels={"lane": lane})
+            for dim, v in row["used"].items():
+                b.gauge("nomad_capacity_lane_used", v,
+                        labels={"lane": lane, "dim": dim})
+        for s in snap["stranded"]:
+            b.gauge("nomad_capacity_stranded_pct", s["stranded_pct"],
+                    labels={"shape": s["shape"]})
+            b.gauge("nomad_capacity_placeable", s["placeable_count"],
+                    labels={"shape": s["shape"]})
 
     def agent_traces(self, req, query) -> Tuple[Any, Optional[int]]:
         """Summaries of the tracer's retained traces, newest first

@@ -28,6 +28,13 @@ everything the observability stack retains at the moment of capture —
 - ``admission``   the admission front door (nomad_tpu/server/admission):
                   decision counters, per-client rate lanes, recent typed
                   rejections, SLO-shed coupling
+- ``capacity``    the capacity observatory (nomad_tpu/capacity.py):
+                  utilization, bin-pack density, per-lane usage,
+                  fragmentation histograms, stranded-capacity % — the
+                  utilization picture a postmortem needs
+- ``solver``      the device-solve efficiency panel (tpu/solver.py):
+                  padding waste, bucket occupancy, compile attribution,
+                  device-time-per-placement
 - ``timelines``   the worst-K slowest submit→placed lifecycle timelines
                   (nomad_tpu.lifecycle) stitched from the retained spans
                   and event ring — where the tail's time went
@@ -60,7 +67,7 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
     "faults", "breaker", "mirror", "plan_pipeline", "slo", "admission",
-    "express", "timelines", "nomadlint", "threads",
+    "express", "capacity", "solver", "timelines", "nomadlint", "threads",
 )
 
 # Every `python -m tools.nomadlint` run writes its full report here; the
@@ -209,6 +216,28 @@ def _express_section(agent) -> Optional[Dict[str, Any]]:
     return express.snapshot() if express is not None else None
 
 
+def _capacity_section(agent) -> Optional[Dict[str, Any]]:
+    """Capacity observatory snapshot (nomad_tpu/capacity.py): a
+    postmortem bundle must carry the utilization picture — whether the
+    cell was full, fragmented, or stranding capacity when things went
+    sideways. None without a server or with the observatory disabled."""
+    server = getattr(agent, "server", None) if agent is not None else None
+    acct = getattr(server, "capacity_accountant", None)
+    if acct is None or not acct.config.enabled:
+        return None
+    acct.refresh()
+    return acct.snapshot()
+
+
+def _solver_section() -> Dict[str, Any]:
+    """Device-solve efficiency panel (tpu/solver.py SOLVER_PANEL):
+    padding economy, bucket occupancy, compile attribution — next to the
+    mirror's delta-roll wall costs already in the ``mirror`` section."""
+    from nomad_tpu.tpu.solver import SOLVER_PANEL
+
+    return SOLVER_PANEL.snapshot()
+
+
 # Worst-K slowest timelines embedded per bundle: summaries of the tail,
 # not the whole run — a red tier-1 bundle must stay one readable JSON.
 TIMELINE_WORST_K = 8
@@ -265,6 +294,8 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "slo": None,
         "admission": None,
         "express": None,
+        "capacity": None,
+        "solver": None,
         "timelines": [],
         "nomadlint": None,
         "threads": None,
@@ -280,6 +311,8 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("slo", lambda: _slo_section(agent)),
         ("admission", lambda: _admission_section(agent)),
         ("express", lambda: _express_section(agent)),
+        ("capacity", lambda: _capacity_section(agent)),
+        ("solver", _solver_section),
         ("timelines", lambda: _timelines_section(agent, last_events)),
         ("nomadlint", _nomadlint_section),
         ("threads", thread_stacks),

@@ -50,6 +50,11 @@ Express    ExpressPlaced (eval id; ONE deterministic event per express
 Leader     LeaderAcquired, LeaderLost (server node id)
 Breaker    BreakerStateChanged (breaker name)
 Fault      FaultInjected (site)
+Capacity   CapacitySnapshot (fixed key "capacity"; OBSERVER topic — the
+           capacity accountant's periodic utilization/stranded-capacity
+           snapshots, published on a wall-clock cadence and therefore
+           excluded from the canonical determinism digest, see
+           OBSERVER_TOPICS)
 =========  ==============================================================
 
 Blocking consumption reuses the state store's watch registry
@@ -71,6 +76,15 @@ from nomad_tpu.state.store import _Watch, WatchItem
 # Watch-item vocabulary: one "any event" item plus one per topic, so a
 # topic-filtered long-poll only wakes for publishes it could return.
 ITEM_ANY: WatchItem = ("events", "_any_")
+
+# Topics published by read-only OBSERVERS on a wall-clock cadence (the
+# capacity accountant's periodic snapshots) rather than by decision-path
+# transitions. The canonical determinism digest (simcluster
+# canonical_events, tests/test_events.py replay digests) excludes them by
+# construction: how many ticks a run's wall time fits is scheduling
+# noise, and an observer being ON vs OFF must be digest-invariant — the
+# observatory's decision-invariance proof depends on exactly that.
+OBSERVER_TOPICS = frozenset({"Capacity"})
 
 
 def item_topic(topic: str) -> WatchItem:

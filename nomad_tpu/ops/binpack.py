@@ -33,6 +33,14 @@ from nomad_tpu import trace
 from nomad_tpu.ops.fit import NEG_INF, score_fit
 
 
+# Counts at or below this route through the exact greedy scan (padded to
+# a power-of-two count bucket); larger counts take the count-independent
+# water-fill. THE one threshold — solve_many_async defaults to it and
+# the solver panel's kind/count-bucket attribution reads it, so the two
+# can never drift.
+EXACT_THRESHOLD = 128
+
+
 def bucket(n: int, floor: int = 8) -> int:
     """Next power-of-two bucket for padding jit shapes."""
     b = floor
@@ -313,7 +321,7 @@ def solve_many_async(
     total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
     eligible, ask, bw_ask, count: int, penalty: float,
     job_distinct: bool = False, tg_distinct: bool = False,
-    exact_threshold: int = 128,
+    exact_threshold: int = EXACT_THRESHOLD,
 ):
     """Dispatch the solve for ``count`` copies of one ask; return a fetch()
     closure that blocks on the device and yields (node_indices, ok).
@@ -417,7 +425,7 @@ def solve_many(
     total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
     eligible, ask, bw_ask, count: int, penalty: float,
     job_distinct: bool = False, tg_distinct: bool = False,
-    exact_threshold: int = 128,
+    exact_threshold: int = EXACT_THRESHOLD,
 ):
     """Synchronous wrapper over solve_many_async."""
     fetch = solve_many_async(

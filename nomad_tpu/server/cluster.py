@@ -181,6 +181,7 @@ class ClusterServer(Server):
         if self.slo_monitor is not None:
             self.slo_monitor.start()
         self.express_lane.start()
+        self.capacity_accountant.start()
         from nomad_tpu.server.worker import Worker
 
         for i in range(self.config.scheduler_workers):

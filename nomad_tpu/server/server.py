@@ -134,6 +134,12 @@ class ServerConfig:
     # reservations. None = lane OFF (decision-invariant: the banked
     # steady-10k digests pin that default).
     express: Optional[Dict] = None
+    # Capacity observatory spec (CapacityConfig.parse mapping,
+    # nomad_tpu/capacity.py): the read-only accountant behind
+    # /v1/agent/capacity — fragmentation, per-lane usage, stranded-
+    # capacity %. None = defaults (enabled; decision-invariant by
+    # construction, pinned by the churn-fragmentation contrast arm).
+    capacity: Optional[Dict] = None
 
     def __post_init__(self) -> None:
         if self.num_schedulers is not None:
@@ -171,6 +177,9 @@ class ServerConfig:
         from nomad_tpu.server.express import ExpressConfig
 
         self.express_config = ExpressConfig.parse(self.express)
+        from nomad_tpu.capacity import CapacityConfig
+
+        self.capacity_config = CapacityConfig.parse(self.capacity)
 
     def scheduler_factory(self, eval_type: str) -> str:
         if self.scheduler_backend == "tpu" and eval_type in (
@@ -264,6 +273,20 @@ class Server:
         self.express_lane = ExpressLane(self, self.config.express_config)
         if self.config.express_config.enabled:
             self.plan_applier.ledger = self.express_lane.ledger
+        # The capacity observatory (nomad_tpu/capacity.py): a read-only
+        # consumer of the state store's change logs, composed HERE and
+        # only here — decision-path modules are statically barred from
+        # importing it (nomadlint OBS001). The store getter re-reads
+        # fsm.state per poll so a raft snapshot install (which rebinds
+        # the store) rolls into a counted full rebuild, never a stale
+        # view.
+        from nomad_tpu.capacity import CapacityAccountant
+
+        self.capacity_accountant = CapacityAccountant(
+            lambda: self.fsm.state,
+            self.config.capacity_config,
+            events=self.fsm.events,
+        )
         self._periodic_stop = threading.Event()
         self._started = False
 
@@ -289,6 +312,7 @@ class Server:
         if self.slo_monitor is not None:
             self.slo_monitor.start()
         self.express_lane.start()
+        self.capacity_accountant.start()
         self.restore_eval_broker()
         for i in range(self.config.scheduler_workers):
             worker = Worker(self, i)
@@ -359,6 +383,7 @@ class Server:
         for worker in self.workers:
             worker.stop()
         self.express_lane.stop()
+        self.capacity_accountant.stop()
         if self.slo_monitor is not None:
             self.slo_monitor.stop()
         self.plan_applier.stop()
@@ -977,6 +1002,8 @@ class Server:
                     if self.slo_monitor is not None else None),
             "admission": self.admission.summary(),
             "express": self.express_lane.summary(),
+            "capacity": (self.capacity_accountant.summary()
+                         if self.config.capacity_config.enabled else None),
         }
 
     @staticmethod

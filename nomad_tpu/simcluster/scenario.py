@@ -56,6 +56,7 @@ from nomad_tpu.simcluster.workload import (
     Action,
     BatchBurstInjector,
     ExpressStreamInjector,
+    FragmentationChurnInjector,
     NodeChurnInjector,
     NodeRefreshInjector,
     OverdriveInjector,
@@ -94,6 +95,12 @@ class ScenarioSpec:
     # (the overdrive scenarios' admission-OFF arm — same offered load,
     # front door disabled, documenting the unbounded-growth cliff).
     contrast_overrides: Optional[Dict] = None
+    # Whether the contrast arm must reproduce the MAIN arm's canonical
+    # event digest (the observatory-off arm: turning a read-only
+    # observer off must be decision-invariant). The admission-off
+    # contrast legitimately diverges (more work admitted) and leaves
+    # this False.
+    contrast_digest_invariant: bool = False
     description: str = ""
 
 
@@ -280,6 +287,85 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
                         "express p50 submit→placed < 1ms while the "
                         "service lane keeps its 250ms SLO",
         ),
+        "churn-frag-200": ScenarioSpec(
+            name="churn-frag-200", n_nodes=200,
+            injectors=lambda seed: [FragmentationChurnInjector(
+                seed, fill_jobs=6, tasks_per_job=400,
+                dereg_fraction=0.5, probe_jobs=2, probe_tasks=40,
+                fill_over=2.0, dereg_start=3.0, dereg_over=1.5,
+                probe_start=5.0, probe_over=1.0,
+            )],
+            server_overrides={
+                "capacity": {"poll_interval": 0.25,
+                             "events_interval": 2.0},
+                "event_buffer_size": 16384,
+                # Long TTLs: loaded-box beat lag must not expire a live
+                # node mid-run (the overdrive smoke's posture).
+                "max_heartbeats_per_second": 2.0,
+            },
+            contrast_overrides={
+                "capacity": {"enabled": False},
+                "event_buffer_size": 16384,
+                "max_heartbeats_per_second": 2.0,
+            },
+            contrast_digest_invariant=True,
+            quiesce_timeout=120.0, ack_cap=0, warmup_count=100,
+            description="tier-1 observatory smoke: 200 nodes, 6 fill "
+                        "jobs x400 small tasks, half deregistered, a "
+                        "chunky probe wave — capacity/solver "
+                        "trajectories banked, observatory-off contrast "
+                        "arm digest-equal",
+        ),
+        "churn-fragmentation": ScenarioSpec(
+            name="churn-fragmentation", n_nodes=600,
+            injectors=lambda seed: [FragmentationChurnInjector(
+                seed, fill_jobs=18, tasks_per_job=1000,
+                dereg_fraction=0.5, probe_jobs=3, probe_tasks=150,
+                fill_over=6.0, dereg_start=8.0, dereg_over=4.0,
+                probe_start=14.0, probe_over=3.0,
+                # The probe shape fits a fully-filled node's free
+                # 1000-cpu headroom too: whether a probe eval's snapshot
+                # lands before or after a racing stop plan, every probe
+                # places — the digest contract must not depend on that
+                # race. Stranding is measured against the REFERENCE
+                # shapes, not the probe.
+                probe_cpu=800, probe_memory_mb=768,
+            )],
+            server_overrides={
+                # Fresh trajectory samples: the accountant rolls every
+                # 250ms and stamps a Capacity event snapshot every 5s.
+                "capacity": {"poll_interval": 0.25,
+                             "events_interval": 5.0},
+                # The deregistration stop storm publishes one
+                # AllocUpserted per stopped object row; the 20 Hz
+                # watcher must never fall off the ring (truncation
+                # voids the digest contract).
+                "event_buffer_size": 32768,
+                "max_heartbeats_per_second": 2.0,
+            },
+            # The observatory-OFF arm: identical workload, capacity
+            # accountant disabled. Its canonical digest must EQUAL the
+            # main arm's — the proof the observatory reads cluster
+            # state without perturbing one decision (Omega's
+            # shared-state observer posture).
+            contrast_overrides={
+                "capacity": {"enabled": False},
+                "event_buffer_size": 32768,
+                "max_heartbeats_per_second": 2.0,
+            },
+            contrast_digest_invariant=True,
+            quiesce_timeout=300.0, ack_cap=0,
+            description="the fragmentation baseline the defrag arc is "
+                        "judged against: 18 batch jobs x1000 small "
+                        "tasks pack a 600-node cell to ~75% cpu, a "
+                        "seeded half deregisters (density shreds, "
+                        "capacity strands), then 3 chunky service "
+                        "probe jobs land in the wreckage; the "
+                        "capacity observatory banks stranded-% and "
+                        "padding-waste trajectories, and an "
+                        "observatory-off contrast arm proves digest "
+                        "equality (decision invariance)",
+        ),
         "churn": ScenarioSpec(
             name="churn", n_nodes=2000,
             injectors=lambda seed: [
@@ -314,10 +400,21 @@ def canonical_events(events) -> Dict:
     type sequence in publish order, and digest the sorted multiset of
     those sequences. Which uuid an eval got and how two workers' groups
     interleaved globally is scheduling noise; what happened to each
-    entity, in order, is the replay contract."""
+    entity, in order, is the replay contract.
+
+    OBSERVER topics (events.OBSERVER_TOPICS — the capacity accountant's
+    periodic snapshots) are excluded BY CONSTRUCTION: they publish on a
+    wall-clock cadence, so how many land in a run is box-speed noise,
+    and an observer being on vs off must be digest-invariant — that
+    exclusion is what lets the churn-fragmentation contrast arm prove
+    the observatory decision-invariant."""
+    from nomad_tpu.events import OBSERVER_TOPICS
+
     groups: Dict[str, List[str]] = {}
     by_type: Dict[str, int] = {}
     for e in events:
+        if e.topic in OBSERVER_TOPICS:
+            continue
         groups.setdefault(e.key, []).append(e.type)
         by_type[e.type] = by_type.get(e.type, 0) + 1
     multiset = sorted(tuple(v) for v in groups.values())
@@ -380,6 +477,13 @@ class ScenarioRunner:
         self._offer_lock = threading.Lock()
         self._offered = 0
         self._rejected: Dict[str, int] = {}
+        # Capacity-observatory + solver-panel trajectories (the
+        # churn-fragmentation artifact's banked time series): sampled at
+        # 2 Hz by the depth sampler when the observatory is on.
+        self._capacity_samples: List[Dict] = []
+        self._panel_samples: List[Dict] = []
+        self._t_measure0 = 0.0
+        self._panel0: Optional[Dict] = None
 
     # -- observation --------------------------------------------------------
 
@@ -400,7 +504,55 @@ class ScenarioRunner:
             self._events.extend(evs)
 
     def _sample_depths(self, srv) -> None:
+        from nomad_tpu.tpu.solver import SOLVER_PANEL
+
+        capacity_on = srv.config.capacity_config.enabled
+        tick = 0
         while not self._stop.wait(0.1):
+            tick += 1
+            if tick % 5 == 0:
+                # 2 Hz observatory trajectory: roll the accountant to
+                # the store's current generation (incremental — the
+                # same change-log consumption its own poll does) and
+                # sample the headline aggregates; the solver panel's
+                # raw padded-axis sums ride alongside so the artifact
+                # can difference them into in-window waste series.
+                # Guarded: a transient observatory error must not kill
+                # the thread that also tracks broker/plan-queue peaks.
+                try:
+                    now = time.perf_counter()
+                    if capacity_on:
+                        acct = srv.capacity_accountant
+                        acct.refresh()
+                        snap = acct.snapshot()
+                        self._capacity_samples.append({
+                            "t": now,
+                            "utilization": snap["utilization"],
+                            "density": snap["binpack_density"],
+                            "stranded": {
+                                s["shape"]: s["stranded_pct"]
+                                for s in snap["stranded"]
+                            },
+                            "placeable": {
+                                s["shape"]: s["placeable_count"]
+                                for s in snap["stranded"]
+                            },
+                            "occupied": snap["nodes"]["occupied"],
+                        })
+                    p = SOLVER_PANEL.snapshot()
+                    self._panel_samples.append({
+                        "t": now,
+                        "solves": p["solves"],
+                        "placed": p["placed"],
+                        "device_ms": p["device_ms"],
+                        "live_rows": p["live_rows"],
+                        "padded_rows": p["padded_rows"],
+                        "count_live": p["count_live"],
+                        "count_padded": p["count_padded"],
+                    })
+                except Exception:
+                    self.logger.exception(
+                        "simcluster: observatory sample failed")
             stats = srv.eval_broker.snapshot_stats()
             self.peaks["broker_ready"] = max(
                 self.peaks["broker_ready"], stats.total_ready)
@@ -476,6 +628,21 @@ class ScenarioRunner:
         self._jobs[payload["job_key"]] = job
         out = fleet._pool().call(
             self._srv.rpc_addr, "Job.Register", {"job": to_dict(job)},
+            timeout=fleet.rpc_timeout,
+        )
+        return out["eval_id"]
+
+    def _deregister_job(self, fleet: SimFleet,
+                        payload: Dict) -> Optional[str]:
+        """One Job.Deregister through the real RPC front door: the
+        teardown eval stops every alloc of the job — the churn that
+        shreds bin-pack density. Returns the eval id (None for an
+        unknown job key)."""
+        job = self._jobs.get(payload["job_key"])
+        if job is None:
+            return None
+        out = fleet._pool().call(
+            self._srv.rpc_addr, "Job.Deregister", {"job_id": job.id},
             timeout=fleet.rpc_timeout,
         )
         return out["eval_id"]
@@ -652,6 +819,13 @@ class ScenarioRunner:
             dispatches0 = GLOBAL_SOLVER.dispatches
             mirror0 = GLOBAL_MIRROR_CACHE.stats()
             pipe0 = srv.plan_pipeline.stats()
+            from nomad_tpu.tpu.solver import SOLVER_PANEL
+
+            self._t_measure0 = t_measure0
+            # The panel is process-global (warmup + earlier runs in this
+            # process accumulate): window accounting differences against
+            # this baseline.
+            self._panel0 = SOLVER_PANEL.snapshot()
             watcher = threading.Thread(
                 target=self._watch_events, args=(broker, cursor),
                 daemon=True, name="sim-events")
@@ -720,6 +894,10 @@ class ScenarioRunner:
                         expected_evals.append(ev_id)
                 elif action.kind == "update_job":
                     ev_id = self._update_job(fleet, action.payload)
+                    if ev_id:
+                        expected_evals.append(ev_id)
+                elif action.kind == "deregister_job":
+                    ev_id = self._deregister_job(fleet, action.payload)
                     if ev_id:
                         expected_evals.append(ev_id)
                 elif action.kind == "refresh_nodes":
@@ -1037,6 +1215,8 @@ class ScenarioRunner:
                 "lane": srv.express_lane.snapshot(),
                 "placed_events": len(express_ms),
             }
+        artifact["capacity"] = self._capacity_section(srv)
+        artifact["solver_panel"] = self._solver_panel_section()
         if self.attribution_layer:
             from nomad_tpu import lifecycle, slo
 
@@ -1066,6 +1246,83 @@ class ScenarioRunner:
         if self.spec.faults_spec is not None:
             artifact["faults"] = faults.get_registry().snapshot()
         return artifact
+
+    def _capacity_section(self, srv) -> Dict:
+        """The observatory's banked trajectory: stranded-% / density /
+        utilization over the measured window plus the final snapshot —
+        the fragmentation 'before' baseline the defrag arc will be
+        judged against. {"enabled": False} in the observatory-off
+        contrast arm (presence keeps the artifact schema stable across
+        arms)."""
+        if not srv.config.capacity_config.enabled:
+            return {"enabled": False}
+        acct = srv.capacity_accountant
+        acct.refresh()
+        trajectory = [
+            {**{k: v for k, v in s.items() if k != "t"},
+             "t_s": round(s["t"] - self._t_measure0, 2)}
+            for s in self._capacity_samples
+        ]
+        return {
+            "enabled": True,
+            "sample_hz": 2,
+            "trajectory": trajectory,
+            "final": acct.snapshot(),
+        }
+
+    def _solver_panel_section(self) -> Dict:
+        """Device-solve efficiency over the measured window: deltas
+        against the window-start baseline (the panel is process-global)
+        plus the padding-waste trajectory derived from the sampled raw
+        padded-axis sums."""
+        from nomad_tpu.tpu.solver import SOLVER_PANEL
+
+        p0 = self._panel0 or {}
+        p1 = SOLVER_PANEL.snapshot()
+
+        def delta(key):
+            return p1.get(key, 0) - p0.get(key, 0)
+
+        trajectory = []
+        for s in self._panel_samples:
+            live = s["live_rows"] - p0.get("live_rows", 0)
+            padded = s["padded_rows"] - p0.get("padded_rows", 0)
+            clive = s["count_live"] - p0.get("count_live", 0)
+            cpadded = s["count_padded"] - p0.get("count_padded", 0)
+            trajectory.append({
+                "t_s": round(s["t"] - self._t_measure0, 2),
+                "solves": s["solves"] - p0.get("solves", 0),
+                "node_padding_waste": round(
+                    1.0 - live / padded, 4) if padded else 0.0,
+                "count_padding_waste": round(
+                    1.0 - clive / cpadded, 4) if cpadded else 0.0,
+            })
+        placed = delta("placed")
+        device_ms = round(delta("device_ms"), 3)
+        padded = delta("padded_rows")
+        live = delta("live_rows")
+        cpadded = delta("count_padded")
+        clive = delta("count_live")
+        return {
+            "window": {
+                "solves": delta("solves"),
+                "requested": delta("requested"),
+                "placed": placed,
+                "device_ms": device_ms,
+                "device_ms_per_placement": round(
+                    device_ms / placed, 4) if placed else 0.0,
+                "node_padding_waste": round(
+                    1.0 - live / padded, 4) if padded else 0.0,
+                "count_padding_waste": round(
+                    1.0 - clive / cpadded, 4) if cpadded else 0.0,
+            },
+            "trajectory": trajectory,
+            # Process-lifetime views (include pre-window warmup — the
+            # compile attribution's precompile records live here).
+            "node_buckets": p1["node_buckets"],
+            "count_buckets": p1["count_buckets"],
+            "compiles": p1["compiles"],
+        }
 
 
 def _equilibrium_rate(srv, fleet) -> float:
@@ -1136,6 +1393,17 @@ def run_scenario(name: str, seed: int = 42, out_path: Optional[str] = None,
             "events": {"observed": full["events"]["observed"],
                        "truncated": full["events"]["truncated"]},
         }
+        if spec.contrast_digest_invariant:
+            # The observatory-off arm's decision-invariance verdict: an
+            # observer being on vs off must leave every per-entity
+            # lifecycle identical. This is the artifact's headline
+            # proof, not a side note.
+            artifact["contrast"]["events"]["digest"] = \
+                full["events"]["digest"]
+            artifact["contrast"]["digest_matches"] = (
+                full["events"]["digest"] == artifact["events"]["digest"]
+            )
+            artifact["contrast"]["capacity"] = full.get("capacity")
     if out_path:
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=2, sort_keys=True)

@@ -20,6 +20,9 @@ them against the server at their offsets. Kinds:
 ``update_job``     payload: job key + mutation ("inplace" bumps cpu by 1
                    — tasks_updated() false, the in-place path;
                    "destructive" changes task env — evict+place).
+``deregister_job`` payload: job key — a full Job.Deregister through the
+                   RPC front door; the teardown eval stops every alloc
+                   (the churn that shreds bin-pack density).
 ``fail_nodes``     payload: how many nodes to silence; the runner picks
                    the tranche (preferring alloc-hosting nodes so the
                    migration path is actually driven).
@@ -346,6 +349,97 @@ class ExpressStreamInjector(Injector):
         return lambda: build_job(jid, structs.JOB_TYPE_BATCH, count,
                                  cpu=cpu, memory_mb=mem, priority=prio,
                                  express=True)
+
+
+class FragmentationChurnInjector(Injector):
+    """Fill → shred → probe: the arrival process that strands capacity.
+
+    Phase 1 (fill): ``fill_jobs`` small-task batch jobs land over
+    ``fill_over`` seconds and pack the cell tight (the columnar path —
+    high bin-pack density by construction).
+
+    Phase 2 (shred): a SEEDED subset (``dereg_fraction``) of the fill
+    jobs deregisters over ``dereg_over`` seconds. Every stop leaves its
+    node's remnant free capacity behind — aggregate free grows, but it
+    is scattered across partially-occupied nodes: bin-pack density
+    drops and capacity strands against the larger reference shapes.
+
+    Phase 3 (probe): ``probe_jobs`` service jobs with a CHUNKY task
+    shape (``probe_cpu``/``probe_memory_mb``, sized so only
+    well-drained nodes fit one) arrive into the shredded cell — the
+    workload whose placement quality the future defragmenter is
+    supposed to rescue. The capacity observatory's stranded-% and the
+    solver panel's padding-waste trajectories across these phases ARE
+    the banked artifact this scenario exists to produce.
+
+    Fully seed-determined: job ids, shapes, the deregistration subset
+    and all pacing derive from the injector's name-salted stream, so
+    the canonical event digest replays."""
+
+    name = "fragmentation-churn"
+
+    def __init__(self, seed: int, fill_jobs: int, tasks_per_job: int,
+                 dereg_fraction: float = 0.5,
+                 probe_jobs: int = 3, probe_tasks: int = 150,
+                 fill_over: float = 6.0, dereg_start: float = 8.0,
+                 dereg_over: float = 4.0, probe_start: float = 14.0,
+                 probe_over: float = 3.0,
+                 fill_cpu: int = 100, fill_memory_mb: int = 128,
+                 probe_cpu: int = 1500, probe_memory_mb: int = 1024):
+        super().__init__(seed)
+        self.fill_jobs = fill_jobs
+        self.tasks_per_job = tasks_per_job
+        self.dereg_fraction = dereg_fraction
+        self.probe_jobs = probe_jobs
+        self.probe_tasks = probe_tasks
+        self.fill_over = fill_over
+        self.dereg_start = dereg_start
+        self.dereg_over = dereg_over
+        self.probe_start = probe_start
+        self.probe_over = probe_over
+        self.fill_cpu = fill_cpu
+        self.fill_memory_mb = fill_memory_mb
+        self.probe_cpu = probe_cpu
+        self.probe_memory_mb = probe_memory_mb
+
+    def actions(self) -> List[Action]:
+        out = []
+        gap = self.fill_over / max(self.fill_jobs, 1)
+        for k in range(self.fill_jobs):
+            jid = f"sim-frag-fill-{k:03d}"
+            out.append(Action(
+                at=k * gap, kind="register_job",
+                payload={"job_key": jid,
+                         "build": self._builder(
+                             jid, structs.JOB_TYPE_BATCH,
+                             self.tasks_per_job, self.fill_cpu,
+                             self.fill_memory_mb)},
+            ))
+        n_dereg = int(round(self.fill_jobs * self.dereg_fraction))
+        victims = self.rng.sample(range(self.fill_jobs), n_dereg)
+        dgap = self.dereg_over / max(n_dereg, 1)
+        for i, k in enumerate(victims):
+            out.append(Action(
+                at=self.dereg_start + i * dgap, kind="deregister_job",
+                payload={"job_key": f"sim-frag-fill-{k:03d}"},
+            ))
+        pgap = self.probe_over / max(self.probe_jobs, 1)
+        for k in range(self.probe_jobs):
+            jid = f"sim-frag-probe-{k:03d}"
+            out.append(Action(
+                at=self.probe_start + k * pgap, kind="register_job",
+                payload={"job_key": jid,
+                         "build": self._builder(
+                             jid, structs.JOB_TYPE_SERVICE,
+                             self.probe_tasks, self.probe_cpu,
+                             self.probe_memory_mb)},
+            ))
+        return out
+
+    @staticmethod
+    def _builder(jid: str, jtype: str, count: int, cpu: int,
+                 mem: int) -> Callable[[], Job]:
+        return lambda: build_job(jid, jtype, count, cpu=cpu, memory_mb=mem)
 
 
 class NodeChurnInjector(Injector):

@@ -454,10 +454,12 @@ def measure_since(key: Key, start: float) -> None:
     get_global().measure_since(key, start)
 
 
-def _prom_name(key: str) -> str:
-    """Sanitize a flattened metric key to the Prometheus data model
+def _sanitize(key: str) -> str:
+    """THE one sanitizer for Prometheus metric and label NAMES
     ([a-zA-Z_:][a-zA-Z0-9_:]*): every run of invalid characters maps to a
-    single underscore."""
+    single underscore. Every name the agent exposes — the sink-derived
+    series below and every subsystem appender riding :class:`PromText` —
+    passes through here, so the data-model rules live in one place."""
     out = []
     prev_us = False
     for ch in key:
@@ -472,6 +474,78 @@ def _prom_name(key: str) -> str:
     if name and name[0].isdigit():
         name = "_" + name
     return name or "_"
+
+
+# Back-compat spelling used by the sink exposition below.
+_prom_name = _sanitize
+
+
+def _escape_label_value(value) -> str:
+    """Label VALUES may be any UTF-8, but backslash, double-quote and
+    newline must be escaped per the text-format grammar."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class PromText:
+    """Shared Prometheus text-exposition line builder.
+
+    One instance assembles one scrape: every subsystem appender (mirror,
+    plan pipeline, tracer, admission, express, capacity, solver) emits
+    through the same builder, so
+
+    - every metric/label name passes :func:`_sanitize` in one place,
+    - the ``# TYPE`` line for a family is emitted exactly once, BEFORE
+      its first sample, across all appenders (the exposition-format
+      invariant a hand-rolled per-appender emitter cannot enforce), and
+    - two appenders registering one family under conflicting types fail
+      loudly (ValueError) instead of serving a scrape Prometheus
+      rejects.
+
+    Values format shortest-exact (.17g), the sink exposition's rule: %g
+    quantizes counters past ~1e6 into phantom rate() resets.
+    """
+
+    __slots__ = ("_lines", "_types")
+
+    def __init__(self):
+        self._lines: List[str] = []
+        self._types: Dict[str, str] = {}
+
+    @staticmethod
+    def _fmt(value) -> str:
+        return format(float(value), ".17g")
+
+    def _sample(self, name: str, mtype: str, value,
+                labels: Optional[Dict[str, object]] = None) -> None:
+        name = _sanitize(name)
+        seen = self._types.get(name)
+        if seen is None:
+            self._types[name] = mtype
+            self._lines.append(f"# TYPE {name} {mtype}")
+        elif seen != mtype:
+            raise ValueError(
+                f"metric family {name!r} registered as {seen} and {mtype}"
+            )
+        if labels:
+            body = ",".join(
+                f'{_sanitize(str(k))}="{_escape_label_value(v)}"'
+                for k, v in labels.items()
+            )
+            self._lines.append(f"{name}{{{body}}} {self._fmt(value)}")
+        else:
+            self._lines.append(f"{name} {self._fmt(value)}")
+
+    def counter(self, name: str, value,
+                labels: Optional[Dict[str, object]] = None) -> None:
+        self._sample(name, "counter", value, labels)
+
+    def gauge(self, name: str, value,
+              labels: Optional[Dict[str, object]] = None) -> None:
+        self._sample(name, "gauge", value, labels)
+
+    def text(self) -> str:
+        return "\n".join(self._lines) + "\n" if self._lines else ""
 
 
 def prometheus_text(inmem: InmemSink) -> str:

@@ -15,6 +15,7 @@ caches stay warm across varying cluster sizes.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 import jax
@@ -1119,6 +1120,12 @@ class MirrorCache:
         self.delta_rolls = 0
         self.full_rebuilds = 0
         self.rows_restaged = 0
+        # Wall-time economy of the two miss paths (the solver panel's
+        # delta-roll-vs-full-rebuild story needs the COST next to the
+        # counts: a roll that were as expensive as a rebuild would make
+        # the whole delta machinery pointless).
+        self.roll_ms = 0.0
+        self.rebuild_ms = 0.0
 
     def get(self, state, datacenters: List[str]):
         """Return (nodes, mirror) for the ready nodes of ``state`` in
@@ -1143,12 +1150,15 @@ class MirrorCache:
             entry = self._roll_forward(key, ancestor, state, datacenters)
             if entry is not None:
                 return entry
+        t0 = time.perf_counter()
         nodes = ready_nodes_in_dcs(state, datacenters)
         mirror = NodeMirror(nodes)
+        build_ms = (time.perf_counter() - t0) * 1000.0
         if uid:
             with self._lock:
                 self.misses += 1
                 self.full_rebuilds += 1
+                self.rebuild_ms += build_ms
                 self._entries[key] = (nodes, mirror)
                 while len(self._entries) > self.capacity:
                     self._entries.popitem(last=False)
@@ -1184,7 +1194,9 @@ class MirrorCache:
         changes = changes_fn(best[1])
         if changes is None:
             return None  # log horizon exceeded
+        t0 = time.perf_counter()
         out = mirror.apply_delta(changes, state, datacenters)
+        roll_ms = (time.perf_counter() - t0) * 1000.0
         if out is None:
             return None  # membership forces repadding/reordering
         new_mirror, restaged = out
@@ -1206,6 +1218,7 @@ class MirrorCache:
             self.misses += 1
             self.delta_rolls += 1
             self.rows_restaged += restaged
+            self.roll_ms += roll_ms
             self._entries[key] = entry
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
@@ -1226,6 +1239,8 @@ class MirrorCache:
                 "delta_rolls": self.delta_rolls,
                 "full_rebuilds": self.full_rebuilds,
                 "rows_restaged": self.rows_restaged,
+                "roll_ms": round(self.roll_ms, 3),
+                "rebuild_ms": round(self.rebuild_ms, 3),
                 "node_buckets": sorted({
                     m.padded for _n, m in self._entries.values()
                 }),

@@ -19,6 +19,7 @@ from tools.nomadlint import (
     determinism,
     excepts,
     lockorder,
+    observatory,
     run_passes,
     tracehygiene,
 )
@@ -402,6 +403,78 @@ def test_lock_watchdog_install_wraps_only_known_sites(tmp_path):
         pass
     assert threading.Lock is not None  # uninstalled cleanly
     assert wd.violations == []
+
+
+# -- observatory pass --------------------------------------------------------
+
+
+def test_observatory_flags_decision_path_imports(tmp_path):
+    """OBS001: any import form of nomad_tpu.capacity inside the
+    decision scope is a finding — module-level, function-local,
+    from-import, and the `from nomad_tpu import capacity` spelling."""
+    project = _project(tmp_path, {
+        "nomad_tpu/scheduler/bad.py": """\
+            import nomad_tpu.capacity
+        """,
+        "nomad_tpu/tpu/bad2.py": """\
+            def solve():
+                from nomad_tpu.capacity import CapacityAccountant
+                return CapacityAccountant
+        """,
+        "nomad_tpu/server/worker_bad.py": """\
+            from nomad_tpu import capacity
+        """,
+        "nomad_tpu/state/clean.py": """\
+            import nomad_tpu.telemetry
+        """,
+    })
+    findings = observatory.run(project)
+    assert _rules(findings) == ["OBS001", "OBS001", "OBS001"]
+    files = sorted(f.file for f in findings)
+    assert files == ["nomad_tpu/scheduler/bad.py",
+                     "nomad_tpu/server/worker_bad.py",
+                     "nomad_tpu/tpu/bad2.py"]
+
+
+def test_observatory_composition_root_exempt(tmp_path):
+    """server/server.py is THE composition root: it constructs and
+    starts the accountant with the other observers. Exempt by path."""
+    project = _project(tmp_path, {
+        "nomad_tpu/server/server.py": """\
+            from nomad_tpu.capacity import CapacityAccountant
+        """,
+    })
+    assert observatory.run(project) == []
+
+
+def test_observatory_allow_escape_hatch(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/scheduler/waived.py": """\
+            # nomadlint: allow(OBS001) -- test fixture exercising the waiver
+            import nomad_tpu.capacity
+        """,
+    })
+    assert observatory.run(project) == []
+
+
+def test_observatory_outside_scope_ignored(tmp_path):
+    """api/ and bundle.py are exposition, not decisions: reading the
+    observatory there is the point."""
+    project = _project(tmp_path, {
+        "nomad_tpu/api/http2.py": """\
+            import nomad_tpu.capacity
+        """,
+        "nomad_tpu/bundle2.py": """\
+            from nomad_tpu.capacity import CapacityAccountant
+        """,
+    })
+    assert observatory.run(project) == []
+
+
+def test_observatory_real_tree_is_clean():
+    """The actual tree honors the contract (the tier-1 gate's view)."""
+    project = Project()
+    assert observatory.run(project) == []
 
 
 # -- tier-1 drift gates: the committed artifacts match a fresh run -----------

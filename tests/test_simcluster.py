@@ -296,6 +296,65 @@ def test_express_1k_smoke(tmp_path):
     assert art["events"]["truncated"] is False
 
 
+def test_churn_frag_200_smoke(tmp_path):
+    """The capacity observatory at smoke scale, contrast arm included:
+    6 fill jobs x400 small tasks pack 200 nodes, half deregister (the
+    density shred), two chunky probe jobs land after. The artifact must
+    bank the stranded/padding trajectories, and the observatory-OFF
+    contrast arm must reproduce the main arm's canonical digest — the
+    decision-invariance proof."""
+    out = tmp_path / "SIMLOAD_churn-frag-200_smoke.json"
+    art = run_scenario("churn-frag-200", seed=42, out_path=str(out))
+    # 6x400 fill + 2x40 probes placed; 3 deregistered jobs stop 1200.
+    assert art["placements"]["placed"] == 6 * 400 + 2 * 40
+    assert art["placements"]["stopped"] == 3 * 400
+    assert art["events"]["by_type"]["JobDeregistered"] == 3
+    assert art["events"]["truncated"] is False
+
+    cap = art["capacity"]
+    assert cap["enabled"] is True
+    assert len(cap["trajectory"]) >= 3
+    final = cap["final"]
+    assert final["nodes"]["schedulable"] == 200
+    # The shred left remnants: work still occupies nodes, density is a
+    # real fraction, and the accountant rode the change logs (rolls
+    # dominate — at most the one initial rebuild).
+    assert final["nodes"]["occupied"] > 0
+    assert 0 < final["binpack_density"]["cpu"] <= 1
+    assert final["accountant"]["rebuilds"] <= 1
+    assert final["accountant"]["rolls"] >= 1
+    shapes = {s["shape"] for s in final["stranded"]}
+    assert shapes == {"small", "medium", "large"}
+    # Mid-fill the cell strands hard against the large shape; the
+    # trajectory must have caught utilization actually moving.
+    utils = [s["utilization"]["cpu"] for s in cap["trajectory"]]
+    assert max(utils) > min(utils)
+
+    panel = art["solver_panel"]
+    assert panel["window"]["solves"] >= 8  # 6 fill + 2 probe solves min
+    assert panel["window"]["placed"] >= 6 * 400 + 2 * 40
+    assert 0 <= panel["window"]["node_padding_waste"] < 1
+    assert panel["window"]["device_ms_per_placement"] > 0
+    assert panel["compiles"]["total"] >= 1
+    assert len(panel["trajectory"]) >= 3
+
+    # The headline: turning the observatory OFF changes nothing the
+    # cluster DID.
+    contrast = art["contrast"]
+    assert contrast["capacity"] == {"enabled": False}
+    assert contrast["digest_matches"] is True
+    assert contrast["placements"]["placed"] == 6 * 400 + 2 * 40
+
+
+def test_churn_frag_smoke_is_seed_deterministic():
+    """Same seed, same canonical digest — deregistration churn and the
+    probe wave racing stop plans included."""
+    a = run_scenario("churn-frag-200", seed=11, contrast=False)
+    b = run_scenario("churn-frag-200", seed=11, contrast=False)
+    assert a["events"]["digest"] == b["events"]["digest"]
+    assert a["events"]["by_type"] == b["events"]["by_type"]
+
+
 def test_express_smoke_is_seed_deterministic():
     """Express placements ride seeded streams (express.pick /
     express.lease_jitter) and publish ONE deterministic event per

@@ -4,8 +4,10 @@
 The CLI face of the flight recorder (the ``nomad operator debug`` analog):
 hits ``/v1/agent/debug/bundle`` on a running agent and writes the single
 JSON artifact — metrics snapshot + cumulative series, recent traces,
-last-K events, redacted config, armed fault plan, breaker state, and
-thread stacks — that you attach when a bench or chaos run goes sideways.
+last-K events, redacted config, armed fault plan, breaker state,
+capacity-observatory and solver-efficiency snapshots (the utilization
+picture: fragmentation, stranded-capacity %, padding waste), and thread
+stacks — that you attach when a bench or chaos run goes sideways.
 
 Usage::
 

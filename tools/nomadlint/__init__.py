@@ -1,6 +1,6 @@
 """nomadlint: project-specific static analysis for the tpu-nomad tree.
 
-Four AST-based passes encode the invariants the control plane's
+Five AST-based passes encode the invariants the control plane's
 correctness story rests on but nothing previously *checked*:
 
 - **determinism** (DET0xx): scheduler / FSM / plan / simcluster decision
@@ -22,6 +22,10 @@ correctness story rests on but nothing previously *checked*:
   flow on traced values, unstable ``static_argnums``, and jitted
   functions closing over mutable module state — the retrace hazards
   ``ops/fit.py``'s jit_trace counters were added to catch at runtime.
+- **observatory** (OBS0xx): the capacity observatory
+  (``nomad_tpu/capacity.py``) is a read-only observer — scheduler /
+  solver / state / raft / server decision paths must not import it;
+  only the server composition root may construct it.
 
 Findings are suppressed inline with ``# nomadlint: allow(RULE) -- reason``
 (the reason is mandatory: an unexplained suppression is itself a finding,
@@ -36,14 +40,21 @@ from tools.nomadlint.project import Project  # noqa: F401
 
 
 def run_passes(project: "Project"):
-    """Run all four passes over ``project`` and return the findings,
+    """Run all passes over ``project`` and return the findings,
     sorted for stable output/baseline comparison."""
-    from tools.nomadlint import determinism, excepts, lockorder, tracehygiene
+    from tools.nomadlint import (
+        determinism,
+        excepts,
+        lockorder,
+        observatory,
+        tracehygiene,
+    )
 
     findings = []
     findings.extend(determinism.run(project))
     findings.extend(lockorder.run(project))
     findings.extend(excepts.run(project))
     findings.extend(tracehygiene.run(project))
+    findings.extend(observatory.run(project))
     findings.extend(project.meta_findings())
     return sorted(findings, key=lambda f: (f.file, f.line, f.rule_id))

@@ -1,0 +1,87 @@
+"""Observatory pass (OBS001): the capacity observatory is read-only.
+
+``nomad_tpu/capacity.py`` observes cluster state through the store's
+change logs and must stay invisible to every decision path — the
+decision-invariance proof (the churn-fragmentation scenario's
+observatory-off arm asserting digest equality) only means something if
+no placement, verify, or apply path can even *reach* the observer's
+books. This pass enforces that statically: any ``import`` of
+``nomad_tpu.capacity`` (module-level or function-local, plain or
+from-import) inside the decision scope is a finding.
+
+The composition roots are allowlisted by path: ``server/server.py``
+constructs and starts the accountant (lifecycle wiring only — the
+ServerConfig parse and start/stop calls), and the exposition layer
+(``api/``, ``bundle.py``) reads snapshots. Everything else in
+scheduler/, server/, state/, raft/, tpu/, and ops/ is barred.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.nomadlint.project import Project, qualname_of
+from tools.nomadlint.registry import Finding
+
+# Where decisions are made: the solve path (scheduler/tpu/ops), the
+# apply path (server/state/raft). The broader DET001 decision scope
+# minus the leaf modules that cannot plausibly hold an import of the
+# observatory's caliber (structs/network/events/faults are kept IN —
+# cheap to check, and events.py importing the accountant would be just
+# as much of a layering break).
+OBSERVATORY_SCOPE = (
+    "nomad_tpu/scheduler",
+    "nomad_tpu/server",
+    "nomad_tpu/state",
+    "nomad_tpu/raft",
+    "nomad_tpu/tpu",
+    "nomad_tpu/ops",
+    "nomad_tpu/structs.py",
+    "nomad_tpu/network.py",
+    "nomad_tpu/events.py",
+    "nomad_tpu/faults.py",
+)
+
+# The one legitimate construction site: the server's composition root
+# builds the accountant and starts/stops it with the other observers
+# (slo monitor, express lane). It may not READ the books either — but
+# that is a review concern; the static bar is the import, and the
+# composition root needs exactly that.
+COMPOSITION_ROOTS = ("nomad_tpu/server/server.py",)
+
+TARGET_MODULE = "nomad_tpu.capacity"
+
+
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.scoped(OBSERVATORY_SCOPE):
+        if mod.relpath in COMPOSITION_ROOTS:
+            continue
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            hit = None
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if (alias.name == TARGET_MODULE
+                            or alias.name.startswith(TARGET_MODULE + ".")):
+                        hit = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                if m == TARGET_MODULE or m.startswith(TARGET_MODULE + "."):
+                    hit = m
+                elif m == "nomad_tpu":
+                    for alias in node.names:
+                        if alias.name == "capacity":
+                            hit = f"nomad_tpu.{alias.name}"
+            if hit is not None:
+                findings.append(Finding(
+                    "OBS001", mod.relpath, node.lineno,
+                    qualname_of(node, mod.modname),
+                    f"decision-path module imports {hit} — the capacity "
+                    "observatory must stay invisible to scheduler/apply "
+                    "paths (read-only observer contract)",
+                    snippet=mod.snippet(node.lineno),
+                ))
+        out.extend(project.filter_allowed(mod, findings))
+    return out

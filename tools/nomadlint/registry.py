@@ -12,7 +12,7 @@ from typing import Dict, Optional
 @dataclass(frozen=True)
 class Rule:
     id: str
-    pass_name: str          # determinism | lockorder | excepts | tracehygiene | meta
+    pass_name: str          # determinism | lockorder | excepts | tracehygiene | observatory | meta
     title: str
     description: str
     retired: bool = False
@@ -84,6 +84,17 @@ RULES: Dict[str, Rule] = {
              "mutated elsewhere bakes the traced-time value into the "
              "compiled executable — later mutations are silently "
              "ignored (the ops/fit.py retrace-counter hazard class)."),
+        Rule("OBS001", "observatory",
+             "decision path imports the capacity observatory",
+             "The capacity observatory (nomad_tpu/capacity.py) is a "
+             "READ-ONLY observer of cluster state (Omega's shared-state "
+             "posture): scheduler, solver, state, raft, and server "
+             "decision paths must never import it — a placement that "
+             "consults the observer's books couples decisions to poll "
+             "timing and voids the decision-invariance contract the "
+             "churn-fragmentation digest arm pins. Only the composition "
+             "roots (server/server.py wiring, api/ exposition) may "
+             "construct or read it."),
         Rule("META001", "meta",
              "allow() without a reason",
              "`# nomadlint: allow(RULE)` must carry `-- <reason>`: an "
