@@ -853,6 +853,86 @@ def run_breakdown(scales=BREAKDOWN_SCALES):
     return sweep
 
 
+_NODE_SWEEP_ENV = os.environ.get("NOMAD_TPU_BENCH_NODE_SWEEP", "")
+NODE_SWEEP_SCALES = tuple(
+    int(s) for s in _NODE_SWEEP_ENV.split(",") if s
+) if _NODE_SWEEP_ENV else (1024, 10_000, 100_000)
+
+
+def run_node_sweep(scales=NODE_SWEEP_SCALES, count=420):
+    """Node-axis sweep to 100k: the ROADMAP item 1 proof arm.
+
+    Holds the ask fixed (420 tasks — the steady-10k workload's job
+    shape) and sweeps the NODE axis through 100k, measuring the warm
+    water-fill solve wall per scale. The claim under test: with padded
+    buffers, bucketed compiles, and (when configured) the node axis
+    sharded over a device mesh, a 100k-node cell's warm per-eval solve
+    stays in the same cost class as 10k — the verdict field pins the
+    ratio. Uses the same clean-state staging as run_breakdown; the
+    mirror build cost is reported but NOT in the warm wall (steady state
+    reuses the resident mirror via MirrorCache)."""
+    import jax
+
+    from nomad_tpu.ops.binpack import device_const, solve_waterfill
+    from nomad_tpu.tpu.mirror import NodeMirror
+
+    ask_dev = device_const("ask", (100, 128, 0, 0))
+    penalty_dev = device_const("f32", 0.0)
+    bw_ask_dev = device_const("i32", 0)
+    count_dev = device_const("i32", count)
+    sweep = []
+    for n in scales:
+        nodes_list = _mk_nodes(n, with_net=False)
+        t0 = time.perf_counter()
+        mirror = NodeMirror(nodes_list)
+        usage = mirror.clean_usage()
+        eligible = mirror.device_mask(None, set(), None, None)[0]
+        for arr in (mirror.total, mirror.sched_cap, eligible, *usage):
+            arr.block_until_ready()
+        staging_ms = (time.perf_counter() - t0) * 1000.0
+        used0, job_count0, tg_count0, bw_used0 = usage
+
+        def dispatch():
+            return solve_waterfill(
+                mirror.total, mirror.sched_cap, used0, job_count0,
+                tg_count0, mirror.bw_avail, bw_used0, eligible, ask_dev,
+                bw_ask_dev, count_dev, penalty_dev, False, False,
+            )
+
+        counts, unplaced = dispatch()  # compile for this node bucket
+        counts.block_until_ready()
+        times = []
+        for _ in range(RUNS):
+            t = time.perf_counter()
+            c, u = dispatch()
+            jax.device_get((c, u))
+            times.append(time.perf_counter() - t)
+        counts_host, unplaced_host = jax.device_get((counts, unplaced))
+        placed = count - int(unplaced_host)
+        warm_ms = statistics.median(times) * 1000.0
+        sweep.append({
+            "n_nodes": n,
+            "padded": mirror.padded,
+            "count": count,
+            "placed": placed,
+            "staging_ms": round(staging_ms, 2),
+            "warm_solve_ms_p50": round(warm_ms, 3),
+            "device_ms_per_placement": round(
+                warm_ms / max(placed, 1), 4),
+        })
+        del mirror, usage, eligible, nodes_list, counts, unplaced
+    by_n = {row["n_nodes"]: row for row in sweep}
+    verdict = {}
+    if 10_000 in by_n and 100_000 in by_n:
+        ratio = (by_n[100_000]["warm_solve_ms_p50"]
+                 / max(by_n[10_000]["warm_solve_ms_p50"], 1e-9))
+        verdict = {
+            "warm_100k_over_10k": round(ratio, 3),
+            "same_cost_class_2x": ratio <= 2.0,
+        }
+    return {"sweep": sweep, **verdict}
+
+
 STAGING_DELTA_SCALES = tuple(
     s for s in (1024, 4096, 10_000) if s <= N_NODES
 ) or (N_NODES,)
@@ -1078,6 +1158,7 @@ def main():
                              ("config4", run_config4),
                              ("config5", run_config5),
                              ("staging_delta", run_staging_delta),
+                             ("node_sweep", run_node_sweep),
                              ("simload", run_simload)):
                 try:
                     aux[name] = fn()
@@ -1209,6 +1290,7 @@ def _cpu_fallback_headline():
                          ("config4", run_config4),
                          ("config5", run_config5),
                          ("staging_delta", run_staging_delta),
+                         ("node_sweep", run_node_sweep),
                          ("simload", run_simload)):
             try:
                 aux[name] = fn()

@@ -53,6 +53,9 @@ class AgentConfig:
     # Capacity observatory spec (nomad_tpu/capacity.py): None = defaults
     # (enabled; set {"enabled": False} to turn the accountant off).
     capacity: Optional[Dict] = None
+    # Solver device mesh spec (nomad_tpu/parallel/mesh.py): None =
+    # single-device solves.
+    solver_mesh: Optional[Dict] = None
     enable_debug: bool = False
     statsite_addr: str = ""
     statsd_addr: str = ""
@@ -144,6 +147,8 @@ class AgentConfig:
                      if fc.server.express is not None else None),
             capacity=(dict(fc.server.capacity)
                       if fc.server.capacity is not None else None),
+            solver_mesh=(dict(fc.server.solver_mesh)
+                         if fc.server.solver_mesh is not None else None),
             enable_debug=fc.enable_debug,
             statsite_addr=fc.telemetry.statsite_address,
             statsd_addr=fc.telemetry.statsd_address,
@@ -239,6 +244,8 @@ class Agent:
                      if self.config.express is not None else None),
             capacity=(dict(self.config.capacity)
                       if self.config.capacity is not None else None),
+            solver_mesh=(dict(self.config.solver_mesh)
+                         if self.config.solver_mesh is not None else None),
         )
         if self.config.event_buffer_size:
             server_config.event_buffer_size = self.config.event_buffer_size

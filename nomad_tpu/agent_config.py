@@ -106,6 +106,12 @@ class ServerBlock:
     # /v1/agent/capacity (poll/event cadence, reference shapes for the
     # stranded-capacity yardstick). None = defaults (enabled).
     capacity: Optional[Dict[str, object]] = None
+    # Solver device mesh (nomad_tpu/parallel/mesh.py): the
+    # ``solver_mesh { }`` sub-block shards the node axis of every device
+    # solve over a JAX mesh — ``node_shards`` devices per eval row,
+    # ``eval_parallel`` rows. None = single-device solves (the default;
+    # decision-invariant — sharding only moves where the flops run).
+    solver_mesh: Optional[Dict[str, object]] = None
     enabled_schedulers: List[str] = field(default_factory=list)
     start_join: List[str] = field(default_factory=list)
 
@@ -302,6 +308,13 @@ class FileConfig:
                 else other.server.capacity if self.server.capacity is None
                 else {**self.server.capacity, **other.server.capacity}
             ),
+            # Solver-mesh knobs merge key-by-key like the blocks above.
+            solver_mesh=(
+                self.server.solver_mesh if other.server.solver_mesh is None
+                else other.server.solver_mesh
+                if self.server.solver_mesh is None
+                else {**self.server.solver_mesh, **other.server.solver_mesh}
+            ),
             enabled_schedulers=(
                 other.server.enabled_schedulers or self.server.enabled_schedulers
             ),
@@ -491,6 +504,16 @@ def _from_mapping(data: dict) -> FileConfig:
 
                     CapacityConfig.parse(dict(v))
                     cfg.server.capacity = dict(v)
+                elif k == "solver_mesh":
+                    if not isinstance(v, dict):
+                        raise ValueError(
+                            "server.solver_mesh must be a mapping")
+                    # Same posture: a typo'd mesh knob fails config load
+                    # (SolverMeshConfig.parse), not leader-establish.
+                    from nomad_tpu.parallel.mesh import SolverMeshConfig
+
+                    SolverMeshConfig.parse(dict(v))
+                    cfg.server.solver_mesh = dict(v)
                 elif k in ("bootstrap_expect", "protocol_version"):
                     setattr(cfg.server, k, int(v))
                 else:

@@ -147,6 +147,19 @@ def _solver_prometheus(b: "telemetry.PromText") -> None:
                   labels={"bucket": row["bucket"]})
         b.gauge("nomad_solver_bucket_occupancy", row["occupancy"],
                 labels={"bucket": row["bucket"]})
+    # Cross-eval batching economy: dispatch/eval totals per stack width
+    # and the amortized per-eval device wall at that width.
+    for width, row in stats.get("batch_widths", {}).items():
+        b.counter("nomad_solver_batch_dispatches_total",
+                  row["dispatches"], labels={"width": width})
+        b.counter("nomad_solver_batch_evals_total",
+                  row["evals"], labels={"width": width})
+        b.gauge("nomad_solver_batch_device_ms_per_eval",
+                row["device_ms_per_eval"], labels={"width": width})
+    equiv = stats.get("equiv", {})
+    for k in ("classes", "members", "copies", "rows_saved"):
+        if k in equiv:
+            b.counter(f"nomad_solver_equiv_{k}_total", equiv[k])
     for trigger, n in stats["compiles"]["by_trigger"].items():
         b.counter("nomad_solver_compiles_total", n,
                   labels={"trigger": trigger})

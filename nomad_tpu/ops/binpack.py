@@ -74,13 +74,15 @@ def device_const(kind: str, value):
 def _monotone_u32(score: jnp.ndarray) -> jnp.ndarray:
     """Map float32 -> uint32 preserving total order (IEEE-754 trick:
     flip all bits of negatives, flip only the sign bit of positives).
-    Lets kth-largest selection run as a 32-step integer binary search
-    instead of a sort. THE shared definition: ops/pallas_solve.py
-    imports this for its in-kernel selection — a change here changes
-    both paths together (the differential suite pins their equality)."""
+    Lets kth-largest selection run as integer threshold search instead
+    of a sort. THE shared definition: ops/pallas_solve.py imports this
+    for its in-kernel selection — a change here changes both paths
+    together (the differential suite pins their equality)."""
     bits = lax.bitcast_convert_type(score, jnp.uint32)
     neg = bits >> 31 == 1
     return jnp.where(neg, ~bits, bits | jnp.uint32(0x80000000))
+
+
 
 
 @partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
@@ -151,6 +153,50 @@ def solve_greedy(
         step, (used0, job_count0, tg_count0, bw_used0), active
     )
     return idxs, oks, scores
+
+
+@partial(jax.jit, static_argnames=("k", "job_distinct", "tg_distinct"))
+def solve_greedy_batched(
+    total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+    eligible, ask, bw_ask, active, penalty, k, job_distinct, tg_distinct,
+):
+    """vmap of the exact greedy scan over the eval axis: every input is
+    stacked on axis 0 ([B, ...]) and each row runs the IDENTICAL
+    sequential scan it would run alone — rows never read each other, so
+    a stacked dispatch is decision-identical to B individual dispatches
+    (the fuzz differential pins bit equality). This is the cross-eval
+    batching of the small-count path: K concurrent evals' exact solves
+    cost one device round trip instead of K."""
+    return jax.vmap(
+        solve_greedy,
+        in_axes=(0,) * 12 + (None, None, None),
+    )(
+        total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+        eligible, ask, bw_ask, active, penalty, k, job_distinct, tg_distinct,
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "job_distinct", "tg_distinct"))
+def solve_greedy_batched_shared(
+    total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+    eligible, ask, bw_ask, active, penalty, k, job_distinct, tg_distinct,
+):
+    """solve_greedy_batched with the NODE tensors (total, sched_cap,
+    bw_avail) shared across the eval axis instead of stacked: the
+    coalescer groups exact entries by mirror identity, so every row of a
+    stacked dispatch reads the same mirror — broadcasting beats
+    materializing B copies of the [N, .] node data (at width 8 on the
+    131072-row bucket, ~40MB of device memory and 8x the node-axis
+    traffic per dispatch). Decision-identical to the all-stacked form:
+    vmap broadcast semantics, not a kernel change."""
+    return jax.vmap(
+        solve_greedy,
+        in_axes=(None, None, 0, 0, 0, None, 0, 0, 0, 0, 0, 0,
+                 None, None, None),
+    )(
+        total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,
+        eligible, ask, bw_ask, active, penalty, k, job_distinct, tg_distinct,
+    )
 
 
 @partial(jax.jit, static_argnames=("job_distinct", "tg_distinct"))
@@ -275,7 +321,13 @@ def solve_waterfill(
         ok = placed_at(mid) <= count
         return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1))
 
-    level, _ = lax.while_loop(bs_cond, bs_body, (jnp.int32(0), count))
+    # Search [0, min(count, max cap)]: any L >= max(cap) saturates
+    # min(cap, L), so base and candidates — the only consumers of
+    # ``level`` — come out identical, and the tighter interval cuts the
+    # O(N) sum passes from ~log2(count) to ~log2(max cap) (a 100k-task
+    # burst: 14 -> 6).
+    hi0 = jnp.minimum(count, jnp.max(cap))
+    level, _ = lax.while_loop(bs_cond, bs_body, (jnp.int32(0), hi0))
 
     base = jnp.minimum(cap, level)
     remaining = count - base.sum()
@@ -293,7 +345,10 @@ def solve_waterfill(
     # map scores to order-preserving uint32 keys, binary-search the
     # remaining-th largest key in exactly 32 compare+reduce steps, then
     # break boundary ties by ascending node index — the same selection
-    # a stable argsort(-score) produces.
+    # a stable argsort(-score) produces. (A byte-radix histogram select
+    # and a full sort were both A/B-measured SLOWER at the 131072-row
+    # bucket on the CPU backend — XLA scatter/sort lose to 32 fused
+    # compare+reduce passes.)
     u = jnp.where(candidates, _monotone_u32(score), jnp.uint32(0))
 
     def kth_body(_, lohi):
@@ -335,43 +390,22 @@ def solve_many_async(
     grouped by node — copies of one ask are interchangeable, so callers
     must not rely on ordering. Unplaceable tail is idx -1 / ok False.
     """
-    import numpy as np
-
     if count <= exact_threshold:
-        k = bucket(count)
-        active = jnp.arange(k) < count
-        penalty_dev = device_const("f32", penalty)
-        from nomad_tpu.ops.coalesce import device_activity
-        from nomad_tpu.parallel import mesh as mesh_lib
+        # The exact scan rides the coalescing engine like the water-fill:
+        # concurrent workers' small-count solves of one shape bucket
+        # stack on the eval axis (solve_greedy_batched) and cost ONE
+        # device dispatch instead of K. Each stacked row runs the
+        # identical independent scan, so results are bit-equal to a lone
+        # dispatch (fuzz-pinned).
+        from nomad_tpu.ops.coalesce import GLOBAL_SOLVER
 
-        # The exact path dispatches (and may COMPILE) on the caller's own
-        # thread — mark it so quiesce_all can drain before teardown.
-        with device_activity():
-            mesh = mesh_lib.mesh_for_nodes(total.shape[0])
-            if mesh is not None:
-                # Node tensors are born sharded by the mirror; the small
-                # per-eval args must be replicated onto the same mesh so the
-                # scan compiles as one SPMD program.
-                ask, bw_ask, active, penalty_dev = mesh_lib.replicate_on_mesh(
-                    mesh, ask, bw_ask, active, penalty_dev
-                )
-            idxs, oks, _scores = solve_greedy(
-                total, sched_cap, used0, job_count0, tg_count0, bw_avail,
-                bw_used0, eligible, ask, bw_ask, active,
-                penalty_dev, k, job_distinct, tg_distinct,
-            )
+        return GLOBAL_SOLVER.submit_exact(
+            total, sched_cap, used0, job_count0, tg_count0, bw_avail,
+            bw_used0, eligible, ask, bw_ask, count, penalty,
+            job_distinct=job_distinct, tg_distinct=tg_distinct,
+        )
 
-        def fetch_exact():
-            # Stage cuts ride the caller's thread-local timer (installed
-            # by TPUStack.solve_group; no-op otherwise): execute = device
-            # completion wait, readback = D2H copy.
-            with trace.stage("execute"):
-                jax.block_until_ready((idxs, oks))
-            with trace.stage("readback"):
-                i, o = jax.device_get((idxs, oks))
-            return i[:count], o[:count]
-
-        return fetch_exact
+    import numpy as np
 
     # Water-fill solver: one dispatch + one transfer for the whole batch.
     # distinct_hosts needs no special-casing: capacity is clamped to one

@@ -34,7 +34,12 @@ import numpy as np
 
 from nomad_tpu import telemetry, trace
 from nomad_tpu.ops import pallas_solve
-from nomad_tpu.ops.binpack import solve_waterfill
+from nomad_tpu.ops.binpack import (
+    solve_greedy,
+    solve_greedy_batched,
+    solve_greedy_batched_shared,
+    solve_waterfill,
+)
 
 # Cap on the vmapped eval-axis batch: dispatch in chunks of at most this
 # many entries so the power-of-two bucket set {1, 2, 4, 8} is the ENTIRE
@@ -107,19 +112,38 @@ def solve_waterfill_batched(
     )
 
 
-class _Entry:
-    __slots__ = ("args", "event", "group", "index", "error")
+def _record_dispatch_width(width: int, wall_ms: float) -> None:
+    """Feed the solver panel's batch-width axis (SOLVER_PANEL is the
+    process-wide /v1/agent/solver book). Late import: the coalescer must
+    stay importable (and the dispatch must not fail) when the solver
+    stack never initialized — e.g. pure-kernel benchmarks."""
+    try:
+        from nomad_tpu.tpu.solver import SOLVER_PANEL
+    except Exception:  # pragma: no cover - import breakage only
+        return
+    SOLVER_PANEL.record_dispatch(width, wall_ms)
 
-    def __init__(self, args):
+
+class _Entry:
+    __slots__ = ("args", "event", "group", "index", "error", "kind", "k")
+
+    def __init__(self, args, kind: str = "wf", k: int = 0):
         self.args = args
         self.event = threading.Event()
         self.group: Optional["_Group"] = None
         self.index = 0
         self.error: Optional[BaseException] = None
+        # Which program family this solve stacks into: "wf" (water-fill
+        # counts, the columnar path) or "exact" (the greedy scan of
+        # small counts, k = padded count bucket). Only same-kind,
+        # same-k entries share a dispatch.
+        self.kind = kind
+        self.k = k
 
     def result(self) -> Tuple[np.ndarray, int]:
         """Block for the dispatch, then return (counts[N], n_unplaced) —
-        or re-raise the dispatch failure instead of hanging."""
+        (idxs[k], oks[k]) for exact entries — or re-raise the dispatch
+        failure instead of hanging."""
         # The dispatcher-hold + device wall both land in the caller's
         # 'execute' stage cut (trace.stage no-ops when the calling thread
         # carries no stage timer).
@@ -134,16 +158,24 @@ class _Group:
     """One dispatched batch: device arrays + lazily-fetched host results."""
 
     __slots__ = ("counts_dev", "remaining_dev", "from_pallas", "_fetch_lock",
-                 "_host")
+                 "_host", "width", "t0")
 
-    def __init__(self, counts_dev, remaining_dev, from_pallas: bool = False):
+    def __init__(self, counts_dev, remaining_dev, from_pallas: bool = False,
+                 width: int = 1, t0: Optional[float] = None):
         self.counts_dev = counts_dev
         self.remaining_dev = remaining_dev
         self.from_pallas = from_pallas
         self._fetch_lock = threading.Lock()
         self._host = None
+        # Eval-stack width of the dispatch (real entries, not padding)
+        # and its dispatch timestamp: the first fetch records the
+        # (width, wall) pair on the solver panel's batch-width axis.
+        self.width = width
+        self.t0 = t0
 
-    def fetch(self, index: int) -> Tuple[np.ndarray, int]:
+    def _materialize(self) -> None:
+        """First fetch blocks on the device and copies the whole batch
+        down; later fetches index the cached host arrays."""
         with self._fetch_lock:
             if self._host is None:
                 try:
@@ -169,8 +201,31 @@ class _Group:
                         _pallas_fallback()
                     raise
                 self._host = (np.asarray(counts), np.asarray(remaining))
+                if self.t0 is not None:
+                    # Dispatch→ready wall, rider-attributed like the
+                    # panel's per-solve device_ms (an upper bound when
+                    # the fetcher arrives late).
+                    _record_dispatch_width(
+                        self.width,
+                        (time.perf_counter() - self.t0) * 1000.0,
+                    )
+
+    def fetch(self, index: int) -> Tuple[np.ndarray, int]:
+        self._materialize()
         counts, remaining = self._host
         return counts[index], int(remaining[index])
+
+
+class _ExactGroup(_Group):
+    """A stacked exact-scan dispatch: device outs are (idxs[B, k],
+    oks[B, k]) riding the base class's counts/remaining slots."""
+
+    __slots__ = ()
+
+    def fetch(self, index: int) -> Tuple[np.ndarray, np.ndarray]:
+        self._materialize()
+        idxs, oks = self._host
+        return idxs[index], oks[index]
 
 
 class CoalescingSolver:
@@ -259,9 +314,11 @@ class CoalescingSolver:
 
     def burst_done(self) -> None:
         """The calling eval thread finished processing. If none of its
-        submits accounted it (it never reached the coalescer — exact-path
-        small count, scale-down, failed prep), resolve its slot now so
-        the hold doesn't wait for a solve that will never come."""
+        submits accounted it (it never reached the coalescer — a
+        scale-down, a no-placement diff, failed prep; exact-path solves
+        DO reach it now via submit_exact and account on first submit),
+        resolve its slot now so the hold doesn't wait for a solve that
+        will never come."""
         if getattr(_BURST_TLS, "counted", True):
             return
         _BURST_TLS.counted = True
@@ -289,6 +346,37 @@ class CoalescingSolver:
             bw_used0, eligible, ask, bw_ask, count, penalty,
             bool(job_distinct), bool(tg_distinct),
         ))
+        self._enqueue(entry)
+        return entry.result
+
+    def submit_exact(
+        self, total, sched_cap, used0, job_count0, tg_count0, bw_avail,
+        bw_used0, eligible, ask, bw_ask, count: int, penalty: float,
+        job_distinct: bool = False, tg_distinct: bool = False,
+    ):
+        """Queue one exact greedy scan (count <= EXACT_THRESHOLD).
+        Concurrent exact solves of one (node bucket, count bucket,
+        distinct flags) shape stack on the eval axis and dispatch as ONE
+        solve_greedy_batched program — each stacked row runs the
+        identical independent scan, so results are bit-equal to a lone
+        dispatch. Returns fetch() -> (node_indices[count], ok[count])."""
+        from nomad_tpu.ops.binpack import bucket
+
+        entry = _Entry((
+            total, sched_cap, used0, job_count0, tg_count0, bw_avail,
+            bw_used0, eligible, ask, bw_ask, count, penalty,
+            bool(job_distinct), bool(tg_distinct),
+        ), kind="exact", k=bucket(count))
+
+        self._enqueue(entry)
+
+        def fetch_exact():
+            idxs, oks = entry.result()
+            return idxs[:count], oks[:count]
+
+        return fetch_exact
+
+    def _enqueue(self, entry: _Entry) -> None:
         # Always hand off to the dispatcher thread — an inline fast path
         # was A/B-measured ~2ms SLOWER per eval: the handoff is what lets
         # the caller's overlapped host work (bulk id generation) run while
@@ -308,7 +396,6 @@ class CoalescingSolver:
                 self._burst_outstanding -= 1
                 self._burst_last = time.monotonic()
             self._cond.notify()
-        return entry.result
 
     # -- dispatcher ---------------------------------------------------------
 
@@ -368,15 +455,24 @@ class CoalescingSolver:
         return False
 
     def _dispatch(self, batch: List[_Entry]) -> None:
-        # Group by (padded node count, static flags): only same-shaped,
-        # same-specialization solves stack into one program.
+        # Group by (padded node count, program kind, count bucket, static
+        # flags): only same-shaped, same-specialization solves stack into
+        # one program. Water-fill entries carry k=0, so the two kinds can
+        # never share a key. Exact entries additionally key on MIRROR
+        # IDENTITY (id of the total tensor — entries hold refs, so ids
+        # are stable for the dispatch): a stacked exact dispatch shares
+        # the node tensors across its rows (solve_greedy_batched_shared)
+        # instead of materializing B copies, which is only sound when
+        # every row reads the same mirror. Same-generation burst members
+        # do; cross-generation stragglers dispatch separately.
         groups: Dict[Tuple, List[_Entry]] = {}
         for e in batch:
             total = e.args[0]
-            key = (total.shape[0], e.args[12], e.args[13])
+            key = (total.shape[0], e.kind, e.k, e.args[12], e.args[13],
+                   id(total) if e.kind == "exact" else None)
             groups.setdefault(key, []).append(e)
 
-        for (n, jd, td), entries in groups.items():
+        for (n, _kind, _k, jd, td, _mid), entries in groups.items():
             # Chunk at the largest warmed eval-axis bucket: the compile
             # surface stays exactly the warmed set (1, 2, 4, 8) no matter
             # how deep a load spike's drain is.
@@ -391,10 +487,11 @@ class CoalescingSolver:
                     # fetch() caller.
                     for e in chunk:
                         try:
-                            counts_dev, remaining_dev, fp = self._solve_one(e)
-                            e.group = _Group(
-                                counts_dev[None], remaining_dev[None],
-                                from_pallas=fp,
+                            (a_dev, b_dev), fp = self._solve_one(e)
+                            cls = (_ExactGroup if e.kind == "exact"
+                                   else _Group)
+                            e.group = cls(
+                                a_dev[None], b_dev[None], from_pallas=fp,
                             )
                             e.index = 0
                         except Exception as exc:
@@ -404,30 +501,49 @@ class CoalescingSolver:
 
     @staticmethod
     def _solve_one(e: _Entry):
-        """Single-entry water-fill dispatch, node-axis sharded over the
-        configured mesh when one exists (parallel/mesh.py). On an
-        unsharded TPU backend the whole solve runs as one VMEM-resident
-        pallas kernel (ops/pallas_solve.py), falling back to the jnp
-        path if the kernel ever fails to lower/execute. Returns
-        (counts_dev, remaining_dev, from_pallas)."""
+        """Single-entry dispatch, node-axis sharded over the configured
+        mesh when one exists (parallel/mesh.py). Water-fill entries: on
+        an unsharded TPU backend the whole solve runs as one
+        VMEM-resident pallas kernel (ops/pallas_solve.py), falling back
+        to the jnp path if the kernel ever fails to lower/execute.
+        Exact entries run the greedy scan (no pallas variant). Returns
+        ((a_dev, b_dev), from_pallas) — (counts, remaining) for wf,
+        (idxs, oks) for exact."""
         from nomad_tpu.parallel import mesh as mesh_lib
 
+        from nomad_tpu.ops.binpack import device_const
+
         args10 = e.args[:10]
-        count = jnp.int32(e.args[10])
-        penalty = jnp.float32(e.args[11])
         mesh = mesh_lib.mesh_for_nodes(args10[0].shape[0])
+        if e.kind == "exact":
+            # Cached device constant, like the pre-coalescer inline path:
+            # on a remote device even a 16-byte penalty upload pays
+            # tunnel latency per lone dispatch.
+            penalty = device_const("f32", e.args[11])
+            active = jnp.arange(e.k) < e.args[10]
+            if mesh is not None:
+                args10 = mesh_lib.shard_waterfill_args(mesh, args10)
+                active, penalty = mesh_lib.replicate_on_mesh(
+                    mesh, active, penalty
+                )
+            idxs, oks, _scores = solve_greedy(
+                *args10, active, penalty, e.k, e.args[12], e.args[13],
+            )
+            return (idxs, oks), False
+        penalty = jnp.float32(e.args[11])
+        count = jnp.int32(e.args[10])
         if mesh is None:
             out = _pallas_dispatch(
                 False, (*args10, count, penalty), e.args[12], e.args[13],
                 args10[0].shape,
             )
             if out is not None:
-                return (*out, True)
+                return out, True
         else:
             args10 = mesh_lib.shard_waterfill_args(mesh, args10)
             count, penalty = mesh_lib.replicate_on_mesh(mesh, count, penalty)
         return (
-            *solve_waterfill(*args10, count, penalty, e.args[12], e.args[13]),
+            solve_waterfill(*args10, count, penalty, e.args[12], e.args[13]),
             False,
         )
 
@@ -437,20 +553,31 @@ class CoalescingSolver:
         telemetry.add_sample(
             ("scheduler", "coalesce", "batch_size"), float(len(entries))
         )
+        t0 = time.perf_counter()
         if len(entries) == 1:
             e = entries[0]
-            counts_dev, remaining_dev, fp = self._solve_one(e)
-            e.group = _Group(counts_dev[None], remaining_dev[None],
-                             from_pallas=fp)
+            (a_dev, b_dev), fp = self._solve_one(e)
+            cls = _ExactGroup if e.kind == "exact" else _Group
+            e.group = cls(a_dev[None], b_dev[None], from_pallas=fp,
+                          width=1, t0=t0)
             e.index = 0
             e.event.set()
             return
 
         self.coalesced += len(entries)
-        counts_dev, remaining_dev, fp = _stack_and_solve(
-            [e.args for e in entries], jd, td
-        )
-        group = _Group(counts_dev, remaining_dev, from_pallas=fp)
+        if entries[0].kind == "exact":
+            idxs_dev, oks_dev = _stack_and_solve_exact(
+                [e.args for e in entries], entries[0].k, jd, td
+            )
+            group: _Group = _ExactGroup(
+                idxs_dev, oks_dev, width=len(entries), t0=t0
+            )
+        else:
+            counts_dev, remaining_dev, fp = _stack_and_solve(
+                [e.args for e in entries], jd, td
+            )
+            group = _Group(counts_dev, remaining_dev, from_pallas=fp,
+                           width=len(entries), t0=t0)
         for i, e in enumerate(entries):
             e.group = group
             e.index = i
@@ -495,6 +622,59 @@ def _stack_and_solve(rows, jd: bool, td: bool):
         *solve_waterfill_batched(*stacked, counts, penalties, jd, td),
         False,
     )
+
+
+def _stack_rows_exact(rows, k: int, jd: bool, td: bool):
+    """Pad the exact-entry list to its power-of-two eval-axis bucket
+    (padding rows repeat row 0 with count=0 — an all-inactive scan) and
+    build the stacked active masks + penalties from the per-entry
+    counts. Returns (rows_padded, active, penalties)."""
+    from nomad_tpu.ops.binpack import bucket
+
+    b = bucket(len(rows), floor=2)
+    rows = list(rows)
+    rows.extend([rows[0][:10] + (0, 0.0, jd, td)] * (b - len(rows)))
+    counts = np.asarray([r[10] for r in rows], dtype=np.int32)
+    active = jnp.asarray(np.arange(k, dtype=np.int32)[None, :]
+                         < counts[:, None])
+    penalties = jnp.asarray([r[11] for r in rows], dtype=jnp.float32)
+    return rows, active, penalties
+
+
+def _stack_and_solve_exact(rows, k: int, jd: bool, td: bool):
+    """Stack the eval axis and dispatch ONE batched exact greedy scan.
+    The dispatcher's identity grouping guarantees every row reads the
+    SAME mirror, so the node tensors (total, sched_cap, bw_avail) ride
+    once — broadcast by vmap (solve_greedy_batched_shared) — and only
+    the per-eval tensors stack. On a configured mesh the fully-stacked
+    SPMD form runs instead (the eval axis can then shard over the
+    mesh's eval extent). Shared by the dispatcher and
+    warm_exact_batch_shapes so warmup provably compiles the exact
+    shapes real dispatches use. Returns (idxs_dev[B, k], oks_dev[B, k])."""
+    from nomad_tpu.parallel import mesh as mesh_lib
+
+    rows, active, penalties = _stack_rows_exact(rows, k, jd, td)
+    mesh = mesh_lib.mesh_for_nodes(rows[0][0].shape[0])
+    if mesh is not None:
+        cols = list(zip(*(r[:10] for r in rows)))
+        stacked = [jnp.stack(col) for col in cols]
+        stacked, active, penalties = mesh_lib.shard_greedy_batch_args(
+            mesh, stacked, active, penalties
+        )
+        idxs, oks, _scores = solve_greedy_batched(
+            *stacked, active, penalties, k, jd, td
+        )
+        return idxs, oks
+    total, sched_cap, bw_avail = rows[0][0], rows[0][1], rows[0][5]
+    per_eval = {
+        i: jnp.stack([r[i] for r in rows]) for i in (2, 3, 4, 6, 7, 8, 9)
+    }
+    idxs, oks, _scores = solve_greedy_batched_shared(
+        total, sched_cap, per_eval[2], per_eval[3], per_eval[4],
+        bw_avail, per_eval[6], per_eval[7], per_eval[8], per_eval[9],
+        active, penalties, k, jd, td,
+    )
+    return idxs, oks
 
 
 # Process-wide engine shared by all workers (like GLOBAL_MIRROR_CACHE).
@@ -587,7 +767,8 @@ def _warm_batch_shapes_inner(n_padded, buckets, stop, args, mesh_lib) -> int:
         if stop is not None and stop():
             return done
         if b == 1:
-            counts_dev, _rem, _fp = CoalescingSolver._solve_one(_Entry(args))
+            (counts_dev, _rem), _fp = CoalescingSolver._solve_one(
+                _Entry(args))
         else:
             counts_dev, _rem, _fp = _stack_and_solve([args] * b, False, False)
         jax.block_until_ready(counts_dev)
@@ -607,4 +788,38 @@ def _warm_batch_shapes_inner(n_padded, buckets, stop, args, mesh_lib) -> int:
                 )
             jax.block_until_ready(jnp_out)
         done += 1
+    return done
+
+
+def warm_exact_batch_shapes(n_padded: int, counts=(8, 16, 32, 64, 128),
+                            buckets=(2, 4, 8), stop=None) -> int:
+    """Pre-compile the STACKED exact greedy scan for each (count bucket ×
+    eval-axis width) at one node-axis bucket — the third axis of the
+    shape-key space the cross-eval batcher adds. Width 1 is warmed by
+    warm_shapes' real solve_group dispatches; the widths here are the
+    coalesced ones a burst's first drain would otherwise compile
+    in-window (blamed, correctly, on bucket_crossing by the compile-
+    attribution ring). Runs through _stack_and_solve_exact — the SAME
+    stacking real dispatches use — so warm shapes can't drift. Returns
+    the number of dispatches issued."""
+    from nomad_tpu.ops.binpack import bucket
+
+    zero4 = jnp.zeros((n_padded, 4), dtype=jnp.int32)
+    zcap = jnp.zeros((n_padded, 2), dtype=jnp.float32)
+    zvec = jnp.zeros((n_padded,), dtype=jnp.int32)
+    elig = jnp.zeros((n_padded,), dtype=bool)
+    args = (zero4, zcap, zero4, zvec, zvec, zvec, zvec, elig,
+            jnp.zeros((4,), dtype=jnp.int32), jnp.int32(0),
+            0, 0.0, False, False)
+    done = 0
+    with device_activity():
+        for k in sorted({bucket(c) for c in counts}):
+            for b in buckets:
+                if stop is not None and stop():
+                    return done
+                idxs_dev, _oks = _stack_and_solve_exact(
+                    [args] * b, k, False, False
+                )
+                jax.block_until_ready(idxs_dev)
+                done += 1
     return done

@@ -216,6 +216,165 @@ def shard_waterfill_batch_args(mesh: Mesh, stacked10, counts, penalties):
     return placed, jax.device_put(counts, vec), jax.device_put(penalties, vec)
 
 
+def shard_greedy_batch_args(mesh: Mesh, stacked10, active, penalties):
+    """Batched EXACT-scan variant (solve_greedy_batched): the same
+    [B, ...] node-axis shardings as the water-fill stack, plus the
+    [B, k] active masks (replicated over the node axis) and the [B]
+    penalties."""
+    b = stacked10[0].shape[0]
+    eval_axis = EVAL_AXIS if b % mesh.shape[EVAL_AXIS] == 0 else None
+    specs = tuple(
+        P(eval_axis, *spec) for spec in (
+            (NODE_AXIS, None), (NODE_AXIS, None), (NODE_AXIS, None),
+            (NODE_AXIS,), (NODE_AXIS,), (NODE_AXIS,), (NODE_AXIS,),
+            (NODE_AXIS,), (None,), (),
+        )
+    )
+    placed = tuple(
+        jax.device_put(x, NamedSharding(mesh, spec))
+        for x, spec in zip(stacked10, specs)
+    )
+    active = jax.device_put(
+        active, NamedSharding(mesh, P(eval_axis, None))
+    )
+    penalties = jax.device_put(
+        penalties, NamedSharding(mesh, P(eval_axis))
+    )
+    return placed, active, penalties
+
+
+# Per-mesh jit cache for node-sharded helper programs (the mirror's
+# delta scatters). Keyed by (mesh id, fn, out signature) and bounded:
+# meshes are configured once per process in production, but tests
+# configure/clear repeatedly and the stale jits would otherwise pile up.
+_SHARDED_JIT_CACHE: dict = {}
+_SHARDED_JIT_CAP = 64
+
+
+def node_sharded_jit(fn, n: int, out_trailing: Tuple[int, ...]):
+    """jit ``fn`` with every output's axis 0 pinned to the NODE_AXIS
+    sharding (``out_trailing[i]`` = that output's trailing dims), or None
+    when no mesh divides the padded length ``n`` — the caller then uses
+    its plain single-device jit.
+
+    This is what makes the mirror's row-sliced delta scatters mesh-aware:
+    a scatter into a sharded buffer whose output sharding floats free
+    would let GSPMD gather the whole node axis onto one device, and every
+    later solve would pay a reshard (STATS['node_reshards'] counts those;
+    the guardrail tests hold it at zero)."""
+    mesh = mesh_for_nodes(n)
+    if mesh is None:
+        return None
+    key = (id(mesh), fn, out_trailing)
+    with _mesh_lock:
+        jitted = _SHARDED_JIT_CACHE.get(key)
+        if jitted is None:
+            out_sh = tuple(
+                NamedSharding(mesh, P(NODE_AXIS, *(None,) * t))
+                for t in out_trailing
+            )
+            jitted = jax.jit(fn, out_shardings=out_sh)
+            if len(_SHARDED_JIT_CACHE) >= _SHARDED_JIT_CAP:
+                _SHARDED_JIT_CACHE.clear()
+            _SHARDED_JIT_CACHE[key] = jitted
+    return jitted
+
+
+# ---------------------------------------------------------------------------
+# The server-config face of the mesh: `server { solver_mesh { } }`.
+
+
+class SolverMeshConfig:
+    """Parsed ``server { solver_mesh { } }`` block: how many devices the
+    node axis of every production solve shards over, and the eval-axis
+    extent of the 2D mesh. Parse-time validated like admission/express —
+    a typo'd knob fails config load, not leader-establish. The default
+    (node_shards 0) keeps solves single-device; a mesh the local device
+    set can't satisfy falls back transparently at apply time (scale-down
+    of the same binary onto a smaller box must not crash the server)."""
+
+    __slots__ = ("node_shards", "eval_parallel")
+
+    _KEYS = ("node_shards", "eval_parallel")
+
+    def __init__(self, node_shards: int = 0, eval_parallel: int = 1):
+        self.node_shards = node_shards
+        self.eval_parallel = eval_parallel
+
+    @property
+    def enabled(self) -> bool:
+        return self.node_shards > 1 or self.eval_parallel > 1
+
+    @classmethod
+    def parse(cls, data) -> "SolverMeshConfig":
+        if data is None:
+            return cls()
+        if not isinstance(data, dict):
+            raise ValueError("server.solver_mesh must be a mapping")
+        unknown = sorted(set(data) - set(cls._KEYS))
+        if unknown:
+            raise ValueError(
+                f"unknown server.solver_mesh key(s) {unknown} "
+                f"(have: {list(cls._KEYS)})"
+            )
+        out = {}
+        for key, lo, hi in (("node_shards", 0, 4096),
+                            ("eval_parallel", 1, 64)):
+            v = data.get(key)
+            if v is None:
+                continue
+            if (not isinstance(v, int) or isinstance(v, bool)
+                    or not lo <= v <= hi):
+                raise ValueError(
+                    f"server.solver_mesh.{key} must be an integer in "
+                    f"[{lo}, {hi}], got {v!r}"
+                )
+            if v > 1 and v & (v - 1):
+                # Node tensors pad to power-of-two buckets; a non-power-
+                # of-two extent could never divide them evenly.
+                raise ValueError(
+                    f"server.solver_mesh.{key} must be a power of two, "
+                    f"got {v}"
+                )
+            out[key] = v
+        return cls(out.get("node_shards", 0), out.get("eval_parallel", 1))
+
+    def as_dict(self) -> dict:
+        return {"node_shards": self.node_shards,
+                "eval_parallel": self.eval_parallel}
+
+
+def apply_solver_mesh(cfg: SolverMeshConfig, log=None) -> Optional[Mesh]:
+    """Configure the process solve mesh from a parsed solver_mesh block.
+    Transparent fallback: when the local device set can't satisfy the
+    requested extents (a one-device box running a mesh-configured
+    config), solves stay single-device and the server keeps running —
+    the knob describes a capability, not a hard requirement."""
+    log = log or logger
+    if not cfg.enabled:
+        return None
+    needed = max(cfg.node_shards, 1) * cfg.eval_parallel
+    n_local = len(jax.devices())
+    if n_local < needed:
+        log.warning(
+            "solver_mesh wants %d device(s) (node_shards=%d x "
+            "eval_parallel=%d) but only %d present; solves stay "
+            "single-device", needed, cfg.node_shards, cfg.eval_parallel,
+            n_local,
+        )
+        return None
+    try:
+        mesh = configure_node_sharding(
+            needed, eval_parallel=cfg.eval_parallel
+        )
+    except Exception as e:
+        log.warning("solver_mesh not usable (%s); solves stay "
+                    "single-device", e)
+        return None
+    log.info("solver mesh configured: %s", dict(mesh.shape))
+    return mesh
+
+
 @partial(jax.jit, static_argnames=("k", "job_distinct", "tg_distinct"))
 def _batched_solve(
     total, sched_cap, used0, job_count0, tg_count0, bw_avail, bw_used0,

@@ -140,6 +140,15 @@ class ServerConfig:
     # capacity %. None = defaults (enabled; decision-invariant by
     # construction, pinned by the churn-fragmentation contrast arm).
     capacity: Optional[Dict] = None
+    # Solver mesh spec (SolverMeshConfig.parse mapping,
+    # nomad_tpu/parallel/mesh.py): shard the node axis of every device
+    # solve (and the mirror's padded buffers) over a JAX device mesh —
+    # `{node_shards: N, eval_parallel: M}`. None/default = single-device
+    # (decision-invariant: sharded solves are fuzz-pinned identical, the
+    # knob only moves where the flops run). Applied at start with a
+    # transparent single-device fallback when the local device set can't
+    # satisfy the extents.
+    solver_mesh: Optional[Dict] = None
 
     def __post_init__(self) -> None:
         if self.num_schedulers is not None:
@@ -180,6 +189,9 @@ class ServerConfig:
         from nomad_tpu.capacity import CapacityConfig
 
         self.capacity_config = CapacityConfig.parse(self.capacity)
+        from nomad_tpu.parallel.mesh import SolverMeshConfig
+
+        self.solver_mesh_config = SolverMeshConfig.parse(self.solver_mesh)
 
     def scheduler_factory(self, eval_type: str) -> str:
         if self.scheduler_backend == "tpu" and eval_type in (
@@ -306,6 +318,7 @@ class Server:
         if self._started:
             return
         self._started = True
+        self._apply_solver_mesh()
         self.plan_queue.set_enabled(True)
         self.eval_broker.set_enabled(True)
         self.plan_applier.start()
@@ -339,6 +352,21 @@ class Server:
                 target=self._prewarm_solver, daemon=True, name="shape-warmer",
             )
             warmer.start()
+
+    def _apply_solver_mesh(self) -> None:
+        """Configure the process solve mesh from `server { solver_mesh }`
+        BEFORE any worker can build a mirror: node tensors are born with
+        the configured sharding (mirror.put_node_sharded), so ordering is
+        what keeps the warm path reshard-free. Transparent fallback on a
+        box that can't satisfy the extents. Shared by Server.start and
+        ClusterServer.start so the gating can never drift."""
+        if (self.config.solver_mesh_config.enabled
+                and self.config.scheduler_backend == "tpu"):
+            from nomad_tpu.parallel import mesh as mesh_lib
+
+            mesh_lib.apply_solver_mesh(
+                self.config.solver_mesh_config, self.logger
+            )
 
     def _prewarm_solver(self) -> None:
         """Background shape-bucket pre-compile (see ServerConfig

@@ -136,6 +136,30 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
                         "(10,080 placements) under steady node-refresh "
                         "writes (12 re-registrations every ~0.9s)",
         ),
+        "steady-100k-nodes": ScenarioSpec(
+            name="steady-100k-nodes", n_nodes=100_000,
+            injectors=lambda seed: [SteadyServiceInjector(
+                seed, jobs=24, tasks_per_job=420, over=24.0,
+            )],
+            server_overrides={
+                # 100k/10 = 10000s TTLs: beats never come due inside the
+                # run, so loaded-box beat starvation can't expire live
+                # nodes (the overdrive-100k posture at 10x the fleet).
+                "max_heartbeats_per_second": 10.0,
+                # The 100k-node registration tranche events + the
+                # steady-10k-shaped placement flow must fit the 20 Hz
+                # watcher's poll stride without ring truncation.
+                "event_buffer_size": 32768,
+            },
+            quiesce_timeout=900.0, ack_cap=0,
+            description="ROADMAP item 1's node-axis proof: the steady-10k "
+                        "service workload (24 jobs x420 tasks over ~24s) "
+                        "against a 100k-node cell — the mirror pads to "
+                        "the 131072-row bucket and every solve scores "
+                        "every node; the solver panel's device-time-per-"
+                        "placement is the meter the 'same warm-path cost "
+                        "class as 10k' claim is judged against",
+        ),
         "burst-100k": ScenarioSpec(
             name="burst-100k", n_nodes=10_000,
             injectors=lambda seed: [BatchBurstInjector(
@@ -1303,6 +1327,22 @@ class ScenarioRunner:
         live = delta("live_rows")
         cpadded = delta("count_padded")
         clive = delta("count_live")
+        # Batch-width window: per-width dispatch/eval/wall deltas against
+        # the window-start baseline (the cross-eval batching economy).
+        bw0 = p0.get("batch_widths", {})
+        batch_widths = {}
+        for width, row in p1.get("batch_widths", {}).items():
+            base = bw0.get(width, {})
+            d = row["dispatches"] - base.get("dispatches", 0)
+            ev = row["evals"] - base.get("evals", 0)
+            ms = round(row["device_ms"] - base.get("device_ms", 0.0), 3)
+            if d:
+                batch_widths[width] = {
+                    "dispatches": d, "evals": ev, "device_ms": ms,
+                    "device_ms_per_eval": round(ms / ev, 4) if ev else 0.0,
+                }
+        eq0 = p0.get("equiv", {})
+        eq1 = p1.get("equiv", {})
         return {
             "window": {
                 "solves": delta("solves"),
@@ -1315,6 +1355,12 @@ class ScenarioRunner:
                     1.0 - live / padded, 4) if padded else 0.0,
                 "count_padding_waste": round(
                     1.0 - clive / cpadded, 4) if cpadded else 0.0,
+                "batch_widths": batch_widths,
+                "equiv": {
+                    k: eq1.get(k, 0) - eq0.get(k, 0)
+                    for k in ("classes", "members", "copies",
+                              "rows_saved")
+                },
             },
             "trajectory": trajectory,
             # Process-lifetime views (include pre-window warmup — the

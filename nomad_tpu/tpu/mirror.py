@@ -24,7 +24,7 @@ import numpy as np
 
 from nomad_tpu import telemetry
 from nomad_tpu.ops.binpack import bucket
-from nomad_tpu.parallel.mesh import put_node_sharded
+from nomad_tpu.parallel.mesh import node_sharded_jit, put_node_sharded
 from nomad_tpu.scheduler.feasible import (
     _parse_bool,
     check_constraint,
@@ -71,11 +71,14 @@ def _node_row_vals(node: Node) -> Tuple[Tuple, Tuple, int, int]:
     return total, reserved, bw_avail, bw_reserved
 
 
-@jax.jit
-def _rows_update(total, sched_cap, bw_avail, rows, tot, sched, bwa):
+def _rows_update_body(total, sched_cap, bw_avail, rows, tot, sched, bwa):
     """One fused dispatch for the mirror's row-sliced device restage:
     three separate .at[].set calls cost ~2ms of un-jitted dispatch EACH
-    on a warm CPU backend — more than the entire roll saves."""
+    on a warm CPU backend — more than the entire roll saves. Jitted two
+    ways: plain (single device) and — when a solve mesh is configured —
+    with out_shardings pinned to the node axis (mesh.node_sharded_jit),
+    so a delta roll of sharded buffers scatters shard-local and the
+    rolled mirror's tensors stay born-sharded for later dispatches."""
     return (
         total.at[rows].set(tot),
         sched_cap.at[rows].set(sched),
@@ -83,10 +86,26 @@ def _rows_update(total, sched_cap, bw_avail, rows, tot, sched, bwa):
     )
 
 
-@jax.jit
-def _usage_rows_update(used, bw, rows, res, bwr):
+def _usage_rows_update_body(used, bw, rows, res, bwr):
     """Fused row restage of the clean-usage pair (reserved deltas)."""
     return used.at[rows].set(res), bw.at[rows].set(bwr)
+
+
+_rows_update = jax.jit(_rows_update_body)
+_usage_rows_update = jax.jit(_usage_rows_update_body)
+
+
+def _rows_update_fn(padded: int):
+    """The mirror-tensor restage program for this node bucket: the mesh-
+    aware sharded jit when one divides the bucket, the plain jit
+    otherwise (the transparent single-device fallback)."""
+    return node_sharded_jit(_rows_update_body, padded, (1, 1, 0)) \
+        or _rows_update
+
+
+def _usage_rows_update_fn(padded: int):
+    return node_sharded_jit(_usage_rows_update_body, padded, (1, 0)) \
+        or _usage_rows_update
 
 
 def _pad_rows(rows_arr: np.ndarray, *vals: np.ndarray):
@@ -357,7 +376,9 @@ class NodeMirror:
             p_rows, p_tot, p_sched, p_bwa = _pad_rows(
                 rows_arr, tot_arr, sched_arr, bwa_arr
             )
-            new.total, new.sched_cap, new.bw_avail = _rows_update(
+            new.total, new.sched_cap, new.bw_avail = _rows_update_fn(
+                self.padded
+            )(
                 self.total, self.sched_cap, self.bw_avail,
                 p_rows, p_tot, p_sched, p_bwa,
             )
@@ -421,7 +442,7 @@ class NodeMirror:
         elif reserved_changed:
             used_dev, z1, z2, bw_dev = self._clean_usage_dev
             p_rows, p_res, p_bwr = _pad_rows(rows_arr, res_arr, bwr_arr)
-            u_dev, b_dev = _usage_rows_update(
+            u_dev, b_dev = _usage_rows_update_fn(self.padded)(
                 used_dev, bw_dev, p_rows, p_res, p_bwr
             )
             new._clean_usage_dev = (u_dev, z1, z2, b_dev)

@@ -22,6 +22,7 @@ dispatches per evaluation regardless of count.
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 import time
@@ -178,6 +179,19 @@ class SolverPanel:
         # reads that as a reset and reports phantom compile spikes.
         self._compile_counts: Dict[str, int] = {}
         self._compiles: List[Dict] = []
+        # Batch-width axis: eval-stack width -> [dispatches, evals,
+        # device_ms] recorded by the coalescer per device dispatch. The
+        # amortization story of cross-eval batching: N stacked evals'
+        # shared dispatch wall divided by N is the per-eval cost the
+        # batching win shows up in.
+        self._batch_widths: Dict[int, List[float]] = {}
+        # Equivalence classes (Borg §'equivalence class'): identical
+        # task groups of one job collapsed to one solve row with a
+        # multiplicity count. rows_saved = solves that never dispatched.
+        self.equiv_classes = 0
+        self.equiv_members = 0
+        self.equiv_copies = 0
+        self.equiv_rows_saved = 0
 
     # -- recording -----------------------------------------------------------
 
@@ -243,6 +257,27 @@ class SolverPanel:
                 })
                 del self._compiles[:-self.MAX_COMPILE_RECORDS]
 
+    def record_dispatch(self, width: int, wall_ms: float) -> None:
+        """One coalescer device dispatch carrying ``width`` stacked evals
+        (1 = a lone solve). Wall is dispatch→ready, rider-attributed like
+        per-solve device_ms."""
+        with self._lock:
+            row = self._batch_widths.get(width)
+            if row is None:
+                row = self._batch_widths[width] = [0, 0, 0.0]
+            row[0] += 1
+            row[1] += width
+            row[2] += wall_ms
+
+    def record_equiv(self, members: int, count: int) -> None:
+        """One equivalence-class collapse: ``members`` identical task
+        groups (``count`` total copies) solved as one row."""
+        with self._lock:
+            self.equiv_classes += 1
+            self.equiv_members += members
+            self.equiv_copies += count
+            self.equiv_rows_saved += members - 1
+
     # -- exposition ----------------------------------------------------------
 
     def snapshot(self) -> Dict:
@@ -289,6 +324,30 @@ class SolverPanel:
                 ) if self.count_padded else 0.0,
                 "node_buckets": node_buckets,
                 "count_buckets": count_buckets,
+                # Eval-stack width histogram of the coalescer's device
+                # dispatches + per-eval amortized device wall: the
+                # cross-eval batching win, read directly. String keys so
+                # the JSON round-trips stably (artifact diffs).
+                "batch_widths": {
+                    str(w): {
+                        "dispatches": d, "evals": ev,
+                        "device_ms": round(ms, 3),
+                        "device_ms_per_eval": round(ms / ev, 4) if ev
+                        else 0.0,
+                    }
+                    for w, (d, ev, ms) in sorted(
+                        self._batch_widths.items())
+                },
+                "batch_dispatches": sum(
+                    d for d, _e, _m in self._batch_widths.values()),
+                "batch_evals": sum(
+                    e for _d, e, _m in self._batch_widths.values()),
+                "equiv": {
+                    "classes": self.equiv_classes,
+                    "members": self.equiv_members,
+                    "copies": self.equiv_copies,
+                    "rows_saved": self.equiv_rows_saved,
+                },
                 "compiles": {
                     "total": sum(self._compile_counts.values()),
                     "by_trigger": dict(sorted(
@@ -760,8 +819,156 @@ class TPUGenericScheduler(GenericScheduler):
             ]
             if place:
                 self.compute_placements(place)
+        self._place_big_groups(big)
+
+    def _place_big_groups(self, big) -> None:
+        """Columnar placement of the big task groups, collapsed by
+        EQUIVALENCE CLASS (Borg §scheduling 'equivalence classes'):
+        CONSECUTIVE groups whose solve inputs are identical — same ask
+        vector, same drivers, same constraint surface, no distinct_hosts
+        scoping — share ONE counts-solve carrying the summed
+        multiplicity, and the per-node counts de-mux host-side back into
+        one AllocBatch per member group (first-member-first along the
+        mirror's row order, the same exhaustion order the sequential
+        per-group loop produces). A job spelled as M identical groups
+        costs one solve row instead of M. Only ADJACENT members collapse:
+        folding a later equivalent group past an interleaved
+        non-equivalent one would let its placements into the plan before
+        that group solves, changing the usage view (anti-affinity
+        job_count, plan deltas) the sequential loop would have given it
+        — consecutive runs keep the accumulation order bit-identical for
+        every non-member."""
+        if len(big) < 2:
+            for tg, missing in big:
+                self._place_batch(tg, missing)
+            return
+        job_distinct = (self.job is not None
+                        and _has_distinct_hosts(self.job.constraints))
+
+        def equiv_key(tg):
+            if job_distinct or _has_distinct_hosts(tg.constraints):
+                return None
+            c = task_group_constraints(tg)
+            return (
+                tuple(c.size.as_vector()),
+                frozenset(c.drivers),
+                tuple((x.l_target, x.operand, x.r_target)
+                      for x in c.constraints),
+            )
+
+        def flush(run):
+            if len(run) == 1:
+                self._place_batch(*run[0])
+            else:
+                self._place_batch_class(run)
+
+        run: list = []
+        run_key: Optional[Tuple] = None
         for tg, missing in big:
-            self._place_batch(tg, missing)
+            key = equiv_key(tg)
+            if run and key is not None and key == run_key:
+                run.append((tg, missing))
+                continue
+            if run:
+                flush(run)
+            if key is None:
+                self._place_batch(tg, missing)
+                run, run_key = [], None
+            else:
+                run, run_key = [(tg, missing)], key
+        if run:
+            flush(run)
+
+    def _place_batch_class(self, members) -> None:
+        """One counts-solve for a whole equivalence class: ``members`` is
+        [(tg, missing_indices), ...] with identical solve inputs. The
+        combined per-node counts split back into per-member AllocBatches
+        by walking the solve's row order and filling members in job
+        order — so member i's share is exactly what a sequential loop
+        would have carved out of the same combined capacity."""
+        from nomad_tpu.structs import AllocBatch
+
+        self.ctx.reset()
+        tg0 = members[0][0]
+        total_count = sum(len(m) for _tg, m in members)
+        _nodes, mirror = GLOBAL_MIRROR_CACHE.get(
+            self.state, self.job.datacenters
+        )
+        self.stack.set_mirror(mirror)
+        # Members share one resource size by class-key construction:
+        # the solve's size serves every member's batch and failed alloc.
+        counts, unplaced, size = self.stack.solve_group_counts(
+            tg0, total_count
+        )
+        SOLVER_PANEL.record_equiv(len(members), total_count)
+        # Per-member metrics: a deep copy of the shared solve's books per
+        # member, so coalesced_failures (and any later mutation) never
+        # accumulates across members onto one object — the sequential
+        # loop gives every group its own AllocMetric and consumers sum
+        # failure counts per failed alloc.
+        solve_metrics = self.ctx.metrics()
+
+        placed_total = total_count - unplaced if counts is not None else 0
+        ids_arr = mirror.id_array()
+        nz = (np.flatnonzero(counts[: mirror.n])
+              if placed_total > 0 else np.empty(0, dtype=np.int64))
+        # De-mux: walk the placed rows in order, carving each row's count
+        # into the current member's remaining need.
+        run_iter = iter(nz.tolist())
+        row = None
+        row_left = 0
+        for tg, missing in members:
+            metrics = copy.deepcopy(solve_metrics)
+            need = min(len(missing), placed_total)
+            placed_total -= need
+            m_rows: List[int] = []
+            m_counts: List[int] = []
+            while need > 0:
+                if row_left == 0:
+                    row = next(run_iter)
+                    row_left = int(counts[row])
+                take = min(row_left, need)
+                m_rows.append(row)
+                m_counts.append(take)
+                row_left -= take
+                need -= take
+            n_member_placed = sum(m_counts)
+            if n_member_placed:
+                batch = AllocBatch(
+                    eval_id=self.eval.id,
+                    job=self.job,
+                    tg_name=tg.name,
+                    resources=size,
+                    task_resources={t.name: t.resources for t in tg.tasks},
+                    metrics=metrics,
+                    node_ids=ids_arr[m_rows].tolist(),
+                    node_counts=m_counts,
+                    name_idx=np.asarray(missing[:n_member_placed]),
+                    ids_seed=_new_ids_seed(),
+                )
+                batch.src_ids_ref = ids_arr
+                batch.src_rows = np.asarray(m_rows, dtype=np.int64)
+                self.plan.append_batch(batch)
+            n_failed = len(missing) - n_member_placed
+            if n_failed:
+                failed = object.__new__(Allocation)
+                failed.__dict__ = {
+                    "id": generate_uuid(), "eval_id": self.eval.id,
+                    "name": f"{self.job.name}.{tg.name}"
+                            f"[{int(missing[n_member_placed])}]",
+                    "node_id": "", "job_id": self.job.id, "job": self.job,
+                    "task_group": tg.name,
+                    "resources": size,
+                    "task_resources": {}, "metrics": metrics,
+                    "desired_status": ALLOC_DESIRED_STATUS_FAILED,
+                    "desired_description":
+                        "failed to find a node for placement",
+                    "client_status": ALLOC_CLIENT_STATUS_FAILED,
+                    "client_description": "", "create_index": 0,
+                    "modify_index": 0,
+                }
+                failed.metrics.coalesced_failures += n_failed - 1
+                self.plan.append_failed(failed)
 
     def _constraints_unchanged(self, old_job, old_tg, new_tg) -> bool:
         """Whether the feasibility criteria (job + tg + per-task
@@ -1910,10 +2117,21 @@ def _warm_shapes_inner(snapshot, counts, log, stop, nodes) -> int:
                 stack.solve_group_counts(tg, count)
             dispatches += 1
         # Coalesced multi-eval dispatches pad the eval axis to power-of-two
-        # buckets; warm those shapes too (ops/coalesce.py).
-        from nomad_tpu.ops.coalesce import warm_batch_shapes
+        # buckets; warm those shapes too (ops/coalesce.py) — the water-fill
+        # widths AND the stacked exact scan's (node-bucket × count-bucket
+        # × batch-width-bucket) keys, so the first coalesced burst after
+        # leader-establish doesn't eat a compile storm the attribution
+        # ring would (correctly) blame on bucket_crossing.
+        from nomad_tpu.ops.coalesce import (
+            warm_batch_shapes,
+            warm_exact_batch_shapes,
+        )
 
         dispatches += warm_batch_shapes(mirror.padded, stop=stop)
+        dispatches += warm_exact_batch_shapes(
+            mirror.padded, counts=[c for c in counts if c <= 128],
+            stop=stop,
+        )
     log.info(
         "warmed %d solve program(s) across %d node bucket(s) in %.1fs",
         dispatches, len(seen), time.perf_counter() - t0,

@@ -262,6 +262,50 @@ def test_dev_config_and_agent_conversion():
     assert ac2.enable_syslog
 
 
+def test_solver_mesh_config_parse_and_merge():
+    """server { solver_mesh { } }: parse-time validated (unknown keys,
+    ranges, power-of-two), key-by-key merge like admission/express, and
+    wired through ServerConfig.__post_init__."""
+    from nomad_tpu.parallel.mesh import SolverMeshConfig
+    from nomad_tpu.server import ServerConfig
+
+    cfg = parse_config('''
+server {
+    enabled = true
+    solver_mesh {
+        node_shards = 4
+        eval_parallel = 2
+    }
+}
+''')
+    assert cfg.server.solver_mesh == {"node_shards": 4, "eval_parallel": 2}
+
+    # Key-by-key merge: a later file overrides one knob, keeps the rest.
+    merged = cfg.merge(parse_config(
+        'server { solver_mesh { node_shards = 8 } }'
+    ))
+    assert merged.server.solver_mesh == {"node_shards": 8,
+                                         "eval_parallel": 2}
+
+    for bad in ('server { solver_mesh { node_shards = 3 } }',
+                'server { solver_mesh { node_shards = -1 } }',
+                'server { solver_mesh { bogus = 1 } }',
+                'server { solver_mesh { eval_parallel = 0 } }'):
+        with pytest.raises(ValueError):
+            parse_config(bad)
+
+    sc = ServerConfig(solver_mesh={"node_shards": 2})
+    assert sc.solver_mesh_config.enabled
+    assert sc.solver_mesh_config.node_shards == 2
+    assert sc.solver_mesh_config.eval_parallel == 1
+    assert not ServerConfig().solver_mesh_config.enabled
+    with pytest.raises(ValueError):
+        ServerConfig(solver_mesh={"node_shards": 6})
+
+    parsed = SolverMeshConfig.parse({"node_shards": 4, "eval_parallel": 2})
+    assert parsed.as_dict() == {"node_shards": 4, "eval_parallel": 2}
+
+
 def test_cli_parses_new_commands():
     from nomad_tpu.cli import make_parser
 

@@ -346,6 +346,114 @@ def test_dispatcher_survives_unexpected_batch_error(monkeypatch):
     assert unplaced == d_unplaced
 
 
+def _submit_exact(engine, inp):
+    return engine.submit_exact(
+        inp["total"], inp["sched_cap"], inp["used0"], inp["job_count0"],
+        inp["tg_count0"], inp["bw_avail"], inp["bw_used0"], inp["eligible"],
+        inp["ask"], inp["bw_ask"], inp["count"], inp["penalty"],
+    )
+
+
+def _direct_exact(inp):
+    from nomad_tpu.ops.binpack import bucket, solve_greedy
+
+    k = bucket(inp["count"])
+    active = jnp.arange(k) < inp["count"]
+    idxs, oks, _ = solve_greedy(
+        inp["total"], inp["sched_cap"], inp["used0"], inp["job_count0"],
+        inp["tg_count0"], inp["bw_avail"], inp["bw_used0"], inp["eligible"],
+        inp["ask"], inp["bw_ask"], active, jnp.float32(inp["penalty"]),
+        k, False, False,
+    )
+    return (np.asarray(idxs)[: inp["count"]],
+            np.asarray(oks)[: inp["count"]])
+
+
+def test_exact_submissions_stack_into_one_dispatch():
+    """Announced-burst exact solves of one (node, count-bucket) shape
+    stack into ONE solve_greedy_batched dispatch, each row bit-equal to
+    its lone dispatch; the solver panel's batch-width axis records the
+    stacked width."""
+    from nomad_tpu.tpu.solver import SOLVER_PANEL
+
+    engine = CoalescingSolver()
+    # Warm the dispatcher + both shapes outside the burst.
+    _submit_exact(engine, _inputs(50, 40))()
+    engine.hint_burst(4, window_s=2.0, gap_s=1.0)
+    d0 = engine.dispatches
+    with SOLVER_PANEL._lock:
+        w0 = dict(
+            (w, list(v)) for w, v in SOLVER_PANEL._batch_widths.items()
+        )
+    # Counts 33..48 share the 64 bucket; asks differ per entry. One
+    # SHARED set of node tensors across the burst (the production shape:
+    # burst members solve against one mirror) — stacking is keyed on
+    # mirror identity.
+    base = _inputs(60, 33)
+    inputs = []
+    for i in range(4):
+        inp = dict(base)
+        inp["ask"] = jnp.array([60 + 10 * i, 128, 0, 0], dtype=jnp.int32)
+        inp["count"] = 33 + 5 * i
+        inputs.append(inp)
+    fetches = []
+    for inp in inputs:
+        engine.burst_begin()
+        fetches.append(_submit_exact(engine, inp))
+    results = [f() for f in fetches]
+    assert engine.dispatches == d0 + 1, "burst must land as one dispatch"
+    for inp, (idxs, oks) in zip(inputs, results):
+        d_idxs, d_oks = _direct_exact(inp)
+        np.testing.assert_array_equal(idxs, d_idxs)
+        np.testing.assert_array_equal(oks, d_oks)
+    with SOLVER_PANEL._lock:
+        row = SOLVER_PANEL._batch_widths.get(4)
+        prev = w0.get(4, [0, 0, 0.0])
+    assert row is not None and row[0] >= prev[0] + 1, (
+        "width-4 dispatch not recorded on the panel's batch-width axis"
+    )
+
+
+def test_exact_and_waterfill_entries_never_share_a_dispatch():
+    """Mixed-kind pending entries group by program family: a wf entry
+    and an exact entry in one drain dispatch separately, both correct."""
+    from nomad_tpu.ops.coalesce import _Entry
+
+    engine = CoalescingSolver()
+    wf_inp = _inputs(100, 300)
+    ex_inp = _inputs(80, 50)
+    entries = _entries([wf_inp])
+    from nomad_tpu.ops.binpack import bucket
+
+    entries.append(_Entry((
+        ex_inp["total"], ex_inp["sched_cap"], ex_inp["used0"],
+        ex_inp["job_count0"], ex_inp["tg_count0"], ex_inp["bw_avail"],
+        ex_inp["bw_used0"], ex_inp["eligible"], ex_inp["ask"],
+        ex_inp["bw_ask"], ex_inp["count"], ex_inp["penalty"],
+        False, False,
+    ), kind="exact", k=bucket(ex_inp["count"])))
+    d0 = engine.dispatches
+    engine._dispatch(entries)
+    assert engine.dispatches == d0 + 2
+    counts, unplaced = entries[0].result()
+    d_counts, d_unplaced = _direct(wf_inp)
+    np.testing.assert_array_equal(counts, d_counts)
+    assert unplaced == d_unplaced
+    idxs, oks = entries[1].result()
+    d_idxs, d_oks = _direct_exact(ex_inp)
+    np.testing.assert_array_equal(np.asarray(idxs)[: ex_inp["count"]],
+                                  d_idxs)
+    np.testing.assert_array_equal(np.asarray(oks)[: ex_inp["count"]],
+                                  d_oks)
+
+
+def test_warm_exact_batch_shapes_compiles():
+    from nomad_tpu.ops.coalesce import warm_exact_batch_shapes
+
+    # 2 count buckets x 3 widths at one node bucket.
+    assert warm_exact_batch_shapes(64, counts=(8, 16)) == 6
+
+
 def test_burst_generation_scopes_accounting():
     """A straggler from an earlier (given-up or over-announced) burst
     must not decrement a successor burst's expectation — member

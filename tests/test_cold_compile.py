@@ -137,8 +137,12 @@ def test_warm_shapes_compiles_cluster_buckets():
     counts = (1, 129)
     dispatches = tpu_solver.warm_shapes(snap, counts=counts)
     # Per node bucket: one dispatch per count, plus the coalesced
-    # eval-axis batch buckets (1, 2, 4, 8 — ops/coalesce.warm_batch_shapes).
-    assert dispatches == 2 * (len(counts) + 4)
+    # eval-axis batch buckets (1, 2, 4, 8 — ops/coalesce.warm_batch_shapes),
+    # plus the stacked exact-scan widths (2, 4, 8) per exact count bucket
+    # (ops/coalesce.warm_exact_batch_shapes — the cross-eval batching's
+    # third shape axis).
+    exact_buckets = len({bucket(c) for c in counts if c <= 128})
+    assert dispatches == 2 * (len(counts) + 4 + 3 * exact_buckets)
 
     # The warmed mirror is the one a real eval adopts (cache hit).
     hits0 = GLOBAL_MIRROR_CACHE.hits

@@ -886,16 +886,20 @@ def test_burst_mix_matches_serial(seed):
     n_nodes = 16
     asks = []
     for _ in range(int(rng.integers(3, 7))):
-        kind = rng.choice(["columnar", "exact", "system"])
+        kind = rng.choice(["columnar", "exact", "system", "equiv"])
         if kind == "columnar":
             count = int(rng.integers(129, 400))
         elif kind == "exact":
             count = int(rng.integers(1, 129))
+        elif kind == "equiv":
+            # 2-3 identical columnar task groups in ONE job: the
+            # equivalence-class collapse rides the burst too.
+            count = int(rng.integers(2, 4)) * 256
         else:
             count = None  # one per node
         asks.append((kind, count))
     # Small per-task ask so the whole mix always fits: worst case
-    # 6*399 tasks * 10cpu = 23940 <= 16 nodes * 4000 cpu.
+    # 6 jobs * max(399, 768) tasks * 10cpu <= 16 nodes * 4000 cpu.
     expected = sum(
         (n_nodes if kind == "system" else count) for kind, count in asks
     )
@@ -920,20 +924,36 @@ def test_burst_mix_matches_serial(seed):
                 nodes.append(node)
             jobs, evals = [], []
             for j, (kind, count) in enumerate(asks):
-                tg = TaskGroup(
-                    name="work", count=1 if kind == "system" else count,
-                    restart_policy=RestartPolicy(
-                        attempts=0, interval=600.0, delay=1.0,
-                    ),
-                    tasks=[Task(name="t", driver="exec",
-                                resources=Resources(cpu=10, memory_mb=16))],
-                )
+                if kind == "equiv":
+                    tgs = [
+                        TaskGroup(
+                            name=f"work{m}", count=256,
+                            restart_policy=RestartPolicy(
+                                attempts=0, interval=600.0, delay=1.0,
+                            ),
+                            tasks=[Task(
+                                name="t", driver="exec",
+                                resources=Resources(cpu=10,
+                                                    memory_mb=16))],
+                        )
+                        for m in range(count // 256)
+                    ]
+                else:
+                    tgs = [TaskGroup(
+                        name="work", count=1 if kind == "system" else count,
+                        restart_policy=RestartPolicy(
+                            attempts=0, interval=600.0, delay=1.0,
+                        ),
+                        tasks=[Task(
+                            name="t", driver="exec",
+                            resources=Resources(cpu=10, memory_mb=16))],
+                    )]
                 job = Job(
                     region="global", id=generate_uuid(),
                     name=f"bm-{j}-{kind}",
                     type=(structs.JOB_TYPE_SYSTEM if kind == "system"
                           else structs.JOB_TYPE_BATCH),
-                    priority=50, datacenters=["dc1"], task_groups=[tg],
+                    priority=50, datacenters=["dc1"], task_groups=tgs,
                 )
                 srv.raft.apply("job_register", {"job": job})
                 jobs.append(job)
@@ -982,6 +1002,233 @@ def test_burst_mix_matches_serial(seed):
     serial = run_mode(1)
     assert burst == serial, (seed, burst, serial)
     assert sum(burst.values()) == expected, (seed, burst, expected)
+
+
+# ---------------------------------------------------------------------------
+# 2f. Cross-eval batched exact solve: stacked dispatch ≡ individual solves
+# ---------------------------------------------------------------------------
+
+
+def _exact_cluster(rng, n):
+    """Shared node tensors for a stacked exact dispatch — one mirror's
+    (total, sched_cap, bw_avail), the identity the coalescer groups on."""
+    total = np.zeros((n, 4), dtype=np.int32)
+    total[:, 0] = rng.integers(200, 8000, n)
+    total[:, 1] = rng.integers(128, 16384, n)
+    total[:, 2] = rng.integers(1024, 200_000, n)
+    total[:, 3] = rng.integers(10, 300, n)
+    return (
+        jnp.asarray(total), jnp.asarray(total[:, :2].astype(np.float32)),
+        jnp.asarray(rng.integers(100, 2000, n).astype(np.int32)),
+        total,
+    )
+
+
+def _exact_entry_args(rng, n, cluster):
+    """One random exact-solve input set over the shared cluster: the
+    per-eval tensors (usage, eligibility, ask) vary, the node tensors
+    are the mirror's (shared objects, like burst members of one state
+    generation)."""
+    total_dev, sched_cap_dev, bw_avail_dev, total = cluster
+    used = (total * (rng.random((n, 1)) * 0.6)).astype(np.int32)
+    ask = np.array([
+        int(rng.integers(1, 1500)), int(rng.integers(1, 2048)),
+        int(rng.integers(0, 2000)), int(rng.integers(0, 50)),
+    ], dtype=np.int32)
+    count = int(rng.integers(1, 129))
+    return (
+        total_dev, sched_cap_dev,
+        jnp.asarray(used), jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        bw_avail_dev,
+        jnp.zeros((n,), jnp.int32),
+        jnp.asarray(rng.random(n) > 0.2),
+        jnp.asarray(ask), jnp.int32(int(rng.integers(0, 100))),
+        count, float(rng.choice([5.0, 10.0])), False, False,
+    )
+
+
+@pytest.mark.parametrize("seed", range(0, N_KERNEL_SEEDS, 4))
+def test_stacked_exact_dispatch_matches_individual(seed):
+    """The cross-eval batched exact scan (solve_greedy_batched through
+    the coalescer's stacked dispatch) must return BIT-IDENTICAL
+    (idxs, oks) to each entry's lone solve_greedy dispatch — the
+    decision-identity contract of ISSUE 14's batching. Heterogeneous
+    counts within one count bucket, heterogeneous asks/usage, padded
+    eval rows; mesh=1 (the default single-device fallback path)."""
+    from nomad_tpu.ops.binpack import bucket, solve_greedy
+    from nomad_tpu.ops.coalesce import CoalescingSolver, _Entry
+
+    rng = np.random.default_rng(130_000 + seed)
+    n = int(rng.choice([32, 64]))
+    cluster = _exact_cluster(rng, n)
+    k_target = None
+    entries = []
+    raw = []
+    # 2-7 entries of ONE count bucket (the dispatcher's grouping key),
+    # counts heterogeneous inside it.
+    width = int(rng.integers(2, 8))
+    while len(entries) < width:
+        args = _exact_entry_args(rng, n, cluster)
+        k = bucket(args[10])
+        if k_target is None:
+            k_target = k
+        elif k != k_target:
+            continue
+        raw.append(args)
+        entries.append(_Entry(args, kind="exact", k=k))
+    engine = CoalescingSolver()
+    d0 = engine.dispatches
+    engine._dispatch(list(entries))
+    assert engine.dispatches == d0 + 1, "one stacked dispatch expected"
+    for e, args in zip(entries, raw):
+        count = args[10]
+        idxs, oks = e.result()
+        active = jnp.arange(e.k) < count
+        ref_idxs, ref_oks, _ = solve_greedy(
+            *args[:10], active, jnp.float32(args[11]), e.k,
+            args[12], args[13],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(idxs), np.asarray(ref_idxs),
+            err_msg=f"seed {seed} idxs diverge",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(oks), np.asarray(ref_oks),
+            err_msg=f"seed {seed} oks diverge",
+        )
+
+
+@pytest.mark.parametrize("seed", range(0, N_SCHED_SEEDS, 6))
+def test_equiv_class_collapse_matches_combined(seed):
+    """Equivalence classes (Borg): a job of M identical columnar task
+    groups must (a) dispatch ONE counts-solve, (b) produce the same
+    per-node placement distribution as the single combined-count group
+    solved alone, (c) place every copy within capacity, and (d) leave
+    the per-member batches carrying the right name-index shares."""
+    from nomad_tpu.tpu.solver import SOLVER_PANEL
+
+    rng = np.random.default_rng(140_000 + seed)
+    n_nodes = int(rng.integers(8, 24))
+    members = int(rng.integers(2, 5))
+    count = int(rng.integers(256, 400))
+    cpu = int(rng.integers(4, 10))
+
+    def mk_nodes():
+        nodes = []
+        for i in range(n_nodes):
+            node = Node(
+                id=f"eq-{seed}-{i}", datacenter="dc1", name=f"n{i}",
+                attributes={"kernel.name": "linux", "driver.exec": "1"},
+                resources=Resources(cpu=14000, memory_mb=28000,
+                                    disk_mb=100_000, iops=1000),
+                status=structs.NODE_STATUS_READY,
+            )
+            nodes.append(node)
+        return nodes
+
+    def run(tg_counts):
+        h = Harness()
+        for node in mk_nodes():
+            h.state.upsert_node(h.next_index(), node)
+        tgs = [
+            TaskGroup(
+                name=f"g{j}", count=c,
+                restart_policy=RestartPolicy(attempts=0, interval=600.0,
+                                             delay=1.0),
+                tasks=[Task(name="t", driver="exec",
+                            resources=Resources(cpu=cpu, memory_mb=16))],
+            )
+            for j, c in enumerate(tg_counts)
+        ]
+        job = Job(
+            region="global", id=generate_uuid(), name=f"eqf-{seed}",
+            type=structs.JOB_TYPE_BATCH, priority=50,
+            datacenters=["dc1"], task_groups=tgs,
+        )
+        h.state.upsert_job(h.next_index(), job)
+        ev = Evaluation(
+            id=generate_uuid(), priority=50, type=job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        )
+        h.process("tpu-batch", ev)
+        assert len(h.plans) == 1
+        per_node: dict = {}
+        per_tg: dict = {}
+        for b in h.plans[0].alloc_batches:
+            per_tg[b.tg_name] = per_tg.get(b.tg_name, 0) + b.n
+            for nid, cnt in zip(b.node_ids, b.node_counts):
+                per_node[nid] = per_node.get(nid, 0) + int(cnt)
+        return h, per_node, per_tg
+
+    e0 = SOLVER_PANEL.equiv_classes
+    s0 = SOLVER_PANEL.solves
+    _h, per_node, per_tg = run([count] * members)
+    assert SOLVER_PANEL.equiv_classes == e0 + 1, "class did not collapse"
+    assert SOLVER_PANEL.solves == s0 + 1, "expected exactly one solve"
+    total = members * count
+    assert sum(per_tg.values()) == total, (seed, per_tg)
+    assert all(per_tg[f"g{j}"] == count for j in range(members)), per_tg
+    # The combined-count reference: one group of members*count copies.
+    _h2, per_node_ref, _ = run([total])
+    assert per_node == per_node_ref, (
+        seed, "class expansion changed the placement distribution",
+    )
+
+
+def test_equiv_class_interleaved_groups_do_not_collapse():
+    """Only CONSECUTIVE equivalent groups collapse: [A, B, A'] with
+    A ≡ A' but B different must solve as three rows — folding A' past B
+    would let A''s placements into the plan before B solves, changing
+    the usage view (anti-affinity job_count, plan deltas) the sequential
+    loop gives B. [A, A', B] collapses the adjacent pair."""
+    from nomad_tpu.tpu.solver import SOLVER_PANEL
+
+    def run(order):
+        h = Harness()
+        for i in range(16):
+            node = Node(
+                id=f"il-{i}", datacenter="dc1", name=f"n{i}",
+                attributes={"kernel.name": "linux", "driver.exec": "1"},
+                resources=Resources(cpu=14000, memory_mb=28000,
+                                    disk_mb=100_000, iops=1000),
+                status=structs.NODE_STATUS_READY,
+            )
+            h.state.upsert_node(h.next_index(), node)
+        tgs = []
+        for j, kind in enumerate(order):
+            cpu = 5 if kind == "A" else 9
+            tgs.append(TaskGroup(
+                name=f"g{j}", count=300,
+                restart_policy=RestartPolicy(attempts=0, interval=600.0,
+                                             delay=1.0),
+                tasks=[Task(name="t", driver="exec",
+                            resources=Resources(cpu=cpu, memory_mb=16))],
+            ))
+        job = Job(
+            region="global", id=generate_uuid(), name="il",
+            type=structs.JOB_TYPE_BATCH, priority=50,
+            datacenters=["dc1"], task_groups=tgs,
+        )
+        h.state.upsert_job(h.next_index(), job)
+        ev = Evaluation(
+            id=generate_uuid(), priority=50, type=job.type,
+            triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        )
+        s0 = SOLVER_PANEL.solves
+        c0 = SOLVER_PANEL.equiv_classes
+        h.process("tpu-batch", ev)
+        placed = sum(b.n for b in h.plans[0].alloc_batches)
+        return placed, SOLVER_PANEL.solves - s0, \
+            SOLVER_PANEL.equiv_classes - c0
+
+    placed, solves, classes = run(["A", "B", "A"])
+    assert placed == 900
+    assert solves == 3 and classes == 0, (solves, classes)
+
+    placed, solves, classes = run(["A", "A", "B"])
+    assert placed == 900
+    assert solves == 2 and classes == 1, (solves, classes)
 
 
 # ---------------------------------------------------------------------------

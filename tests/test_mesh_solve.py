@@ -216,6 +216,92 @@ def test_mesh_dispatch_guardrails(node_mesh):
     assert mesh_lib.STATS["replications"] <= 6, mesh_lib.STATS
 
 
+def test_apply_solver_mesh_fallback_and_configure():
+    """The server-config face: a mesh the local device set can't satisfy
+    falls back transparently (None, solves stay single-device); a
+    satisfiable one configures and is indistinguishable from the env/
+    explicit path."""
+    from nomad_tpu.parallel.mesh import SolverMeshConfig
+
+    cfg = mesh_lib.SolverMeshConfig.parse({"node_shards": 1024})
+    assert mesh_lib.apply_solver_mesh(cfg) is None
+    assert mesh_lib.node_sharding_mesh() is None
+
+    cfg = SolverMeshConfig.parse({"node_shards": 4, "eval_parallel": 2})
+    mesh = mesh_lib.apply_solver_mesh(cfg)
+    try:
+        assert mesh is not None
+        assert mesh.shape[mesh_lib.NODE_AXIS] == 4
+        assert mesh.shape[mesh_lib.EVAL_AXIS] == 2
+        assert mesh_lib.node_sharding_mesh() is mesh
+    finally:
+        mesh_lib.clear_node_sharding()
+
+    # Disabled spec: no-op.
+    assert mesh_lib.apply_solver_mesh(SolverMeshConfig.parse(None)) is None
+
+
+def test_sharded_mirror_delta_roll_keeps_node_sharding(node_mesh):
+    """The mesh-aware _rows_update: rolling a sharded mirror forward
+    through a node write must leave the patched buffers NODE_AXIS-
+    sharded (out_shardings-pinned scatter) — a roll that let the output
+    sharding float would cost every later solve a full-axis reshard."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.tpu.mirror import MirrorCache
+
+    store = StateStore()
+    nodes = []
+    for i in range(12):
+        n = mock.node()
+        n.id = f"roll-{i:02d}"
+        store.upsert_node(i + 1, n)
+        nodes.append(n)
+    cache = MirrorCache()
+    snap0 = store.snapshot()
+    _n0, m0 = cache.get(snap0, ["dc1"])
+    want = NamedSharding(node_mesh, P(mesh_lib.NODE_AXIS, None))
+    assert m0.total.sharding == want
+
+    # Resource-only rewrite of one resident node: the delta path.
+    import copy
+
+    n2 = copy.deepcopy(nodes[3])
+    n2.resources.cpu += 111
+    store.upsert_node(100, n2)
+    rolls0 = cache.delta_rolls
+    _n1, m1 = cache.get(store.snapshot(), ["dc1"])
+    assert cache.delta_rolls == rolls0 + 1, "write did not take the roll"
+    assert m1.total.sharding == want, "roll dropped the node sharding"
+    assert m1.sched_cap.sharding == NamedSharding(
+        node_mesh, P(mesh_lib.NODE_AXIS, None))
+    assert m1.bw_avail.sharding == NamedSharding(
+        node_mesh, P(mesh_lib.NODE_AXIS))
+    # And the rolled row actually carries the write.
+    row = m1.index["roll-03"]
+    assert int(np.asarray(m1.total)[row, 0]) == n2.resources.cpu
+
+
+def test_stacked_exact_dispatch_on_mesh_matches_single_device(node_mesh):
+    """The cross-eval batched exact scan runs SPMD too: stacked entries
+    through the coalescer on the mesh match their single-device solves
+    bit-for-bit."""
+    import test_coalesce as tc
+    from nomad_tpu.ops.coalesce import CoalescingSolver
+
+    engine = CoalescingSolver()
+    inputs = [tc._inputs(50 + 10 * i, 20 + 7 * i) for i in range(4)]
+    expected = [tc._direct_exact(inp) for inp in inputs]
+    fetches = [tc._submit_exact(engine, inp) for inp in inputs]
+    for (idxs, oks), (e_idxs, e_oks) in zip(
+        [f() for f in fetches], expected
+    ):
+        np.testing.assert_array_equal(idxs, e_idxs)
+        np.testing.assert_array_equal(oks, e_oks)
+
+
 def test_mesh_dispatch_count_bounded_for_concurrent_evals(node_mesh):
     """Concurrent solves on the mesh stay correct and bounded: K submits
     cost at most K dispatches (coalescing may merge them into fewer), each

@@ -234,6 +234,39 @@ def test_steady_1k_smoke(tmp_path):
     assert art["heartbeat"]["scheduled_renewals_per_sec"] > 0
 
 
+def test_steady_100k_nodes_registered():
+    """The 100k-node scenario is registered with the intended shape (the
+    run itself is a bank-time event — tools/simload.py — not a tier-1
+    test: registration alone takes ~30s)."""
+    spec = SCENARIOS["steady-100k-nodes"]
+    assert spec.n_nodes == 100_000
+    assert spec.deterministic is True
+    injectors = spec.injectors(42)
+    assert len(injectors) == 1
+    # Same workload shape as steady-10k: the node axis is the variable.
+    actions = injectors[0].actions()
+    assert len(actions) == 24
+    # TTLs sized so no beat comes due inside the run at 100k.
+    assert spec.server_overrides["max_heartbeats_per_second"] == 10.0
+
+
+def test_steady_smoke_batch_width_and_equiv_sections(tmp_path):
+    """The artifact's solver_panel window carries the new batch-width
+    and equivalence-class axes (present even when zero — consumers diff
+    them across rounds)."""
+    out = tmp_path / "SIMLOAD_steady-1k_panel.json"
+    art = run_scenario("steady-1k", seed=11, out_path=str(out))
+    window = art["solver_panel"]["window"]
+    assert "batch_widths" in window
+    assert set(window["equiv"]) == {"classes", "members", "copies",
+                                    "rows_saved"}
+    # The steady smoke's 6 concurrent service evals ride the coalescer:
+    # at least one dispatch recorded on the width axis.
+    assert sum(
+        row["dispatches"] for row in window["batch_widths"].values()
+    ) >= 1
+
+
 def test_overdrive_1k_smoke(tmp_path):
     """The impolite front door at smoke scale: 6 clients blast 8 batch
     jobs each with no self-throttling; admission rate lanes (burst 2,

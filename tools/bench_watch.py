@@ -357,11 +357,49 @@ def slo_gate_absolute(new_artifact: dict,
     return {"ok": ok, "tolerance": None, "checks": checks}
 
 
+# Solver-economy gate tolerance: the panel's device-time-per-placement
+# is box-noise-sensitive (rider-attributed walls under coalescing), so
+# the regression bar is deliberately loose — it exists to catch a real
+# batching/padding regression (2x-class), not scheduler jitter.
+SOLVER_GATE_TOLERANCE = 0.5
+
+
+def solver_gate(new_artifact: dict, baseline_artifact: dict,
+                tolerance: float = SOLVER_GATE_TOLERANCE) -> dict | None:
+    """Gate the solver panel's measured-window economy newest-vs-
+    previous: FAIL when device-time-per-placement worsened more than
+    ``tolerance`` relative. Also reports the batch-width histogram and
+    the amortized per-eval device wall (the cross-eval batching win) so
+    a gate log shows WHERE a regression came from. None when either
+    artifact predates the solver_panel window section."""
+    new_w = (new_artifact.get("solver_panel") or {}).get("window") or {}
+    base_w = (baseline_artifact.get("solver_panel") or {}).get(
+        "window") or {}
+    new_v = new_w.get("device_ms_per_placement")
+    base_v = base_w.get("device_ms_per_placement")
+    # `is None`, not truthiness: a legitimate 0.0 baseline (sub-precision
+    # walls) must keep the gate armed, not read as a pre-panel artifact.
+    if new_v is None or base_v is None:
+        return None
+    if not base_v:
+        base_v = 1e-9  # zero baseline: any measurable cost is a regression
+    regressed = new_v > base_v * (1.0 + tolerance)
+    return {
+        "ok": not regressed,
+        "tolerance": tolerance,
+        "device_ms_per_placement": new_v,
+        "baseline_ms_per_placement": base_v,
+        "batch_widths": new_w.get("batch_widths"),
+        "equiv": new_w.get("equiv"),
+    }
+
+
 def slo_gate_scan(log=log) -> bool:
     """Run the SLO gate over every banked artifact family: newest-vs-
     previous where a prior round exists, absolute-against-objectives for
-    first-round families; log one verdict per family. Returns overall
-    pass."""
+    first-round families; log one verdict per family. Families whose
+    artifacts carry the solver-panel window additionally gate on the
+    device-solve economy (solver_gate). Returns overall pass."""
     ok = True
     for fam, new_path, base_path in _banked_simload_pairs():
         try:
@@ -370,10 +408,12 @@ def slo_gate_scan(log=log) -> bool:
             objectives = _objectives_for(new)
             if base_path is None:
                 verdict = slo_gate_absolute(new, objectives)
+                solver_verdict = None
             else:
                 with open(base_path) as f:
                     base = json.load(f)
                 verdict = slo_gate(new, base, objectives)
+                solver_verdict = solver_gate(new, base)
         except (OSError, ValueError, KeyError) as e:
             log("slo-gate-error", family=fam, error=str(e))
             ok = False
@@ -386,6 +426,12 @@ def slo_gate_scan(log=log) -> bool:
             regressed=[c["objective"] for c in verdict["checks"]
                        if c["regressed"]])
         ok = ok and verdict["ok"]
+        if solver_verdict is not None:
+            log("solver-gate", family=fam, ok=solver_verdict["ok"],
+                device_ms_per_placement=solver_verdict[
+                    "device_ms_per_placement"],
+                baseline=solver_verdict["baseline_ms_per_placement"])
+            ok = ok and solver_verdict["ok"]
     return ok
 
 
