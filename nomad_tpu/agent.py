@@ -53,6 +53,9 @@ class AgentConfig:
     # Capacity observatory spec (nomad_tpu/capacity.py): None = defaults
     # (enabled; set {"enabled": False} to turn the accountant off).
     capacity: Optional[Dict] = None
+    # Raft & recovery observatory spec (nomad_tpu/raft_observe.py):
+    # None = defaults (enabled).
+    raft_observe: Optional[Dict] = None
     # Solver device mesh spec (nomad_tpu/parallel/mesh.py): None =
     # single-device solves.
     solver_mesh: Optional[Dict] = None
@@ -147,6 +150,8 @@ class AgentConfig:
                      if fc.server.express is not None else None),
             capacity=(dict(fc.server.capacity)
                       if fc.server.capacity is not None else None),
+            raft_observe=(dict(fc.server.raft_observe)
+                          if fc.server.raft_observe is not None else None),
             solver_mesh=(dict(fc.server.solver_mesh)
                          if fc.server.solver_mesh is not None else None),
             enable_debug=fc.enable_debug,
@@ -244,6 +249,8 @@ class Agent:
                      if self.config.express is not None else None),
             capacity=(dict(self.config.capacity)
                       if self.config.capacity is not None else None),
+            raft_observe=(dict(self.config.raft_observe)
+                          if self.config.raft_observe is not None else None),
             solver_mesh=(dict(self.config.solver_mesh)
                          if self.config.solver_mesh is not None else None),
         )

@@ -106,6 +106,10 @@ class ServerBlock:
     # /v1/agent/capacity (poll/event cadence, reference shapes for the
     # stranded-capacity yardstick). None = defaults (enabled).
     capacity: Optional[Dict[str, object]] = None
+    # Raft & recovery observatory (nomad_tpu/raft_observe.py): the
+    # ``raft_observe { }`` sub-block tunes the read-only observer behind
+    # /v1/agent/raft (poll/event cadence). None = defaults (enabled).
+    raft_observe: Optional[Dict[str, object]] = None
     # Solver device mesh (nomad_tpu/parallel/mesh.py): the
     # ``solver_mesh { }`` sub-block shards the node axis of every device
     # solve over a JAX mesh — ``node_shards`` devices per eval row,
@@ -308,6 +312,15 @@ class FileConfig:
                 else other.server.capacity if self.server.capacity is None
                 else {**self.server.capacity, **other.server.capacity}
             ),
+            # Raft-observatory knobs merge key-by-key like capacity.
+            raft_observe=(
+                self.server.raft_observe
+                if other.server.raft_observe is None
+                else other.server.raft_observe
+                if self.server.raft_observe is None
+                else {**self.server.raft_observe,
+                      **other.server.raft_observe}
+            ),
             # Solver-mesh knobs merge key-by-key like the blocks above.
             solver_mesh=(
                 self.server.solver_mesh if other.server.solver_mesh is None
@@ -504,6 +517,16 @@ def _from_mapping(data: dict) -> FileConfig:
 
                     CapacityConfig.parse(dict(v))
                     cfg.server.capacity = dict(v)
+                elif k == "raft_observe":
+                    if not isinstance(v, dict):
+                        raise ValueError(
+                            "server.raft_observe must be a mapping")
+                    # Same posture: a typo'd observatory knob fails
+                    # config load (RaftObserveConfig.parse), not start.
+                    from nomad_tpu.raft_observe import RaftObserveConfig
+
+                    RaftObserveConfig.parse(dict(v))
+                    cfg.server.raft_observe = dict(v)
                 elif k == "solver_mesh":
                     if not isinstance(v, dict):
                         raise ValueError(

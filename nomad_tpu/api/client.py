@@ -432,6 +432,14 @@ class AgentApi:
         out, _ = self.client.query("/v1/agent/solver")
         return out
 
+    def raft(self) -> Dict:
+        """Raft & recovery observatory state (/v1/agent/raft):
+        write-path stage attribution per msg_type, per-follower lag,
+        log/snapshot economy, and the restart-replay recovery timeline
+        (nomad_tpu/raft_observe.py)."""
+        out, _ = self.client.query("/v1/agent/raft")
+        return out
+
     def traces(self, n: int = 0) -> List[Dict]:
         """Retained trace summaries (/v1/agent/traces), newest first;
         ``n`` limits (0 = all retained)."""

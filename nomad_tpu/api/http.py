@@ -240,6 +240,7 @@ class HTTPServer:
             (r"^/v1/agent/admission$", self.agent_admission),
             (r"^/v1/agent/express$", self.agent_express),
             (r"^/v1/agent/capacity$", self.agent_capacity),
+            (r"^/v1/agent/raft$", self.agent_raft),
             (r"^/v1/agent/solver$", self.agent_solver),
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
@@ -800,6 +801,109 @@ class HTTPServer:
             ), None
         return acct.snapshot(), None
 
+    def agent_raft(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Raft & recovery observatory state (nomad_tpu/raft_observe.py):
+        write-path stage attribution per msg_type (p50/p95/p99 +
+        bytes-per-entry), per-follower lag, commit-advance rate, the
+        log/snapshot economy, and the restart-replay recovery timeline.
+        ``?format=prometheus`` serves just the raft families as text
+        exposition. The handler drains the raft node's books before
+        answering, so the body reflects the node NOW, not the last poll
+        tick — still read-only (the drain consumes the same bounded
+        ring the poll does)."""
+        obs = self._raft_observatory()
+        if obs is None:
+            raise HTTPCodedError(404, "raft observatory not running "
+                                      "(no server, or raft_observe "
+                                      "{ enabled = false })")
+        obs.refresh()
+        if query.get("format") == "prometheus":
+            b = telemetry.PromText()
+            self._raft_prometheus(b)
+            return RawResponse(
+                b.text().encode(), "text/plain; version=0.0.4"
+            ), None
+        return obs.snapshot(), None
+
+    def _raft_observatory(self):
+        """The server's raft observatory, or None (no server / disabled)
+        — the metrics endpoint must answer on a client-only agent too."""
+        server = getattr(self.agent, "server", None)
+        obs = getattr(server, "raft_observatory", None)
+        if obs is None or not obs.config.enabled:
+            return None
+        return obs
+
+    def _raft_summary(self) -> Optional[Dict[str, Any]]:
+        obs = self._raft_observatory()
+        return obs.summary() if obs is not None else None
+
+    def _raft_prometheus(self, b: "telemetry.PromText") -> None:
+        """Raft observatory: replication-state and log-economy gauges,
+        append/compaction counters, per-follower lag, and the write-path
+        quantiles per msg_type (submit→applied total + per-stage p95)."""
+        obs = self._raft_observatory()
+        if obs is None:
+            return
+        snap = obs.snapshot()
+        core = snap["raft"]
+        for k in ("commit_index", "applied_index", "last_log_index",
+                  "inflight_writes"):
+            if k in core:
+                b.gauge(f"nomad_raft_{k}", core[k])
+        for k in ("commit_advances",):
+            if k in core:
+                b.counter(f"nomad_raft_{k}_total", core[k])
+        log = snap["log"]
+        if log:
+            b.gauge("nomad_raft_log_entries", log["entries"])
+            b.gauge("nomad_raft_log_bytes", log["bytes"])
+            b.counter("nomad_raft_entries_appended_total",
+                      log["appended_entries"])
+            b.counter("nomad_raft_bytes_appended_total",
+                      log["appended_bytes"])
+            b.counter("nomad_raft_entries_truncated_total",
+                      log["truncated_entries"])
+        snapshot = snap["snapshot"]
+        if snapshot:
+            b.gauge("nomad_raft_snapshot_index", snapshot["index"])
+            b.gauge("nomad_raft_snapshot_bytes", snapshot["last_bytes"])
+            b.gauge("nomad_raft_snapshot_disk_bytes",
+                    snapshot["disk_bytes"])
+            b.counter("nomad_raft_compactions_total",
+                      snapshot["compactions"])
+            b.counter("nomad_raft_compaction_wall_ms_total",
+                      snapshot["compaction_wall_ms"])
+            b.counter("nomad_raft_snapshot_installs_total",
+                      snapshot["installs_received"])
+        b.gauge("nomad_raft_commit_advance_entries_per_s",
+                snap["replication"]["commit_advance"]["entries_per_s"])
+        for pid, peer in snap["replication"]["peers"].items():
+            b.gauge("nomad_raft_peer_lag_entries", peer["lag_entries"],
+                    labels={"peer": pid})
+            if peer.get("last_ack_age_s") is not None:
+                b.gauge("nomad_raft_peer_ack_age_seconds",
+                        peer["last_ack_age_s"], labels={"peer": pid})
+        for msg_type, books in snap["write_path"].items():
+            b.counter("nomad_raft_write_entries_total", books["count"],
+                      labels={"msg_type": msg_type})
+            b.counter("nomad_raft_write_bytes_total",
+                      books["bytes_total"], labels={"msg_type": msg_type})
+            for q in ("p50", "p95", "p99"):
+                b.gauge("nomad_raft_write_ms", books["total_ms"][q],
+                        labels={"msg_type": msg_type, "quantile": q})
+            for stage, agg in books["stages_ms"].items():
+                b.gauge("nomad_raft_write_stage_p95_ms", agg["p95"],
+                        labels={"msg_type": msg_type, "stage": stage})
+        recovery = snap["recovery"]
+        if recovery.get("cold_start"):
+            b.gauge("nomad_raft_recovery_entries_replayed",
+                    recovery.get("entries_replayed", 0))
+            for k in ("snapshot_restore_ms", "replay_wall_ms",
+                      "time_to_leader_ms", "time_to_serving_ms"):
+                if recovery.get(k) is not None:
+                    b.gauge(f"nomad_raft_recovery_{k}", recovery[k])
+
     def agent_solver(self, req, query) -> Tuple[Any, Optional[int]]:
         """Device-solve efficiency panel (tpu/solver.py SOLVER_PANEL):
         per-solve padding economy, bucket-occupancy histograms,
@@ -856,6 +960,7 @@ class HTTPServer:
             self._admission_prometheus(b)
             self._express_prometheus(b)
             self._capacity_prometheus(b)
+            self._raft_prometheus(b)
             _solver_prometheus(b)
             return RawResponse(
                 (telemetry.prometheus_text(sink) + b.text()).encode(),
@@ -867,6 +972,7 @@ class HTTPServer:
                 "admission": self._admission_stats(),
                 "express": self._express_stats(),
                 "capacity": self._capacity_summary(),
+                "raft": self._raft_summary(),
                 "solver_panel": _solver_panel_stats(),
                 "trace": trace.get_tracer().stats()}, None
 

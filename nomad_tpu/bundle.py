@@ -67,7 +67,8 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
     "faults", "breaker", "mirror", "plan_pipeline", "slo", "admission",
-    "express", "capacity", "solver", "timelines", "nomadlint", "threads",
+    "express", "capacity", "raft", "solver", "timelines", "nomadlint",
+    "threads",
 )
 
 # Every `python -m tools.nomadlint` run writes its full report here; the
@@ -229,6 +230,20 @@ def _capacity_section(agent) -> Optional[Dict[str, Any]]:
     return acct.snapshot()
 
 
+def _raft_section(agent) -> Optional[Dict[str, Any]]:
+    """Raft & recovery observatory snapshot (nomad_tpu/raft_observe.py):
+    a postmortem bundle must carry the replicated write path's books —
+    per-entry stage costs, follower lag, log/snapshot economy, and
+    whether (and how fast) this process recovered from a cold restart.
+    None without a server or with the observatory disabled."""
+    server = getattr(agent, "server", None) if agent is not None else None
+    obs = getattr(server, "raft_observatory", None)
+    if obs is None or not obs.config.enabled:
+        return None
+    obs.refresh()
+    return obs.snapshot()
+
+
 def _solver_section() -> Dict[str, Any]:
     """Device-solve efficiency panel (tpu/solver.py SOLVER_PANEL):
     padding economy, bucket occupancy, compile attribution — next to the
@@ -295,6 +310,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "admission": None,
         "express": None,
         "capacity": None,
+        "raft": None,
         "solver": None,
         "timelines": [],
         "nomadlint": None,
@@ -312,6 +328,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("admission", lambda: _admission_section(agent)),
         ("express", lambda: _express_section(agent)),
         ("capacity", lambda: _capacity_section(agent)),
+        ("raft", lambda: _raft_section(agent)),
         ("solver", _solver_section),
         ("timelines", lambda: _timelines_section(agent, last_events)),
         ("nomadlint", _nomadlint_section),

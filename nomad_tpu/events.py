@@ -55,6 +55,9 @@ Capacity   CapacitySnapshot (fixed key "capacity"; OBSERVER topic — the
            snapshots, published on a wall-clock cadence and therefore
            excluded from the canonical determinism digest, see
            OBSERVER_TOPICS)
+Raft       RaftSnapshot (fixed key "raft"; OBSERVER topic like Capacity
+           — the raft observatory's periodic replication/log-economy
+           snapshots, nomad_tpu/raft_observe.py)
 =========  ==============================================================
 
 Blocking consumption reuses the state store's watch registry
@@ -84,7 +87,7 @@ ITEM_ANY: WatchItem = ("events", "_any_")
 # construction: how many ticks a run's wall time fits is scheduling
 # noise, and an observer being ON vs OFF must be digest-invariant — the
 # observatory's decision-invariance proof depends on exactly that.
-OBSERVER_TOPICS = frozenset({"Capacity"})
+OBSERVER_TOPICS = frozenset({"Capacity", "Raft"})
 
 
 def item_topic(topic: str) -> WatchItem:

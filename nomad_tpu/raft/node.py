@@ -39,6 +39,7 @@ import os
 import random
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -89,12 +90,20 @@ class _Entry:
     term: int
     msg_type: str
     payload: dict  # encoded (wire) form
+    # Serialized (wire/journal) size; 0 when never measured. Kept on the
+    # entry so the log's byte economy (raft_observe.py) is a cheap sum,
+    # not a re-serialization per poll.
+    wire_bytes: int = 0
 
     def to_wire(self) -> dict:
         return {"term": self.term, "type": self.msg_type, "payload": self.payload}
 
     @staticmethod
     def from_wire(d: dict) -> "_Entry":
+        # wire_bytes is stamped by the caller where it is cheap to know
+        # (the journal line's length at load, one dumps per ACTUALLY
+        # APPENDED entry on the follower path) — measuring here would
+        # also charge re-sent entries that never append.
         return _Entry(d["term"], d["type"], d["payload"])
 
 
@@ -169,6 +178,48 @@ class RaftNode:
         self._replicate_now = threading.Event()
         self.on_leadership_change: Optional[Callable[[bool], None]] = None
 
+        # -- observability books (plain data, mutated under _lock; read
+        # by nomad_tpu/raft_observe.py — this module never imports the
+        # observer, the OBS001 direction) -------------------------------
+        # Per-entry write-path anchor records: index -> open record with
+        # monotonic stamps (submit/persisted/first_ack/committed/
+        # fsm_start/fsm_end/resolved); finalized records move to a
+        # bounded ring the observatory drains by sequence number.
+        self._wp_open: Dict[int, dict] = {}
+        self._wp_done: "deque" = deque(maxlen=1024)
+        self._wp_seq = 0
+        self._peer_ack_at: Dict[str, float] = {}
+        self.commit_advances = 0
+        self.entries_appended = 0
+        self.bytes_appended = 0
+        self.entries_truncated = 0
+        self.compactions = 0
+        self.compaction_wall_ms = 0.0
+        self.snapshot_persist_ms = 0.0
+        self.snapshot_last_bytes = 0
+        self.snapshot_disk_bytes = 0
+        self.snapshots_installed = 0
+        self.snapshots_sent = 0
+        # Restart-replay timeline: populated by _load_persistent (cold
+        # start), advanced by the replaying applies, closed out by
+        # leadership + mark_serving(). All ms fields are relative to
+        # construction time.
+        self._recovery_t0 = time.monotonic()
+        self._replay_started: Optional[float] = None
+        self.recovery: Dict[str, Any] = {
+            "cold_start": False,
+            "snapshot_restore_ms": 0.0,
+            "snapshot_index": 0,
+            "snapshot_bytes": 0,
+            "log_entries_loaded": 0,
+            "replay_target": 0,
+            "entries_replayed": 0,
+            "replayed_by_type": {},
+            "replay_wall_ms": None,
+            "time_to_leader_ms": None,
+            "time_to_serving_ms": None,
+        }
+
         self._load_persistent()
         rpc.register("Raft.RequestVote", self._handle_request_vote)
         rpc.register("Raft.AppendEntries", self._handle_append_entries)
@@ -218,6 +269,7 @@ class RaftNode:
         """Append + replicate + commit + FSM-apply. Resolves with the log
         index; raises NotLeaderError through the future on followers."""
         future: Future = Future()
+        t_submit = time.monotonic()
         with self._lock:
             if self.role != LEADER:
                 future.set_exception(NotLeaderError(self.leader_addr))
@@ -228,7 +280,27 @@ class RaftNode:
             self.log.append(entry)
             index = self.log_offset + len(self.log)
             self._apply_futures[index] = future
-            self._persist_entry(index, entry)
+            # Serialize ONCE: the journal line doubles as the entry's
+            # byte measurement (in-memory mode pays the same dumps the
+            # durable mode always paid — measurement, not decisions).
+            line = json.dumps({"index": index, **entry.to_wire()})
+            entry.wire_bytes = len(line)
+            self._persist_entry_line(line)
+            self.entries_appended += 1
+            self.bytes_appended += entry.wire_bytes
+            self._wp_open[index] = {
+                "index": index,
+                "msg_type": msg_type,
+                "bytes": entry.wire_bytes,
+                "anchors": {"submit": t_submit,
+                            "persisted": time.monotonic()},
+            }
+            if len(self._wp_open) > 4096:
+                # Bound the open table: a stalled commit must not grow it
+                # unboundedly. Insertion order is index order, so the
+                # first key IS the oldest record — O(1), no key scan
+                # under the lock exactly when the leader is struggling.
+                self._wp_open.pop(next(iter(self._wp_open)))
             if len(self.config.peers) == 1:
                 self._advance_commit_locked()
         self._replicate_now.set()
@@ -310,6 +382,92 @@ class RaftNode:
                 "num_peers": len(self.config.peers) - 1,
             }
 
+    # -- observability surface (read by nomad_tpu/raft_observe.py) -----------
+
+    def mark_serving(self) -> None:
+        """Close the recovery timeline: leadership is established and the
+        broker restored — the node serves again. Called by the cluster
+        layer's establish-leadership path; idempotent (first call
+        wins)."""
+        with self._lock:
+            if self.recovery["time_to_serving_ms"] is None:
+                self.recovery["time_to_serving_ms"] = round(
+                    (time.monotonic() - self._recovery_t0) * 1000.0, 3
+                )
+
+    def write_path_records(self, since: int):
+        """(sequence, finalized write-path records newer than ``since``)
+        — the raft observatory's drain. Records fall off the bounded
+        ring; the sequence gap tells the consumer exactly how many it
+        missed (counted there, never silent)."""
+        with self._lock:
+            seq = self._wp_seq
+            n = seq - int(since)
+            if n <= 0:
+                return seq, []
+            n = min(n, len(self._wp_done))
+            return seq, list(self._wp_done)[-n:]
+
+    def observe_stats(self) -> Dict[str, Any]:
+        """One locked read of the replication/log/snapshot books (plain
+        data for nomad_tpu/raft_observe.py — per-follower lag, log byte
+        economy, compaction counters). Disk sizes are point-in-time
+        stamps taken at write, so no I/O happens under the lock."""
+        with self._lock:
+            now = time.monotonic()
+            last_idx = self.log_offset + len(self.log)
+            peers = {}
+            for pid in sorted(self._other_peers()):
+                match = self.match_index.get(pid, 0)
+                ack = self._peer_ack_at.get(pid)
+                peers[pid] = {
+                    "match_index": match,
+                    "next_index": self.next_index.get(pid, 0),
+                    "lag_entries": max(last_idx - match, 0),
+                    "last_ack_age_s": (
+                        round(now - ack, 3) if ack is not None else None
+                    ),
+                }
+            return {
+                "node_id": self.config.node_id,
+                "state": self.role,
+                "term": self.current_term,
+                "leader_id": self.leader_id,
+                "commit_index": self.commit_index,
+                "applied_index": self.last_applied,
+                "last_log_index": last_idx,
+                "commit_advances": self.commit_advances,
+                "inflight_writes": len(self._wp_open),
+                "peers": peers,
+                "log": {
+                    "entries": len(self.log),
+                    "bytes": sum(e.wire_bytes for e in self.log),
+                    "offset": self.log_offset,
+                    "appended_entries": self.entries_appended,
+                    "appended_bytes": self.bytes_appended,
+                    "truncated_entries": self.entries_truncated,
+                    # The trailing_logs economy: entries kept IN the log
+                    # although the snapshot already covers them, so
+                    # slightly-lagging followers replicate normally.
+                    "retained_below_snapshot": max(
+                        self.snapshot_index - self.log_offset, 0
+                    ),
+                },
+                "snapshot": {
+                    "index": self.snapshot_index,
+                    "term": self.snapshot_term,
+                    "threshold": self.config.snapshot_threshold,
+                    "trailing_logs": self.config.trailing_logs,
+                    "compactions": self.compactions,
+                    "compaction_wall_ms": round(self.compaction_wall_ms, 3),
+                    "persist_wall_ms": round(self.snapshot_persist_ms, 3),
+                    "last_bytes": self.snapshot_last_bytes,
+                    "disk_bytes": self.snapshot_disk_bytes,
+                    "installs_received": self.snapshots_installed,
+                    "installs_sent": self.snapshots_sent,
+                },
+            }
+
     # -- persistence --------------------------------------------------------
 
     def _paths(self) -> Tuple[str, str]:
@@ -328,12 +486,15 @@ class RaftNode:
              "peers": dict(self.config.peers)}
         ))
 
-    def _persist_entry(self, index: int, entry: _Entry) -> None:
+    def _persist_entry_line(self, line: str) -> None:
+        """Append one pre-serialized journal line (apply() builds the
+        line once so the byte measurement and the journal share one
+        dumps)."""
         if not self.config.data_dir:
             return
         _, log_path = self._paths()
         with open(log_path, "a") as f:
-            f.write(json.dumps({"index": index, **entry.to_wire()}) + "\n")
+            f.write(line + "\n")
 
     def _truncate_persisted_log(self) -> None:
         if not self.config.data_dir:
@@ -350,8 +511,10 @@ class RaftNode:
     def _write_snapshot_file(self, index: int, term: int, data: bytes) -> None:
         """Write a snapshot to disk, retaining the newest
         ``snapshot_retain`` files (raft.FileSnapshotStore, server.go:453)."""
+        self.snapshot_last_bytes = len(data)
         if not self.config.data_dir:
             return
+        t0 = time.monotonic()
         path = self._snap_path(index)
         _atomic_write(path, json.dumps({
             "index": index,
@@ -359,6 +522,11 @@ class RaftNode:
             "data": base64.b64encode(data).decode("ascii"),
         }))
         self._prune_snapshots()
+        self.snapshot_persist_ms += (time.monotonic() - t0) * 1000.0
+        try:
+            self.snapshot_disk_bytes = os.path.getsize(path)
+        except OSError:
+            pass
 
     def _prune_snapshots(self) -> None:
         snaps = sorted(glob.glob(
@@ -398,7 +566,13 @@ class RaftNode:
                 with open(path) as f:
                     snap = json.load(f)
                 data = base64.b64decode(snap["data"])
+                t_restore0 = time.monotonic()
                 self.fsm.restore_bytes(data)
+                self.recovery["snapshot_restore_ms"] = round(
+                    (time.monotonic() - t_restore0) * 1000.0, 3
+                )
+                self.recovery["snapshot_index"] = snap["index"]
+                self.recovery["snapshot_bytes"] = len(data)
             except Exception:
                 # Restore failures of ANY kind fall through to the older
                 # retained copy (that is what retain=2 is for) — but a
@@ -435,9 +609,26 @@ class RaftNode:
                             d["index"], self.log_offset + len(self.log) + 1,
                         )
                         break
-                    self.log.append(_Entry.from_wire(d))
+                    entry = _Entry.from_wire(d)
+                    # The journal line's own length IS the byte measure
+                    # (the convention apply() stamps) — no re-dump on
+                    # the cold-start path the recovery timeline clocks.
+                    entry.wire_bytes = len(line.rstrip("\n"))
+                    self.log.append(entry)
         except (OSError, ValueError):
             pass
+        # Close out the recovery bookkeeping for this load: the tail past
+        # last_applied is what leadership (or the next leader's commit
+        # advance) will REPLAY into the FSM; an empty tail means replay
+        # is already done (wall 0), and a warm start (no durable state)
+        # leaves the whole record inert.
+        self.recovery["log_entries_loaded"] = len(self.log)
+        self.recovery["replay_target"] = self.log_offset + len(self.log)
+        self.recovery["cold_start"] = bool(
+            self.recovery["snapshot_index"] or self.log
+        )
+        if self.recovery["replay_target"] <= self.last_applied:
+            self.recovery["replay_wall_ms"] = 0.0
 
     # -- helpers ------------------------------------------------------------
 
@@ -488,6 +679,10 @@ class RaftNode:
             if not future.done():
                 future.set_exception(NotLeaderError(self.leader_addr))
         self._apply_futures.clear()
+        # Open write-path records belong to the deposed leadership: the
+        # entries may still commit under the new leader, but this node
+        # can no longer attribute their submit→applied path honestly.
+        self._wp_open.clear()
 
     # -- election (paper §5.2) ----------------------------------------------
 
@@ -580,6 +775,10 @@ class RaftNode:
                 "raft: node %s won election for term %d",
                 self.config.node_id, term,
             )
+            if self.recovery["time_to_leader_ms"] is None:
+                self.recovery["time_to_leader_ms"] = round(
+                    (time.monotonic() - self._recovery_t0) * 1000.0, 3
+                )
         # Commit a no-op immediately: a leader may only count replicas for
         # current-term entries (paper §5.4.2), so this is what commits any
         # prior-term tail — including a freshly replayed log.
@@ -696,8 +895,17 @@ class RaftNode:
             if self.role != LEADER or self.current_term != term:
                 return
             if resp.get("success"):
+                old_match = self.match_index.get(pid, 0)
                 self.match_index[pid] = prev_idx + len(entries)
                 self.next_index[pid] = self.match_index[pid] + 1
+                now = time.monotonic()
+                self._peer_ack_at[pid] = now
+                # First-ack anchors for the write-path partition: the
+                # freshly covered indexes' replicate stage ends here.
+                for i in range(old_match + 1, self.match_index[pid] + 1):
+                    rec = self._wp_open.get(i)
+                    if rec is not None:
+                        rec["anchors"].setdefault("first_ack", now)
                 self._advance_commit_locked()
             else:
                 # Back off and retry (fast backtrack via follower hint)
@@ -730,6 +938,8 @@ class RaftNode:
                 return
             self.match_index[pid] = max(self.match_index.get(pid, 0), snap_index)
             self.next_index[pid] = snap_index + 1
+            self._peer_ack_at[pid] = time.monotonic()
+            self.snapshots_sent += 1
         self._replicate_now.set()
 
     def _handle_install_snapshot(self, args: dict) -> dict:
@@ -772,6 +982,7 @@ class RaftNode:
             self.last_applied = max(self.last_applied, snap_index)
             self._write_snapshot_file(snap_index, snap_term, data)
             self._truncate_persisted_log()
+            self.snapshots_installed += 1
             self.logger.info(
                 "raft: node %s installed snapshot at index %d",
                 self.config.node_id, snap_index,
@@ -782,6 +993,7 @@ class RaftNode:
         """Advance commit index over majority-matched entries of the current
         term (paper §5.4.2), then apply."""
         last_idx, _ = self._last_log()
+        old_commit = self.commit_index
         for n in range(last_idx, self.commit_index, -1):
             if self._term_at(n) != self.current_term:
                 break
@@ -791,12 +1003,26 @@ class RaftNode:
             if votes >= len(self.config.peers) // 2 + 1:
                 self.commit_index = n
                 break
+        if self.commit_index > old_commit:
+            self.commit_advances += 1
+            now = time.monotonic()
+            for i in range(old_commit + 1, self.commit_index + 1):
+                rec = self._wp_open.get(i)
+                if rec is not None:
+                    rec["anchors"].setdefault("committed", now)
         self._apply_committed_locked()
 
     def _apply_committed_locked(self) -> None:
         while self.last_applied < self.commit_index:
             index = self.last_applied + 1
             entry = self._entry_at(index)
+            rec = self._wp_open.get(index)
+            if rec is not None:
+                rec["anchors"]["fsm_start"] = time.monotonic()
+            replaying = (index <= self.recovery["replay_target"]
+                         and self.recovery["replay_wall_ms"] is None)
+            if replaying and self._replay_started is None:
+                self._replay_started = time.monotonic()
             try:
                 if entry.msg_type == "_config":
                     self._apply_config_locked(entry.payload)
@@ -814,12 +1040,31 @@ class RaftNode:
                 telemetry.incr_counter(("raft", "fsm_apply_error"))
                 error = e
             self.last_applied = index
+            if replaying:
+                # Restart-replay accounting: entries re-applied from the
+                # persisted tail, per msg_type, closed out when the tail
+                # is exhausted (the recovery report's replay rate).
+                self.recovery["entries_replayed"] += 1
+                by_type = self.recovery["replayed_by_type"]
+                by_type[entry.msg_type] = by_type.get(entry.msg_type, 0) + 1
+                if index >= self.recovery["replay_target"]:
+                    self.recovery["replay_wall_ms"] = round(
+                        (time.monotonic() - self._replay_started) * 1000.0,
+                        3,
+                    )
             future = self._apply_futures.pop(index, None)
+            if rec is not None:
+                rec["anchors"]["fsm_end"] = time.monotonic()
             if future is not None and not future.done():
                 if error is None:
                     future.set_result(index)
                 else:
                     future.set_exception(error)
+            if rec is not None:
+                rec["anchors"]["resolved"] = time.monotonic()
+                self._wp_open.pop(index, None)
+                self._wp_done.append(rec)
+                self._wp_seq += 1
         if (self.last_applied - self.snapshot_index
                 >= self.config.snapshot_threshold and not self._compacting):
             self._compacting = True
@@ -834,6 +1079,7 @@ class RaftNode:
         run off the node lock so replication and elections aren't stalled
         (the reference snapshots in a background goroutine the same way).
         Only a cheap copy-on-write handle is taken under the lock."""
+        t_compact0 = time.monotonic()
         try:
             with self._lock:
                 idx = self.last_applied
@@ -867,11 +1113,16 @@ class RaftNode:
                 if keep_from > self.log_offset:
                     self.log_offset_term = self._term_at(keep_from)
                     del self.log[: keep_from - self.log_offset]
+                    self.entries_truncated += keep_from - self.log_offset
                     self.log_offset = keep_from
                 self.snapshot_index = idx
                 self.snapshot_term = snap_term
                 self._snap_data = data
                 self._truncate_persisted_log()
+                self.compactions += 1
+                self.compaction_wall_ms += (
+                    time.monotonic() - t_compact0
+                ) * 1000.0
             self.logger.info(
                 "raft: node %s compacted log through index %d "
                 "(%d bytes snapshot)", self.config.node_id, idx, len(data),
@@ -925,13 +1176,24 @@ class RaftNode:
                 idx = prev_idx + 1 + i
                 entry = _Entry.from_wire(wire)
                 pos = idx - self.log_offset - 1
+                append = False
                 if len(self.log) > pos:
                     if self.log[pos].term != entry.term:
                         del self.log[pos:]
-                        self.log.append(entry)
-                        changed = True
+                        append = True
                 else:
+                    append = True
+                if append:
+                    # One dumps per ACTUALLY appended entry, measured in
+                    # the journal-line convention (index key included)
+                    # so leader/follower/reloaded byte books agree for
+                    # identical entries.
+                    entry.wire_bytes = len(
+                        json.dumps({"index": idx, **entry.to_wire()})
+                    )
                     self.log.append(entry)
+                    self.entries_appended += 1
+                    self.bytes_appended += entry.wire_bytes
                     changed = True
             if changed:
                 self._truncate_persisted_log()

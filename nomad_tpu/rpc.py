@@ -208,10 +208,13 @@ class RPCServer:
 
     def shutdown(self) -> None:
         self._shutdown.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        # shutdown(SHUT_RDWR) BEFORE close: a bare close() does not wake
+        # the thread blocked in accept() — the open file description
+        # (and with it the LISTEN port binding) survives until that
+        # syscall returns, so a server restarting on the SAME port gets
+        # EADDRINUSE from its own ghost (the restart-under-load
+        # scenario's kill/rebind found this).
+        _hard_close(self._listener)
         # Close accepted connections too: parked long-poll streams on
         # peers must fail fast, not sleep out their timeouts.
         with self._conns_lock:
@@ -219,6 +222,10 @@ class RPCServer:
             self._conns.clear()
         for conn in conns:
             _hard_close(conn)
+        # The accept thread must actually exit before the caller may
+        # rebind the port.
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     def _accept_loop(self) -> None:
         while not self._shutdown.is_set():

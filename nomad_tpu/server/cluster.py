@@ -61,6 +61,9 @@ class ClusterConfig:
     # 2 at nomad/server.go:453).
     snapshot_threshold: int = 8192
     snapshot_retain: int = 2
+    # Entries retained past the snapshot at compaction (hashicorp/raft
+    # TrailingLogs; RaftConfig.trailing_logs).
+    trailing_logs: int = 1024
     # Gossip-style failure detection (serf memberlist probing, serf.go:136-
     # 194): each server pings its same-region peers every probe_interval;
     # suspicion_threshold consecutive failures mark a member failed. The
@@ -131,6 +134,7 @@ class ClusterServer(Server):
                 bootstrap_expect=max(self.cluster.bootstrap_expect, 1),
                 snapshot_threshold=self.cluster.snapshot_threshold,
                 snapshot_retain=self.cluster.snapshot_retain,
+                trailing_logs=self.cluster.trailing_logs,
             ),
             self.fsm,
             self.rpc,
@@ -185,6 +189,7 @@ class ClusterServer(Server):
             self.slo_monitor.start()
         self.express_lane.start()
         self.capacity_accountant.start()
+        self.raft_observatory.start()
         from nomad_tpu.server.worker import Worker
 
         for i in range(self.config.scheduler_workers):
@@ -245,6 +250,11 @@ class ClusterServer(Server):
             for node in self.state_store.nodes():
                 if not node.terminal_status():
                     self.heartbeat.reset_heartbeat_timer(node.id)
+            # The recovery timeline's terminal anchor: leadership is
+            # established, the broker restored, TTLs renewed — this
+            # server answers queries and schedules again (time-to-
+            # serving, nomad_tpu/raft_observe.py). Idempotent.
+            self.raft.mark_serving()
         else:
             self.logger.info("cluster: %s lost leadership",
                              self.cluster.node_id)

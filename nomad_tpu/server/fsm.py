@@ -17,6 +17,7 @@ import logging
 import pickle
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional, TYPE_CHECKING
 
@@ -54,6 +55,11 @@ class FSM:
         # its stale broker and double-deliver.
         self.enqueue_guard = lambda: True
         self.logger = logger or logging.getLogger("nomad_tpu.fsm")
+        # Last snapshot-restore forensics (plain data, read by
+        # nomad_tpu/raft_observe.py for the recovery timeline): wall
+        # cost and per-table row counts of the most recent
+        # restore_bytes, None until one happens.
+        self.last_restore: Optional[Dict[str, Any]] = None
         self._handlers: Dict[str, Callable[[int, dict], Any]] = {
             "node_register": self._apply_node_register,
             "node_batch_register": self._apply_node_batch_register,
@@ -267,6 +273,7 @@ class FSM:
 
     def restore_bytes(self, data: bytes) -> None:
         """Rebuild a fresh state store from a snapshot (fsm.go:313-410)."""
+        t0 = time.perf_counter()
         payload = pickle.loads(data)
         old_store = self.state
         self.state = StateStore()
@@ -287,6 +294,22 @@ class FSM:
         for table, index in payload["indexes"].items():
             restore.index_restore(table, index)
         restore.commit()
+        blocks = payload.get("blocks", [])
+        self.last_restore = {
+            "wall_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+            "bytes": len(data),
+            "nodes": len(payload["nodes"]),
+            "jobs": len(payload["jobs"]),
+            "evals": len(payload["evals"]),
+            "allocs": len(payload["allocs"]),
+            "blocks": len(blocks),
+            # Placements the snapshot re-materialized: object rows plus
+            # the columnar blocks' live members — the recovery report's
+            # placements-per-second numerator starts here.
+            "placements": len(payload["allocs"]) + sum(
+                cnt for b in blocks for _nid, cnt in b.live_node_counts()
+            ),
+        }
         # Blocking queries parked on the replaced store would never be
         # notified again; wake them so they re-check against the live one.
         old_store.watch.notify_all()
@@ -304,11 +327,30 @@ class InProcRaft:
         self.fsm = fsm
         self._lock = threading.Lock()
         self._index = 0
+        # Write-path anchor records (the RaftNode book surface, read by
+        # nomad_tpu/raft_observe.py): DevMode attribution degrades
+        # honestly — no persistence/replication, so those stages are
+        # exactly zero wide and fsm_apply dominates. Entry bytes stay 0:
+        # InProcRaft payloads are live objects, and serializing them
+        # here would cost the hot path a dumps it never needed.
+        self._wp_done: "deque" = deque(maxlen=1024)
+        self._wp_seq = 0
 
     @property
     def applied_index(self) -> int:
         with self._lock:
             return self._index
+
+    def write_path_records(self, since: int):
+        """(sequence, finalized records newer than ``since``) — the raft
+        observatory's drain, same contract as RaftNode's."""
+        with self._lock:
+            seq = self._wp_seq
+            n = seq - int(since)
+            if n <= 0:
+                return seq, []
+            n = min(n, len(self._wp_done))
+            return seq, list(self._wp_done)[-n:]
 
     def apply(self, msg_type: str, payload: dict) -> Future:
         """Apply under the lock, publishing the index only after the FSM has
@@ -320,14 +362,26 @@ class InProcRaft:
         the FSM error is deterministic, matching replicated-raft semantics.
         """
         future: Future = Future()
+        t_submit = time.monotonic()
         with self._lock:
             index = self._index + 1
+            anchors = {"submit": t_submit}
+            # Synchronous quorum-of-one: append/persist/replicate/commit
+            # all collapse to the lock acquisition.
+            anchors["persisted"] = anchors["committed"] = time.monotonic()
+            anchors["fsm_start"] = time.monotonic()
             try:
                 self.fsm.apply(index, msg_type, payload)
             except Exception as e:
                 self._index = index
+                anchors["fsm_end"] = time.monotonic()
                 future.set_exception(e)
             else:
                 self._index = index
+                anchors["fsm_end"] = time.monotonic()
                 future.set_result(index)
+            anchors["resolved"] = time.monotonic()
+            self._wp_done.append({"index": index, "msg_type": msg_type,
+                                  "bytes": 0, "anchors": anchors})
+            self._wp_seq += 1
         return future

@@ -140,6 +140,13 @@ class ServerConfig:
     # capacity %. None = defaults (enabled; decision-invariant by
     # construction, pinned by the churn-fragmentation contrast arm).
     capacity: Optional[Dict] = None
+    # Raft & recovery observatory spec (RaftObserveConfig.parse mapping,
+    # nomad_tpu/raft_observe.py): the read-only observer behind
+    # /v1/agent/raft — write-path stage attribution per msg_type,
+    # follower lag, log/snapshot economy, restart-replay timeline.
+    # None = defaults (enabled; decision-invariant by construction: the
+    # observer drains bounded books the raft node keeps as plain data).
+    raft_observe: Optional[Dict] = None
     # Solver mesh spec (SolverMeshConfig.parse mapping,
     # nomad_tpu/parallel/mesh.py): shard the node axis of every device
     # solve (and the mirror's padded buffers) over a JAX device mesh —
@@ -189,6 +196,9 @@ class ServerConfig:
         from nomad_tpu.capacity import CapacityConfig
 
         self.capacity_config = CapacityConfig.parse(self.capacity)
+        from nomad_tpu.raft_observe import RaftObserveConfig
+
+        self.raft_observe_config = RaftObserveConfig.parse(self.raft_observe)
         from nomad_tpu.parallel.mesh import SolverMeshConfig
 
         self.solver_mesh_config = SolverMeshConfig.parse(self.solver_mesh)
@@ -299,6 +309,20 @@ class Server:
             self.config.capacity_config,
             events=self.fsm.events,
         )
+        # The raft & recovery observatory (nomad_tpu/raft_observe.py):
+        # drains the bounded write-path/log/recovery books the raft node
+        # keeps as plain data. Composed HERE and only here — the same
+        # OBS001 composition-root contract as the capacity accountant.
+        # The raft getter re-reads self.raft per poll: ClusterServer
+        # swaps InProcRaft for a RaftNode after this constructor runs.
+        from nomad_tpu.raft_observe import RaftObservatory
+
+        self.raft_observatory = RaftObservatory(
+            lambda: self.raft,
+            self.config.raft_observe_config,
+            events=self.fsm.events,
+            fsm_getter=lambda: self.fsm,
+        )
         self._periodic_stop = threading.Event()
         self._started = False
 
@@ -326,6 +350,7 @@ class Server:
             self.slo_monitor.start()
         self.express_lane.start()
         self.capacity_accountant.start()
+        self.raft_observatory.start()
         self.restore_eval_broker()
         for i in range(self.config.scheduler_workers):
             worker = Worker(self, i)
@@ -412,6 +437,7 @@ class Server:
             worker.stop()
         self.express_lane.stop()
         self.capacity_accountant.stop()
+        self.raft_observatory.stop()
         if self.slo_monitor is not None:
             self.slo_monitor.stop()
         self.plan_applier.stop()
@@ -1032,6 +1058,9 @@ class Server:
             "express": self.express_lane.summary(),
             "capacity": (self.capacity_accountant.summary()
                          if self.config.capacity_config.enabled else None),
+            "raft_observe": (self.raft_observatory.summary()
+                             if self.config.raft_observe_config.enabled
+                             else None),
         }
 
     @staticmethod

@@ -57,6 +57,7 @@ from nomad_tpu.simcluster.workload import (
     BatchBurstInjector,
     ExpressStreamInjector,
     FragmentationChurnInjector,
+    LeaderRestartInjector,
     NodeChurnInjector,
     NodeRefreshInjector,
     OverdriveInjector,
@@ -101,6 +102,14 @@ class ScenarioSpec:
     # contrast legitimately diverges (more work admitted) and leaves
     # this False.
     contrast_digest_invariant: bool = False
+    # Durable raft state: the runner creates a temp data dir so every
+    # entry journals and the leader can be killed and restarted from
+    # disk mid-run (the restart-under-load scenario). Cleaned up after.
+    durable_raft: bool = False
+    # ClusterConfig overrides (snapshot_threshold, trailing_logs, ...):
+    # the restart scenario compresses the compaction cadence so a cold
+    # restart exercises snapshot restore AND log-tail replay.
+    cluster_overrides: Dict = field(default_factory=dict)
     description: str = ""
 
 
@@ -390,6 +399,81 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
                         "observatory-off contrast arm proves digest "
                         "equality (decision invariance)",
         ),
+        "restart-under-load": ScenarioSpec(
+            name="restart-under-load", n_nodes=10_000,
+            injectors=lambda seed: [
+                # The steady-10k service workload, verbatim: the restart
+                # must be survived UNDER the north-star load, not on an
+                # idle cell.
+                SteadyServiceInjector(
+                    seed, jobs=24, tasks_per_job=420, over=18.0,
+                ),
+                # The cut: mid-window, while placements are in flight.
+                # Evals caught on the wrong side of it redeliver from
+                # durable state after the restart — the canonical
+                # per-key lifecycles (and therefore the digest) must not
+                # depend on which side of the kill a plan landed.
+                LeaderRestartInjector(seed, at=9.0),
+            ],
+            durable_raft=True,
+            cluster_overrides={
+                # Compressed compaction so the restart exercises BOTH
+                # halves of recovery: snapshot restore (the 10k-node
+                # registration prefix compacts away) and log-tail replay
+                # (the short trailing tail plus everything since the
+                # last compaction re-applies through the FSM).
+                "snapshot_threshold": 64,
+                "trailing_logs": 16,
+            },
+            server_overrides={
+                # The restart replays the committed prefix into a FRESH
+                # event ring before the runner's watcher pages it;
+                # headroom keeps the (floor-filtered) replay burst from
+                # truncating the stream.
+                "event_buffer_size": 16384,
+                # 10k/10 = 1000s TTLs: no heartbeat traffic inside the
+                # window, so fleet beats can't race the downtime and
+                # expiry fan-out can't touch the digest (the
+                # overdrive-100k posture).
+                "max_heartbeats_per_second": 10.0,
+            },
+            quiesce_timeout=600.0, ack_cap=0,
+            description="ROADMAP item 2's kill-and-recover proof, "
+                        "measurement half: the steady-10k service "
+                        "workload (24 jobs x420 tasks over ~18s) at 10k "
+                        "nodes with a DURABLE raft log (journal + "
+                        "compressed snapshot cadence); at t=9s the "
+                        "leader is killed outright and restarted from "
+                        "its data dir on the same port — every pre-kill "
+                        "placement must survive the replay, in-flight "
+                        "evals redeliver and finish, the canonical "
+                        "event digest stays seed-deterministic across "
+                        "the cut (events dedup by raft index), and the "
+                        "artifact banks the recovery timeline "
+                        "(snapshot-restore wall, entries replayed, "
+                        "replay rate, time-to-leader/serving)",
+        ),
+        "restart-800": ScenarioSpec(
+            name="restart-800", n_nodes=800,
+            injectors=lambda seed: [
+                SteadyServiceInjector(
+                    seed, jobs=6, tasks_per_job=120, over=4.0,
+                ),
+                LeaderRestartInjector(seed, at=2.0),
+            ],
+            durable_raft=True,
+            cluster_overrides={"snapshot_threshold": 24,
+                               "trailing_logs": 8},
+            server_overrides={
+                "event_buffer_size": 8192,
+                "max_heartbeats_per_second": 2.0,
+            },
+            quiesce_timeout=120.0, ack_cap=0, warmup_count=100,
+            description="tier-1 restart smoke: 800 nodes, 6 service "
+                        "jobs x120 tasks, leader killed and restarted "
+                        "from durable state at t=2s — placements "
+                        "survive, recovery timeline populated",
+        ),
         "churn": ScenarioSpec(
             name="churn", n_nodes=2000,
             injectors=lambda seed: [
@@ -508,24 +592,69 @@ class ScenarioRunner:
         self._panel_samples: List[Dict] = []
         self._t_measure0 = 0.0
         self._panel0: Optional[Dict] = None
+        # Restart bookkeeping (restart-under-load): the event watcher's
+        # raft-index floor (post-restart, replayed events at or below it
+        # are dupes of already-collected ones and are dropped), carried
+        # per-server counter baselines (a fresh server's pipeline/
+        # heartbeat books start at zero), and the restart verdict block.
+        self._raft_floor = 0
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._pipe0: Dict = {}
+        self._pipe_carry: Dict = {}
+        self._hb0: Dict = {}
+        self._hb_carry: Dict = {}
+        self._data_dir: Optional[str] = None
+        self._restart: Optional[Dict] = None
 
     # -- observation --------------------------------------------------------
 
-    def _watch_events(self, broker, cursor: int) -> None:
-        while not self._stop.is_set():
+    def _start_watcher(self, broker, cursor: int) -> None:
+        """Tail one broker into the run's event list. The restart path
+        stops the old server's watcher (final drain included) and starts
+        a fresh one on the restarted server's broker with the raft-index
+        floor set, so the replayed prefix dedups instead of
+        double-counting."""
+        self._watch_stop = threading.Event()
+        self._watch_thread = threading.Thread(
+            target=self._watch_events,
+            args=(broker, cursor, self._watch_stop),
+            daemon=True, name="sim-events")
+        self._watch_thread.start()
+
+    def _stop_watcher(self) -> None:
+        self._watch_stop.set()
+        t = self._watch_thread
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def _take_events(self, evs) -> None:
+        """Collect a page, dropping post-restart replay dupes: an event
+        re-published by log replay carries the SAME raft index as its
+        pre-kill original (the FSM apply is deterministic), so everything
+        at or below the kill-time applied index is already collected.
+        Observer-born events (raft_index 0) always pass — their topics
+        are digest-excluded anyway."""
+        floor = self._raft_floor
+        if floor:
+            evs = [e for e in evs if not (0 < e.raft_index <= floor)]
+        if evs:
+            with self._events_lock:
+                self._events.extend(evs)
+
+    def _watch_events(self, broker, cursor: int, stop) -> None:
+        while not stop.is_set():
             latest, evs, truncated = broker.events_after(cursor)
             if truncated:
                 self._truncated = True
             if evs:
-                with self._events_lock:
-                    self._events.extend(evs)
+                self._take_events(evs)
                 cursor = latest
             time.sleep(0.05)
         latest, evs, truncated = broker.events_after(cursor)
         if truncated:
             self._truncated = True
-        with self._events_lock:
-            self._events.extend(evs)
+        self._take_events(evs)
 
     def _sample_depths(self, srv) -> None:
         from nomad_tpu.tpu.solver import SOLVER_PANEL
@@ -533,6 +662,9 @@ class ScenarioRunner:
         capacity_on = srv.config.capacity_config.enabled
         tick = 0
         while not self._stop.wait(0.1):
+            # Re-read per tick: the restart action swaps the server out
+            # from under the sampler mid-run.
+            srv = self._srv
             tick += 1
             if tick % 5 == 0:
                 # 2 Hz observatory trajectory: roll the accountant to
@@ -712,6 +844,109 @@ class ScenarioRunner:
                          len(pick), len(hosting & set(pick)))
         return pick
 
+    def _cluster_config(self, bind_port: int = 0) -> ClusterConfig:
+        kwargs = dict(bootstrap_expect=1, bind_port=bind_port)
+        if self._data_dir:
+            kwargs["raft_data_dir"] = self._data_dir
+        kwargs.update(self.spec.cluster_overrides)
+        return ClusterConfig(**kwargs)
+
+    def _restart_leader(self, fleet: SimFleet) -> None:
+        """Kill the leader outright and restart it from its durable raft
+        state on the SAME port. Sequencing is the contract:
+
+        1. shut the old server down (in-flight plans fail typed; their
+           evals stay pending in durable state),
+        2. drain the old event broker completely (every applied entry's
+           events are in the ring), record the kill-time applied index
+           as the watcher's raft-index floor and the pre-kill live
+           placement map,
+        3. build the new server on the same data dir + port, attach a
+           fresh watcher BEFORE start (replay events race the first
+           poll), start it, wait for leadership,
+        4. flush the fleet's pooled conns (dead sockets invalidate on
+           first use) until the new listener answers."""
+        from nomad_tpu.rpc import RPCError, RemoteError
+
+        spec = self.spec
+        if not spec.durable_raft or self._data_dir is None:
+            raise RuntimeError(
+                "restart_leader requires a durable_raft scenario spec")
+        old = self._srv
+        port = int(old.rpc_addr.rsplit(":", 1)[1])
+        t_kill0 = time.perf_counter()
+        self.logger.info("simcluster: killing leader at t=%.2fs",
+                         t_kill0 - self._t_measure0)
+        old.shutdown()
+        # Watcher drains the (quiescent) old ring on its way out.
+        self._stop_watcher()
+        pre_applied = old.raft.applied_index
+        pre_allocs = {
+            a.id: a.node_id for a in old.state_store.allocs()
+            if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+        }
+        # Carry the per-server counter baselines across the process
+        # boundary: the fresh server's books start at zero, and the
+        # artifact's measured-window deltas must span both lives.
+        old_pipe = old.plan_pipeline.stats()
+        for k, v in old_pipe.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if k == "max_batch_seen":  # high-watermark, not a delta
+                self._pipe_carry[k] = max(self._pipe_carry.get(k, 0), v)
+                continue
+            self._pipe_carry[k] = (self._pipe_carry.get(k, 0)
+                                   + v - self._pipe0.get(k, 0))
+            self._pipe0[k] = 0
+        old_hb = old.heartbeat.stats()
+        for k, v in old_hb.items():
+            self._hb_carry[k] = (self._hb_carry.get(k, 0)
+                                 + v - self._hb0.get(k, 0))
+            self._hb0[k] = 0
+        self._raft_floor = pre_applied
+
+        cfg2 = ServerConfig(**self._cfg_kwargs)
+        srv2 = ClusterServer(
+            cfg2, self._cluster_config(bind_port=port), logger=self.logger,
+        )
+        self._srv = srv2
+        # The write-path books must span both server lives: the new
+        # observatory adopts the dead one's cumulative aggregates.
+        srv2.raft_observatory.absorb(old.raft_observatory)
+        # Fresh watcher BEFORE start: the log replay publishes into the
+        # new ring within milliseconds of leadership; every replayed
+        # event is at or below the floor and dedups, everything newer
+        # collects.
+        self._start_watcher(srv2.fsm.events, 0)
+        srv2.start()
+        wait_for_leader([srv2], timeout=60.0)
+        # The fleet's pooled conns still point at the dead listener's
+        # sockets; one failed call invalidates a conn, the next redials.
+        deadline = time.monotonic() + 30.0
+        for pool in fleet._pools:
+            while True:
+                try:
+                    pool.call(srv2.rpc_addr, "Status.Ping", {},
+                              timeout=2.0)
+                    break
+                except (RPCError, RemoteError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
+        downtime = time.perf_counter() - t_kill0
+        self._restart = {
+            "killed_at_s": round(t_kill0 - self._t_measure0, 2),
+            "downtime_s": round(downtime, 3),
+            "pre_kill_applied_index": pre_applied,
+            "pre_kill_placements": len(pre_allocs),
+            "pre_kill_alloc_map": pre_allocs,
+        }
+        self.logger.info(
+            "simcluster: leader restarted in %.2fs (replaying from "
+            "applied index %d, %d live placements pre-kill)",
+            downtime, pre_applied, len(pre_allocs),
+        )
+
     # -- the run ------------------------------------------------------------
 
     def run(self) -> Dict:
@@ -736,9 +971,14 @@ class ScenarioRunner:
         cfg_kwargs.update(spec.server_overrides)
         if not self.attribution_layer:
             cfg_kwargs["slo_objectives"] = {}
+        self._cfg_kwargs = cfg_kwargs
+        if spec.durable_raft and self._data_dir is None:
+            import tempfile
+
+            self._data_dir = tempfile.mkdtemp(prefix="nomad-sim-raft-")
         cfg = ServerConfig(**cfg_kwargs)
         srv = self._srv = ClusterServer(
-            cfg, ClusterConfig(bootstrap_expect=1), logger=self.logger,
+            cfg, self._cluster_config(), logger=self.logger,
         )
         fleet = SimFleet(srv.rpc_addr, logger=self.logger)
         threads: List[threading.Thread] = []
@@ -838,11 +1078,11 @@ class ScenarioRunner:
                 faults.get_registry().load(plan)
             broker = srv.fsm.events
             cursor = broker.get_index()
-            hb0 = srv.heartbeat.stats()
+            self._hb0 = hb0 = srv.heartbeat.stats()
             t_measure0 = time.perf_counter()
             dispatches0 = GLOBAL_SOLVER.dispatches
             mirror0 = GLOBAL_MIRROR_CACHE.stats()
-            pipe0 = srv.plan_pipeline.stats()
+            self._pipe0 = pipe0 = srv.plan_pipeline.stats()
             from nomad_tpu.tpu.solver import SOLVER_PANEL
 
             self._t_measure0 = t_measure0
@@ -850,14 +1090,11 @@ class ScenarioRunner:
             # process accumulate): window accounting differences against
             # this baseline.
             self._panel0 = SOLVER_PANEL.snapshot()
-            watcher = threading.Thread(
-                target=self._watch_events, args=(broker, cursor),
-                daemon=True, name="sim-events")
+            self._start_watcher(broker, cursor)
             sampler = threading.Thread(
                 target=self._sample_depths, args=(srv,), daemon=True,
                 name="sim-sampler")
-            threads = [watcher, sampler]
-            watcher.start()
+            threads = [sampler]
             sampler.start()
 
             injectors = spec.injectors(self.seed)
@@ -928,6 +1165,11 @@ class ScenarioRunner:
                     self._refresh_nodes(fleet, action.payload)
                 elif action.kind == "fail_nodes":
                     failed_tranche = self._fail_nodes(fleet, action.payload)
+                elif action.kind == "restart_leader":
+                    # Synchronous in the paced loop: no registration is
+                    # in flight across the kill (only worker-side eval/
+                    # plan work, which the durable log re-drives).
+                    self._restart_leader(fleet)
             for t in blasters:
                 t.join()
             if blast_errors:
@@ -938,10 +1180,18 @@ class ScenarioRunner:
             for out in blasted:
                 expected_evals.extend(ev_id for ev_id in out if ev_id)
 
+            # The restart action swaps the server instance mid-loop;
+            # everything from quiescence on reads the CURRENT one.
+            srv = self._srv
             self._wait_quiesced(srv, expected_evals, failed_tranche,
                                 time.monotonic() + spec.quiesce_timeout)
             wall = time.perf_counter() - t_run0
             measured = time.perf_counter() - t_measure0
+            # Effective baselines: per-server counters carried across a
+            # restart (the old server's measured-window contribution is
+            # folded in as a negative baseline offset).
+            hb0 = {k: self._hb0.get(k, 0) - self._hb_carry.get(k, 0)
+                   for k in self._hb0}
             hb1 = srv.heartbeat.stats()
             dispatches = GLOBAL_SOLVER.dispatches - dispatches0
             mirror1 = GLOBAL_MIRROR_CACHE.stats()
@@ -955,12 +1205,15 @@ class ScenarioRunner:
             }
             pipe1 = srv.plan_pipeline.stats()
             pipeline = {
-                k: pipe1[k] - pipe0[k]
+                k: (pipe1[k] - self._pipe0.get(k, 0)
+                    + self._pipe_carry.get(k, 0))
                 for k in ("batches", "plans", "committed", "noops",
                           "conflicts", "refreshes", "fused_plans",
                           "scalar_plans")
             }
-            pipeline["max_batch_seen"] = pipe1["max_batch_seen"]
+            pipeline["max_batch_seen"] = max(
+                pipe1["max_batch_seen"],
+                self._pipe_carry.get("max_batch_seen", 0))
 
             # Phase 4: alloc acknowledgement (bounded client posture).
             acked = 0
@@ -976,6 +1229,7 @@ class ScenarioRunner:
 
             # Drain the watcher, then build the artifact.
             self._stop.set()
+            self._stop_watcher()
             for t in threads:
                 t.join(timeout=5.0)
             return self._artifact(
@@ -984,11 +1238,17 @@ class ScenarioRunner:
             )
         finally:
             self._stop.set()
+            self._stop_watcher()
             tracer.enabled = tracing_was
             if spec.faults_spec is not None:
                 faults.get_registry().clear()
             fleet.stop()
-            srv.shutdown()
+            self._srv.shutdown()
+            if self._data_dir is not None:
+                import shutil
+
+                shutil.rmtree(self._data_dir, ignore_errors=True)
+                self._data_dir = None
 
     def _wait_quiesced(self, srv, expected_evals: List[str],
                        failed_tranche: List[str], deadline: float) -> None:
@@ -1240,6 +1500,7 @@ class ScenarioRunner:
                 "placed_events": len(express_ms),
             }
         artifact["capacity"] = self._capacity_section(srv)
+        artifact["raft"] = self._raft_section(srv)
         artifact["solver_panel"] = self._solver_panel_section()
         if self.attribution_layer:
             from nomad_tpu import lifecycle, slo
@@ -1252,11 +1513,15 @@ class ScenarioRunner:
             slow_tls = [t for t in timelines.values()
                         if t.triggered_by != "express"]
             att = lifecycle.attribution(slow_tls)
-            objectives = None
+            # Scenario-scoped objectives (slo.SCENARIO_OBJECTIVES): the
+            # artifact's own verdict and the bench_watch gate consult
+            # the SAME table, so they can never disagree about which
+            # promise a family is judged against.
+            objectives = slo.SCENARIO_OBJECTIVES.get(self.spec.name)
             if express_ms:
                 att["express_placed_ms"] = _quantiles(
                     [ms / 1000.0 for ms in express_ms])
-                objectives = {**slo.DEFAULT_OBJECTIVES,
+                objectives = {**(objectives or slo.DEFAULT_OBJECTIVES),
                               **slo.EXPRESS_OBJECTIVES}
             att["slo_check"] = slo.evaluate_artifact(att, objectives)
             artifact["latency_attribution"] = att
@@ -1293,6 +1558,60 @@ class ScenarioRunner:
             "trajectory": trajectory,
             "final": acct.snapshot(),
         }
+
+    def _raft_section(self, srv) -> Dict:
+        """The raft observatory's run report (nomad_tpu/raft_observe.py):
+        write-path stage attribution per msg_type, log/snapshot economy,
+        and — for restart scenarios — the recovery timeline plus the
+        placements-survived verdict. A run that LOST a pre-kill
+        placement fails loudly here: survival is the scenario's
+        contract, not a statistic."""
+        obs = getattr(srv, "raft_observatory", None)
+        if obs is None or not srv.config.raft_observe_config.enabled:
+            return {"enabled": False}
+        obs.refresh()
+        snap = obs.snapshot()
+        out = {
+            "enabled": True,
+            "write_path": snap["write_path"],
+            "replication": snap["replication"],
+            "log": snap["log"],
+            "snapshot": snap["snapshot"],
+            "recovery": snap["recovery"],
+            "observer": snap["observer"],
+        }
+        if self._restart is not None:
+            restart = {k: v for k, v in self._restart.items()
+                       if k != "pre_kill_alloc_map"}
+            pre = self._restart["pre_kill_alloc_map"]
+            post = {
+                a.id: a.node_id for a in srv.state_store.allocs()
+                if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+            }
+            # Survival = same alloc id on the same node: a committed
+            # placement must come back from the durable log verbatim,
+            # not be re-placed somewhere else.
+            surviving = sum(
+                1 for aid, nid in pre.items() if post.get(aid) == nid
+            )
+            restart["surviving_placements"] = surviving
+            restart["placements_survived"] = surviving == len(pre)
+            recovery = snap["recovery"]
+            rematerialize_ms = (
+                (recovery.get("snapshot_restore_ms") or 0.0)
+                + (recovery.get("replay_wall_ms") or 0.0)
+            )
+            restart["placements_rematerialized_per_s"] = (
+                round(len(pre) / (rematerialize_ms / 1000.0), 1)
+                if rematerialize_ms else None
+            )
+            out["restart"] = restart
+            if not restart["placements_survived"]:
+                raise RuntimeError(
+                    f"leader restart lost placements: {surviving}/"
+                    f"{len(pre)} survived the replay"
+                )
+        return out
 
     def _solver_panel_section(self) -> Dict:
         """Device-solve efficiency over the measured window: deltas

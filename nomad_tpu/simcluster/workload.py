@@ -442,6 +442,26 @@ class FragmentationChurnInjector(Injector):
         return lambda: build_job(jid, jtype, count, cpu=cpu, memory_mb=mem)
 
 
+class LeaderRestartInjector(Injector):
+    """Kill-and-recover: at ``at`` seconds the runner shuts the leader
+    down mid-load and restarts it from its durable raft state (same
+    data dir, same RPC port) — ROADMAP item 2's cold-restart-under-load
+    ask. The runner handles the mechanics (event-stream dedup by raft
+    index across the restart, fleet reconnection, recovery-timeline
+    capture); this injector only schedules the cut. Requires a spec
+    with ``durable_raft`` — an in-memory leader has nothing to recover
+    from."""
+
+    name = "leader-restart"
+
+    def __init__(self, seed: int, at: float):
+        super().__init__(seed)
+        self.at = at
+
+    def actions(self) -> List[Action]:
+        return [Action(at=self.at, kind="restart_leader", payload={})]
+
+
 class NodeChurnInjector(Injector):
     """Node-failure churn: silence ``count`` nodes at ``at`` seconds. The
     runner resolves the tranche (preferring alloc-hosting nodes with this

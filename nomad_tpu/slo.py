@@ -59,6 +59,34 @@ EXPRESS_OBJECTIVES: Dict[str, float] = {
     "express_placed_p50_ms": 1.0,
 }
 
+# Scenario-scoped objectives: SIMLOAD families whose CONTRACT is not the
+# default cell SLO. The gate (tools/bench_watch.py) and the scenario
+# runner's in-artifact slo_check both consult this table by scenario
+# name, so a banked artifact and its CI verdict can never disagree about
+# which promise was being judged.
+#
+# - churn-fragmentation (and its tier-1 smoke): the scenario's claim is
+#   the capacity/stranding trajectory, and its probe wave INTENTIONALLY
+#   races a ~9000-alloc deregistration stop storm — the p95 tail is the
+#   storm, not placement health. The scenario-scoped bound (1s) catches
+#   a real regression (the r13 bank's p95 is ~455ms) without pretending
+#   the run ever promised the 250ms steady-state SLO.
+# - restart-under-load (and its smoke): evals caught mid-flight by the
+#   leader kill wait out the downtime (~1-3s: re-election + snapshot
+#   restore + log replay) and THEN place — survival and recovery speed
+#   are the contract (the recovery gate judges those), so the placed
+#   bound absorbs the declared downtime.
+SCENARIO_OBJECTIVES: Dict[str, Dict[str, float]] = {
+    "churn-fragmentation": {**DEFAULT_OBJECTIVES,
+                            "submit_to_placed_p95_ms": 1000.0},
+    "churn-frag-200": {**DEFAULT_OBJECTIVES,
+                       "submit_to_placed_p95_ms": 1000.0},
+    "restart-under-load": {**DEFAULT_OBJECTIVES,
+                           "submit_to_placed_p95_ms": 15000.0},
+    "restart-800": {**DEFAULT_OBJECTIVES,
+                    "submit_to_placed_p95_ms": 15000.0},
+}
+
 _NAME_RE = re.compile(r"^(?P<metric>[a-z_]+)_p(?P<pct>\d{1,2})_ms$")
 
 

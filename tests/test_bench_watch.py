@@ -342,3 +342,89 @@ def test_slo_gate_scan_absolute_arm(tmp_path, monkeypatch):
         log=lambda event, **kw: logged.append({"event": event, **kw}))
     assert logged[0]["baseline"] == "<absolute>"
     assert logged[0]["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# scenario-scoped objectives + recovery gate
+# ---------------------------------------------------------------------------
+
+
+def test_objectives_for_scenario_scoped_family():
+    """churn-fragmentation is judged against its declared scenario
+    objective (the probe wave races a stop storm by design), not the
+    250ms cell SLO — and the r13 bank honestly meets it."""
+    from nomad_tpu.slo import SCENARIO_OBJECTIVES
+
+    art = _artifact(p95=455.0)
+    art["scenario"] = "churn-fragmentation"
+    objectives = bench_watch._objectives_for(art)
+    assert objectives == SCENARIO_OBJECTIVES["churn-fragmentation"]
+    verdict = bench_watch.slo_gate_absolute(art, objectives)
+    assert verdict["ok"] is True
+    # The same artifact against the DEFAULT objectives fails — the
+    # scenario scoping is load-bearing, not cosmetic.
+    assert bench_watch.slo_gate_absolute(art, None)["ok"] is False
+
+
+def _restart_artifact(survived=True, rate=60.0, tts=1000.0, p95=2000.0):
+    art = _artifact(p95=p95)
+    art["scenario"] = "restart-under-load"
+    art["raft"] = {
+        "enabled": True,
+        "restart": {"placements_survived": survived,
+                    "pre_kill_placements": 400,
+                    "surviving_placements": 400 if survived else 399},
+        "recovery": {"cold_start": True, "entries_replayed": 20,
+                     "replay_entries_per_s": rate,
+                     "time_to_serving_ms": tts},
+    }
+    return art
+
+
+def test_recovery_gate_absolute_on_survival():
+    """Digest/placement survival gates ABSOLUTELY, baseline or not."""
+    good = bench_watch.recovery_gate(_restart_artifact(), None)
+    assert good["ok"] is True
+    bad = bench_watch.recovery_gate(_restart_artifact(survived=False),
+                                    None)
+    assert bad["ok"] is False
+    assert [c["check"] for c in bad["checks"]
+            if c["regressed"]] == ["placements_survived"]
+    # Non-restart artifacts are not this gate's business.
+    assert bench_watch.recovery_gate(_artifact(), None) is None
+
+
+def test_recovery_gate_newest_vs_previous_tolerance():
+    """Replay rate and time-to-serving gate newest-vs-previous at 50%
+    tolerance: inside it passes, beyond it fails."""
+    base = _restart_artifact(rate=60.0, tts=1000.0)
+    within = bench_watch.recovery_gate(
+        _restart_artifact(rate=40.0, tts=1400.0), base)
+    assert within["ok"] is True
+    slow_replay = bench_watch.recovery_gate(
+        _restart_artifact(rate=20.0, tts=1000.0), base)
+    assert slow_replay["ok"] is False
+    assert [c["check"] for c in slow_replay["checks"] if c["regressed"]] \
+        == ["replay_entries_per_s"]
+    slow_serving = bench_watch.recovery_gate(
+        _restart_artifact(rate=60.0, tts=2000.0), base)
+    assert slow_serving["ok"] is False
+    assert [c["check"] for c in slow_serving["checks"]
+            if c["regressed"]] == ["time_to_serving_ms"]
+
+
+def test_recovery_gate_rides_the_scan(tmp_path, monkeypatch):
+    new = tmp_path / "SIMLOAD_restart-under-load_s42_r16.json"
+    old = tmp_path / "SIMLOAD_restart-under-load_s42_r15.json"
+    new.write_text(json.dumps(_restart_artifact(rate=20.0)))
+    old.write_text(json.dumps(_restart_artifact(rate=60.0)))
+    monkeypatch.setattr(
+        bench_watch, "_banked_simload_pairs",
+        lambda: [("restart-under-load_s42", str(new), str(old))])
+    logged = []
+    ok = bench_watch.slo_gate_scan(
+        log=lambda event, **kw: logged.append({"event": event, **kw}))
+    assert ok is False
+    rec = next(r for r in logged if r["event"] == "recovery-gate")
+    assert rec["ok"] is False
+    assert rec["regressed"] == ["replay_entries_per_s"]

@@ -471,6 +471,44 @@ def test_observatory_outside_scope_ignored(tmp_path):
     assert observatory.run(project) == []
 
 
+def test_observatory_flags_raft_observe_imports(tmp_path):
+    """OBS001 covers the raft observatory too: every import form of
+    nomad_tpu.raft_observe inside the decision scope is a finding —
+    including from raft/ itself (the node keeps plain-data books the
+    observer drains; the dependency must never point back)."""
+    project = _project(tmp_path, {
+        "nomad_tpu/raft/bad_node.py": """\
+            import nomad_tpu.raft_observe
+        """,
+        "nomad_tpu/server/bad_plan.py": """\
+            def snapshot():
+                from nomad_tpu.raft_observe import RaftObservatory
+                return RaftObservatory
+        """,
+        "nomad_tpu/state/bad_store.py": """\
+            from nomad_tpu import raft_observe
+        """,
+        "nomad_tpu/server/clean.py": """\
+            import nomad_tpu.telemetry
+        """,
+    })
+    findings = observatory.run(project)
+    assert _rules(findings) == ["OBS001", "OBS001", "OBS001"]
+    files = sorted(f.file for f in findings)
+    assert files == ["nomad_tpu/raft/bad_node.py",
+                     "nomad_tpu/server/bad_plan.py",
+                     "nomad_tpu/state/bad_store.py"]
+
+
+def test_observatory_raft_observe_composition_root_exempt(tmp_path):
+    project = _project(tmp_path, {
+        "nomad_tpu/server/server.py": """\
+            from nomad_tpu.raft_observe import RaftObservatory
+        """,
+    })
+    assert observatory.run(project) == []
+
+
 def test_observatory_real_tree_is_clean():
     """The actual tree honors the contract (the tier-1 gate's view)."""
     project = Project()

@@ -379,6 +379,52 @@ def test_churn_frag_200_smoke(tmp_path):
     assert contrast["placements"]["placed"] == 6 * 400 + 2 * 40
 
 
+def test_restart_800_smoke(tmp_path):
+    """Kill-and-recover at smoke scale: 800 nodes, 6 service jobs x120
+    tasks, leader killed outright at t=2s and restarted from its
+    durable raft state on the same port. Every pre-kill placement must
+    survive the replay verbatim (same alloc id, same node), the run
+    still places everything, and the artifact banks a populated
+    recovery timeline."""
+    out = tmp_path / "SIMLOAD_restart-800_smoke.json"
+    art = run_scenario("restart-800", seed=42, out_path=str(out))
+    assert art["placements"]["placed"] == 6 * 120
+    assert art["events"]["truncated"] is False
+
+    raft = art["raft"]
+    assert raft["enabled"] is True
+    restart = raft["restart"]
+    assert restart["placements_survived"] is True
+    assert restart["pre_kill_placements"] > 0
+    assert restart["surviving_placements"] == restart["pre_kill_placements"]
+    assert restart["downtime_s"] > 0
+    recovery = raft["recovery"]
+    assert recovery["cold_start"] is True
+    assert recovery["entries_replayed"] > 0
+    assert recovery["replayed_by_type"].get("alloc_update", 0) >= 1
+    assert recovery["replay_wall_ms"] is not None
+    assert recovery["time_to_leader_ms"] is not None
+    assert recovery["time_to_serving_ms"] is not None
+    assert recovery["replay_entries_per_s"] > 0
+    # Write-path attribution spans both server lives (plan commits land
+    # as alloc_update entries; the books carry p50/p95 per msg_type).
+    assert raft["write_path"]["alloc_update"]["count"] >= 6
+    assert raft["write_path"]["alloc_update"]["total_ms"]["p95"] > 0
+
+
+def test_restart_smoke_is_seed_deterministic():
+    """The kill point is wall-clock and WHICH evals straddle it is
+    scheduling noise — but every per-key lifecycle (and therefore the
+    canonical digest) must replay under the same seed: placements
+    committed pre-kill come back via log replay, in-flight evals
+    redeliver from durable state, and the event stream dedups the
+    replayed prefix by raft index."""
+    a = run_scenario("restart-800", seed=11)
+    b = run_scenario("restart-800", seed=11)
+    assert a["events"]["digest"] == b["events"]["digest"]
+    assert a["events"]["by_type"] == b["events"]["by_type"]
+
+
 def test_churn_frag_smoke_is_seed_deterministic():
     """Same seed, same canonical digest — deregistration churn and the
     probe wave racing stop plans included."""

@@ -266,16 +266,24 @@ def _attribution_of(artifact: dict) -> dict:
 
 
 def _objectives_for(artifact: dict) -> dict | None:
-    """Objective set for one artifact family: the defaults, plus the
-    express lane's own target (express_placed_p50_ms < 1ms) when the
-    artifact carries express observations — the express-mix family gates
-    ABSOLUTELY on its headline number instead of skipping it. None =
-    the default set (evaluate_artifact's convention)."""
-    from nomad_tpu.slo import DEFAULT_OBJECTIVES, EXPRESS_OBJECTIVES
+    """Objective set for one artifact family: scenario-scoped overrides
+    first (slo.SCENARIO_OBJECTIVES — e.g. churn-fragmentation's probe
+    wave races a deregistration stop storm by design and is judged
+    against its own declared bound, not the 250ms steady-state SLO),
+    plus the express lane's own target (express_placed_p50_ms < 1ms)
+    when the artifact carries express observations — the express-mix
+    family gates ABSOLUTELY on its headline number instead of skipping
+    it. None = the default set (evaluate_artifact's convention)."""
+    from nomad_tpu.slo import (
+        DEFAULT_OBJECTIVES,
+        EXPRESS_OBJECTIVES,
+        SCENARIO_OBJECTIVES,
+    )
 
+    objectives = SCENARIO_OBJECTIVES.get(artifact.get("scenario") or "")
     if _attribution_of(artifact).get("express_placed_ms"):
-        return {**DEFAULT_OBJECTIVES, **EXPRESS_OBJECTIVES}
-    return None
+        return {**(objectives or DEFAULT_OBJECTIVES), **EXPRESS_OBJECTIVES}
+    return objectives
 
 
 def slo_gate(new_artifact: dict, baseline_artifact: dict,
@@ -394,6 +402,58 @@ def solver_gate(new_artifact: dict, baseline_artifact: dict,
     }
 
 
+# Recovery-gate tolerance: restart downtime and replay rates are box-
+# noise-sensitive (re-election jitter alone spans 150-300ms), so the
+# newest-vs-previous bar is deliberately loose — it exists to catch a
+# real recovery regression (2x-class), not scheduler jitter.
+RECOVERY_GATE_TOLERANCE = 0.5
+
+
+def recovery_gate(new_artifact: dict, baseline_artifact: dict | None,
+                  tolerance: float = RECOVERY_GATE_TOLERANCE) -> dict | None:
+    """Gate a restart-family artifact's recovery story. ABSOLUTE (every
+    round, baseline or not): the mid-load leader kill must have lost
+    nothing — ``placements_survived`` is the digest-survival contract,
+    not a statistic. RELATIVE (newest-vs-previous when a prior bank
+    carries a restart section): replay rate (entries/s) must not drop
+    more than ``tolerance``, and time-to-serving must not grow more than
+    ``tolerance``. None when the artifact has no restart section (not a
+    restart family)."""
+    raft = new_artifact.get("raft") or {}
+    restart = raft.get("restart")
+    if not restart:
+        return None
+    recovery = raft.get("recovery") or {}
+    survived = restart.get("placements_survived") is True
+    checks = [{
+        "check": "placements_survived",
+        "value": restart.get("placements_survived"),
+        "baseline": None,
+        "regressed": not survived,
+    }]
+    ok = survived
+    base_raft = (baseline_artifact or {}).get("raft") or {}
+    base_recovery = base_raft.get("recovery") or {}
+    if base_raft.get("restart"):
+        new_rate = recovery.get("replay_entries_per_s")
+        base_rate = base_recovery.get("replay_entries_per_s")
+        if new_rate is not None and base_rate:
+            regressed = new_rate < base_rate * (1.0 - tolerance)
+            checks.append({"check": "replay_entries_per_s",
+                           "value": new_rate, "baseline": base_rate,
+                           "regressed": regressed})
+            ok = ok and not regressed
+        new_tts = recovery.get("time_to_serving_ms")
+        base_tts = base_recovery.get("time_to_serving_ms")
+        if new_tts is not None and base_tts:
+            regressed = new_tts > base_tts * (1.0 + tolerance)
+            checks.append({"check": "time_to_serving_ms",
+                           "value": new_tts, "baseline": base_tts,
+                           "regressed": regressed})
+            ok = ok and not regressed
+    return {"ok": ok, "tolerance": tolerance, "checks": checks}
+
+
 def slo_gate_scan(log=log) -> bool:
     """Run the SLO gate over every banked artifact family: newest-vs-
     previous where a prior round exists, absolute-against-objectives for
@@ -409,11 +469,13 @@ def slo_gate_scan(log=log) -> bool:
             if base_path is None:
                 verdict = slo_gate_absolute(new, objectives)
                 solver_verdict = None
+                recovery_verdict = recovery_gate(new, None)
             else:
                 with open(base_path) as f:
                     base = json.load(f)
                 verdict = slo_gate(new, base, objectives)
                 solver_verdict = solver_gate(new, base)
+                recovery_verdict = recovery_gate(new, base)
         except (OSError, ValueError, KeyError) as e:
             log("slo-gate-error", family=fam, error=str(e))
             ok = False
@@ -432,6 +494,11 @@ def slo_gate_scan(log=log) -> bool:
                     "device_ms_per_placement"],
                 baseline=solver_verdict["baseline_ms_per_placement"])
             ok = ok and solver_verdict["ok"]
+        if recovery_verdict is not None:
+            log("recovery-gate", family=fam, ok=recovery_verdict["ok"],
+                regressed=[c["check"] for c in recovery_verdict["checks"]
+                           if c["regressed"]])
+            ok = ok and recovery_verdict["ok"]
     return ok
 
 

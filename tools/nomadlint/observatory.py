@@ -1,16 +1,19 @@
-"""Observatory pass (OBS001): the capacity observatory is read-only.
+"""Observatory pass (OBS001): the observatories are read-only.
 
-``nomad_tpu/capacity.py`` observes cluster state through the store's
-change logs and must stay invisible to every decision path — the
-decision-invariance proof (the churn-fragmentation scenario's
-observatory-off arm asserting digest equality) only means something if
-no placement, verify, or apply path can even *reach* the observer's
-books. This pass enforces that statically: any ``import`` of
-``nomad_tpu.capacity`` (module-level or function-local, plain or
-from-import) inside the decision scope is a finding.
+``nomad_tpu/capacity.py`` (the capacity observatory) and
+``nomad_tpu/raft_observe.py`` (the raft & recovery observatory) observe
+cluster state through change logs and the raft node's plain-data books,
+and must stay invisible to every decision path — the decision-invariance
+proofs (the churn-fragmentation observatory-off contrast arm's digest
+equality; the steady-10k digest staying byte-equal with the raft
+observatory on) only mean something if no placement, verify, or apply
+path can even *reach* an observer's books. This pass enforces that
+statically: any ``import`` of an observatory module (module-level or
+function-local, plain or from-import) inside the decision scope is a
+finding.
 
 The composition roots are allowlisted by path: ``server/server.py``
-constructs and starts the accountant (lifecycle wiring only — the
+constructs and starts the observers (lifecycle wiring only — the
 ServerConfig parse and start/stop calls), and the exposition layer
 (``api/``, ``bundle.py``) reads snapshots. Everything else in
 scheduler/, server/, state/, raft/, tpu/, and ops/ is barred.
@@ -44,13 +47,21 @@ OBSERVATORY_SCOPE = (
 )
 
 # The one legitimate construction site: the server's composition root
-# builds the accountant and starts/stops it with the other observers
-# (slo monitor, express lane). It may not READ the books either — but
-# that is a review concern; the static bar is the import, and the
-# composition root needs exactly that.
+# builds the observers and starts/stops them (slo monitor, express
+# lane, capacity accountant, raft observatory). It may not READ the
+# books either — but that is a review concern; the static bar is the
+# import, and the composition root needs exactly that.
 COMPOSITION_ROOTS = ("nomad_tpu/server/server.py",)
 
-TARGET_MODULE = "nomad_tpu.capacity"
+TARGET_MODULES = ("nomad_tpu.capacity", "nomad_tpu.raft_observe")
+_TARGET_LEAVES = tuple(m.rsplit(".", 1)[1] for m in TARGET_MODULES)
+
+
+def _match(name: str):
+    for target in TARGET_MODULES:
+        if name == target or name.startswith(target + "."):
+            return target
+    return None
 
 
 def run(project: Project) -> List[Finding]:
@@ -63,22 +74,21 @@ def run(project: Project) -> List[Finding]:
             hit = None
             if isinstance(node, ast.Import):
                 for alias in node.names:
-                    if (alias.name == TARGET_MODULE
-                            or alias.name.startswith(TARGET_MODULE + ".")):
+                    if _match(alias.name):
                         hit = alias.name
             elif isinstance(node, ast.ImportFrom):
                 m = node.module or ""
-                if m == TARGET_MODULE or m.startswith(TARGET_MODULE + "."):
+                if _match(m):
                     hit = m
                 elif m == "nomad_tpu":
                     for alias in node.names:
-                        if alias.name == "capacity":
+                        if alias.name in _TARGET_LEAVES:
                             hit = f"nomad_tpu.{alias.name}"
             if hit is not None:
                 findings.append(Finding(
                     "OBS001", mod.relpath, node.lineno,
                     qualname_of(node, mod.modname),
-                    f"decision-path module imports {hit} — the capacity "
+                    f"decision-path module imports {hit} — an "
                     "observatory must stay invisible to scheduler/apply "
                     "paths (read-only observer contract)",
                     snippet=mod.snippet(node.lineno),
