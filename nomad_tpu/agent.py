@@ -56,6 +56,9 @@ class AgentConfig:
     # Raft & recovery observatory spec (nomad_tpu/raft_observe.py):
     # None = defaults (enabled).
     raft_observe: Optional[Dict] = None
+    # Read-path observatory spec (nomad_tpu/read_observe.py):
+    # None = defaults (enabled).
+    reads: Optional[Dict] = None
     # Solver device mesh spec (nomad_tpu/parallel/mesh.py): None =
     # single-device solves.
     solver_mesh: Optional[Dict] = None
@@ -152,6 +155,8 @@ class AgentConfig:
                       if fc.server.capacity is not None else None),
             raft_observe=(dict(fc.server.raft_observe)
                           if fc.server.raft_observe is not None else None),
+            reads=(dict(fc.server.reads)
+                   if fc.server.reads is not None else None),
             solver_mesh=(dict(fc.server.solver_mesh)
                          if fc.server.solver_mesh is not None else None),
             enable_debug=fc.enable_debug,
@@ -251,6 +256,8 @@ class Agent:
                       if self.config.capacity is not None else None),
             raft_observe=(dict(self.config.raft_observe)
                           if self.config.raft_observe is not None else None),
+            reads=(dict(self.config.reads)
+                   if self.config.reads is not None else None),
             solver_mesh=(dict(self.config.solver_mesh)
                          if self.config.solver_mesh is not None else None),
         )

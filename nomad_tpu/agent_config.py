@@ -110,6 +110,10 @@ class ServerBlock:
     # ``raft_observe { }`` sub-block tunes the read-only observer behind
     # /v1/agent/raft (poll/event cadence). None = defaults (enabled).
     raft_observe: Optional[Dict[str, object]] = None
+    # Read-path observatory (nomad_tpu/read_observe.py): the
+    # ``reads { }`` sub-block tunes the read-only observer behind
+    # /v1/agent/reads (poll/event cadence). None = defaults (enabled).
+    reads: Optional[Dict[str, object]] = None
     # Solver device mesh (nomad_tpu/parallel/mesh.py): the
     # ``solver_mesh { }`` sub-block shards the node axis of every device
     # solve over a JAX mesh — ``node_shards`` devices per eval row,
@@ -321,6 +325,14 @@ class FileConfig:
                 else {**self.server.raft_observe,
                       **other.server.raft_observe}
             ),
+            # Read-observatory knobs merge key-by-key like capacity.
+            reads=(
+                self.server.reads
+                if other.server.reads is None
+                else other.server.reads
+                if self.server.reads is None
+                else {**self.server.reads, **other.server.reads}
+            ),
             # Solver-mesh knobs merge key-by-key like the blocks above.
             solver_mesh=(
                 self.server.solver_mesh if other.server.solver_mesh is None
@@ -527,6 +539,16 @@ def _from_mapping(data: dict) -> FileConfig:
 
                     RaftObserveConfig.parse(dict(v))
                     cfg.server.raft_observe = dict(v)
+                elif k == "reads":
+                    if not isinstance(v, dict):
+                        raise ValueError(
+                            "server.reads must be a mapping")
+                    # Same posture: a typo'd observatory knob fails
+                    # config load (ReadObserveConfig.parse), not start.
+                    from nomad_tpu.read_observe import ReadObserveConfig
+
+                    ReadObserveConfig.parse(dict(v))
+                    cfg.server.reads = dict(v)
                 elif k == "solver_mesh":
                     if not isinstance(v, dict):
                         raise ValueError(

@@ -440,6 +440,16 @@ class AgentApi:
         out, _ = self.client.query("/v1/agent/raft")
         return out
 
+    def reads(self) -> Dict:
+        """Read-path observatory state (/v1/agent/reads): per-endpoint
+        serving attribution (route/lane latency + bytes, blocking
+        hold/serve partition, SSE session books), watch-registry economy
+        (bucket occupancy, wake fan-out, spurious re-probes), and the
+        freshness/staleness distribution every read response is stamped
+        with (nomad_tpu/read_observe.py)."""
+        out, _ = self.client.query("/v1/agent/reads")
+        return out
+
     def traces(self, n: int = 0) -> List[Dict]:
         """Retained trace summaries (/v1/agent/traces), newest first;
         ``n`` limits (0 = all retained)."""

@@ -36,6 +36,16 @@ from nomad_tpu.structs import (
 )
 
 
+def _route_template(pattern: str) -> str:
+    """Stable attribution key for a route regex: named groups become
+    ``:name`` path segments (``^/v1/job/(?P<job_id>[^/]+)$`` →
+    ``/v1/job/:job_id``) so the read observatory's books key on the
+    route SHAPE, never on unbounded concrete ids."""
+    return re.sub(
+        r"\(\?P<([^>]+)>[^)]+\)", r":\1", pattern
+    ).lstrip("^").rstrip("$")
+
+
 def _prefix_filter(items, query):
     """Apply the list endpoints' ``?prefix=`` filter over item ids (the
     reference api's QueryOptions.Prefix: CLI short-id resolution lists
@@ -241,6 +251,7 @@ class HTTPServer:
             (r"^/v1/agent/express$", self.agent_express),
             (r"^/v1/agent/capacity$", self.agent_capacity),
             (r"^/v1/agent/raft$", self.agent_raft),
+            (r"^/v1/agent/reads$", self.agent_reads),
             (r"^/v1/agent/solver$", self.agent_solver),
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
@@ -255,7 +266,13 @@ class HTTPServer:
             (r"^/v1/status/leader$", self.status_leader),
             (r"^/v1/status/peers$", self.status_peers),
         ]
-        self.routes = [(re.compile(p), h) for p, h in self.routes]
+        self.routes = [(re.compile(p), _route_template(p), h)
+                       for p, h in self.routes]
+        # Per-request read-attribution context (route template, lane,
+        # hold/serve seam) threaded to responders + _maybe_block without
+        # touching every handler signature: each request runs on its own
+        # thread (ThreadingHTTPServer), keep-alive requests serially.
+        self._local = threading.local()
 
     def start(self) -> None:
         self._thread.start()
@@ -267,61 +284,132 @@ class HTTPServer:
     # -- dispatch + envelope (http.go:147-226 wrap) --------------------------
 
     def dispatch(self, req: BaseHTTPRequestHandler) -> None:
+        import time as _time
+
         parsed = urlparse(req.path)
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-        for pattern, handler in self.routes:
+        for pattern, template, handler in self.routes:
             m = pattern.match(parsed.path)
             if m is None:
                 continue
+            ctx = {"template": template, "lane": "plain", "status": 200,
+                   "bytes": 0, "hold_s": 0.0, "woke": None}
+            self._local.ctx = ctx
+            t0 = _time.monotonic()
             try:
-                out, index = handler(req, query, **m.groupdict())
-            except HTTPCodedError as e:
-                self._respond_error(req, e.code, str(e))
-            except RejectError as e:
-                self._respond_reject(req, e)
-            except KeyError as e:
-                # Endpoints raise KeyError for missing resources
-                self._respond_error(req, 404, str(e).strip("'\""))
-            except (ValidationError, ValueError) as e:
-                self._respond_error(req, 400, str(e))
-            except Exception as e:
-                self.logger.exception("http: request failed")
-                self._respond_error(req, 500, str(e))
-            else:
-                if out is STREAMED:
-                    pass  # handler streamed the body itself
-                elif isinstance(out, RawResponse):
-                    self._respond_raw(req, out)
+                try:
+                    out, index = handler(req, query, **m.groupdict())
+                except HTTPCodedError as e:
+                    self._respond_error(req, e.code, str(e))
+                except RejectError as e:
+                    self._respond_reject(req, e)
+                except KeyError as e:
+                    # Endpoints raise KeyError for missing resources
+                    self._respond_error(req, 404, str(e).strip("'\""))
+                except (ValidationError, ValueError) as e:
+                    self._respond_error(req, 400, str(e))
+                except Exception as e:
+                    self.logger.exception("http: request failed")
+                    self._respond_error(req, 500, str(e))
                 else:
-                    self._respond_json(req, out, index)
+                    if out is STREAMED:
+                        pass  # handler streamed the body itself
+                    elif isinstance(out, RawResponse):
+                        self._respond_raw(req, out)
+                    else:
+                        self._respond_json(req, out, index)
+            finally:
+                self._local.ctx = None
+                self._record_read(req, ctx, _time.monotonic() - t0)
             return
         self._respond_error(req, 404, "not found")
 
+    def _record_read(self, req, ctx: Dict[str, Any],
+                     duration_s: float) -> None:
+        """Fold one finished GET into the read observatory's recorder
+        (no-op on writes, on a server-less agent, or with the
+        observatory off — the knob gates recording, never headers)."""
+        if req.command != "GET":
+            return
+        obs = self._read_observatory()
+        if obs is None:
+            return
+        rec = obs.recorder
+        rec.record_request(ctx["template"], ctx["lane"], ctx["status"],
+                           duration_s, ctx["bytes"])
+        if ctx["lane"] == "blocking":
+            rec.record_blocking(ctx["template"], ctx["hold_s"],
+                                duration_s, bool(ctx["woke"]))
+
+    def _freshness_headers(self, req) -> None:
+        """Stamp the response with read-freshness meta: the serving
+        server's last-applied raft index, whether it currently knows a
+        leader, and the response's staleness vs the leader commit index
+        (in raft entries). Stamped on EVERY response — plain GETs,
+        errors, and streams alike, not just blocking queries — so a
+        consumer can always judge how fresh the state it read was (the
+        follower-read groundwork). A protocol feature, not an
+        observatory one: headers stay identical with the observatory
+        off; only the recording below is knob-gated. Degrades to no
+        headers on a server-less (client-only) agent."""
+        server = getattr(self.agent, "server", None)
+        raft = getattr(server, "raft", None)
+        if raft is None:
+            return
+        applied = int(getattr(raft, "applied_index", 0) or 0)
+        commit = int(getattr(raft, "commit_index", applied) or applied)
+        age = max(commit - applied, 0)
+        try:
+            known_leader = bool(self.agent.leader_addr())
+        except Exception:
+            known_leader = False
+        req.send_header("X-Nomad-Applied-Index", str(applied))
+        req.send_header("X-Nomad-Staleness", str(age))
+        req.send_header("X-Nomad-KnownLeader",
+                        "true" if known_leader else "false")
+        if req.command == "GET":
+            obs = self._read_observatory()
+            if obs is not None:
+                obs.recorder.record_staleness(age)
+
     def _respond_json(self, req, out: Any, index: Optional[int]) -> None:
         body = json.dumps(to_dict(out)).encode()
+        ctx = getattr(self._local, "ctx", None)
+        if ctx is not None:
+            ctx["bytes"] = len(body)
         req.send_response(200)
         req.send_header("Content-Type", "application/json")
         req.send_header("Content-Length", str(len(body)))
         if index is not None:
-            # Query meta headers (http.go setMeta)
+            # Query meta headers (http.go setMeta; known-leader now
+            # rides the uniform freshness stamp below)
             req.send_header("X-Nomad-Index", str(index))
-            req.send_header("X-Nomad-KnownLeader", "true")
             req.send_header("X-Nomad-LastContact", "0")
+        self._freshness_headers(req)
         req.end_headers()
         req.wfile.write(body)
 
     def _respond_raw(self, req, out: RawResponse) -> None:
+        ctx = getattr(self._local, "ctx", None)
+        if ctx is not None:
+            ctx["bytes"] = len(out.body)
         req.send_response(200)
         req.send_header("Content-Type", out.content_type)
         req.send_header("Content-Length", str(len(out.body)))
+        self._freshness_headers(req)
         req.end_headers()
         req.wfile.write(out.body)
 
     def _respond_error(self, req, code: int, message: str) -> None:
         body = message.encode()
+        ctx = getattr(self._local, "ctx", None)
+        if ctx is not None:
+            ctx["status"] = code
+            ctx["bytes"] = len(body)
         req.send_response(code)
         req.send_header("Content-Type", "text/plain")
         req.send_header("Content-Length", str(len(body)))
+        self._freshness_headers(req)
         req.end_headers()
         req.wfile.write(body)
 
@@ -339,11 +427,16 @@ class HTTPServer:
             "reason": e.reason,
             "retry_after": e.retry_after,
         }).encode()
+        ctx = getattr(self._local, "ctx", None)
+        if ctx is not None:
+            ctx["status"] = code
+            ctx["bytes"] = len(body)
         req.send_response(code)
         req.send_header("Content-Type", "application/json")
         req.send_header("Retry-After",
                         str(max(1, math.ceil(e.retry_after))))
         req.send_header("Content-Length", str(len(body)))
+        self._freshness_headers(req)
         req.end_headers()
         req.wfile.write(body)
 
@@ -360,7 +453,11 @@ class HTTPServer:
 
     def _maybe_block(self, query: Dict[str, str], table: str) -> None:
         """Implements ?index=N&wait=D against the state watch: return when
-        the table index passes N or the wait expires."""
+        the table index passes N or the wait expires. A blocking pass
+        stamps the request's read context: the whole park-until-return
+        wall is the ``hold`` stage (register→wake — what follower
+        serving would keep local), everything after it back in the
+        handler is ``serve`` (wake→respond — what moves)."""
         min_index = int(query.get("index", 0))
         if min_index == 0:
             return
@@ -369,28 +466,45 @@ class HTTPServer:
         wait = min(parse_duration(query.get("wait", "5m")), MAX_QUERY_TIME)
         import time as _time
 
-        end = _time.monotonic() + wait
-        while True:
-            # Re-read per pass: a raft snapshot install rebinds fsm.state,
-            # orphaning any watch parked on the previous store.
-            store = self.agent.server.state_store
-            if store.get_index(table) > min_index:
-                return
-            remaining = end - _time.monotonic()
-            if remaining <= 0:
-                return
-            # register may raise a typed RejectError(WATCH_LIMIT) — the
-            # dispatcher maps it to a 503 with Retry-After.
-            ticket = store.watch.register([item_table(table)])
-            try:
-                # Identity re-check closes the register-vs-rebind race; a
-                # rebind after registration fires notify_all on the old
-                # store, so a full-length wait is safe.
-                if (self.agent.server.state_store is store
-                        and store.get_index(table) <= min_index):
-                    store.watch.wait(ticket, timeout=remaining)
-            finally:
-                store.watch.unregister(ticket)
+        ctx = getattr(self._local, "ctx", None)
+        t0 = _time.monotonic()
+        woke = False
+        end = t0 + wait
+        try:
+            while True:
+                # Re-read per pass: a raft snapshot install rebinds
+                # fsm.state, orphaning any watch parked on the previous
+                # store.
+                store = self.agent.server.state_store
+                if store.get_index(table) > min_index:
+                    woke = True
+                    return
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    return
+                # register may raise a typed RejectError(WATCH_LIMIT) —
+                # the dispatcher maps it to a 503 with Retry-After.
+                ticket = store.watch.register([item_table(table)])
+                try:
+                    # Identity re-check closes the register-vs-rebind
+                    # race; a rebind after registration fires notify_all
+                    # on the old store, so a full-length wait is safe.
+                    if (self.agent.server.state_store is store
+                            and store.get_index(table) <= min_index):
+                        fired = store.watch.wait(ticket, timeout=remaining)
+                        if fired and store.get_index(table) <= min_index:
+                            # Woken by a bucket-sharing neighbor, index
+                            # unmoved: the spurious re-probe the
+                            # coalesced registry trades for O(items)
+                            # publishes. Plain counter, observatory-read.
+                            store.watch.spurious_wakes += 1
+                finally:
+                    store.watch.unregister(ticket)
+        finally:
+            if ctx is not None:
+                ctx["lane"] = "blocking"
+                ctx["hold_s"] = _time.monotonic() - t0
+                ctx["woke"] = woke
 
     def _srv(self):
         if self.agent.server is None:
@@ -639,6 +753,10 @@ class HTTPServer:
             # park the poll.
             index, out = run(broker)
             return out, index
+        import time as _time
+
+        ctx = getattr(self._local, "ctx", None)
+        t0 = _time.monotonic()
         index, out = blocking_query(
             get_store=lambda: broker,
             items=lambda b: tfilter.watch_items(),
@@ -650,6 +768,12 @@ class HTTPServer:
             # matching event landed, not on every unrelated publish.
             index_of=lambda b: b.index_for(tfilter),
         )
+        if ctx is not None:
+            # The blocking_query wall (park + cheap index probes) is the
+            # hold stage; serialization back in the dispatcher is serve.
+            ctx["lane"] = "blocking"
+            ctx["hold_s"] = _time.monotonic() - t0
+            ctx["woke"] = index > min_index
         return out, index
 
     def _stream_sse(self, req, broker, tfilter, min_index, query) -> None:
@@ -671,13 +795,27 @@ class HTTPServer:
             wait = 0.0 if raw_wait in ("", "0") else parse_duration(raw_wait)
         except Exception:
             raise HTTPCodedError(400, "invalid wait duration")
+        ctx = getattr(self._local, "ctx", None)
+        if ctx is not None:
+            ctx["lane"] = "sse"
+        obs = self._read_observatory()
+        rec = obs.recorder if obs is not None else None
+
+        def _w(data: bytes) -> None:
+            req.wfile.write(data)
+            if ctx is not None:
+                ctx["bytes"] += len(data)
+
         req.send_response(200)
         req.send_header("Content-Type", "text/event-stream")
         req.send_header("Cache-Control", "no-cache")
         req.send_header("Connection", "close")
+        self._freshness_headers(req)
         req.end_headers()
         deadline = _time.monotonic() + wait if wait > 0 else None
         cursor = min_index
+        if rec is not None:
+            rec.sse_session_start()
         try:
             while True:
                 idx, evs, truncated = broker.events_after(cursor, tfilter)
@@ -685,7 +823,10 @@ class HTTPServer:
                     # Every time the cursor falls off the ring — not just
                     # on the first page: a tail that lags a burst larger
                     # than the ring mid-stream has lost events too.
-                    req.wfile.write(
+                    # Counted in the session books, never absorbed.
+                    if rec is not None:
+                        rec.sse_truncated()
+                    _w(
                         b"event: Truncated\ndata: "
                         + json.dumps({"resume_index": cursor,
                                       "horizon": broker.horizon()}).encode()
@@ -696,9 +837,15 @@ class HTTPServer:
                         f"event: {e.type}\nid: {e.index}\n"
                         f"data: {json.dumps(e.to_dict())}\n\n"
                     )
-                    req.wfile.write(frame.encode())
+                    _w(frame.encode())
                 req.wfile.flush()
                 cursor = idx
+                if rec is not None and evs:
+                    # Session lag vs the broker head for this filter,
+                    # sampled as the batch goes out.
+                    rec.sse_delivered(
+                        len(evs),
+                        max(broker.index_for(tfilter) - cursor, 0))
                 remaining = (
                     deadline - _time.monotonic() if deadline is not None
                     else 15.0
@@ -722,10 +869,15 @@ class HTTPServer:
                 if not fired:
                     # Keep-alive comment; also how a dead client is
                     # detected while the stream is idle.
-                    req.wfile.write(b": heartbeat\n\n")
+                    _w(b": heartbeat\n\n")
                     req.wfile.flush()
+                    if rec is not None:
+                        rec.sse_heartbeat()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away — the normal end of a tail
+        finally:
+            if rec is not None:
+                rec.sse_session_end()
 
     # -- agent + status endpoints --------------------------------------------
 
@@ -904,6 +1056,108 @@ class HTTPServer:
                 if recovery.get(k) is not None:
                     b.gauge(f"nomad_raft_recovery_{k}", recovery[k])
 
+    def agent_reads(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Read-path observatory state (nomad_tpu/read_observe.py):
+        route-template serving attribution (request counts, latency
+        quantiles, bytes out, plain/blocking/SSE lane split), the
+        blocking-query hold/serve partition, SSE session books, the
+        watch-registry wake economy, and the response-staleness
+        distribution. ``?format=prometheus`` serves just the read
+        families as text exposition. The handler refreshes the
+        watch-economy sample before answering, so the body reflects the
+        registries NOW, not the last poll tick — still read-only."""
+        obs = self._read_observatory()
+        if obs is None:
+            raise HTTPCodedError(404, "read observatory not running "
+                                      "(no server, or reads "
+                                      "{ enabled = false })")
+        obs.refresh()
+        if query.get("format") == "prometheus":
+            b = telemetry.PromText()
+            self._read_prometheus(b)
+            return RawResponse(
+                b.text().encode(), "text/plain; version=0.0.4"
+            ), None
+        return obs.snapshot(), None
+
+    def _read_observatory(self):
+        """The server's read observatory, or None (no server / disabled)
+        — the recording hooks and the metrics endpoint must answer on a
+        client-only agent too."""
+        server = getattr(self.agent, "server", None)
+        obs = getattr(server, "read_observatory", None)
+        if obs is None or not obs.config.enabled:
+            return None
+        return obs
+
+    def _read_summary(self) -> Optional[Dict[str, Any]]:
+        obs = self._read_observatory()
+        return obs.summary() if obs is not None else None
+
+    def _read_prometheus(self, b: "telemetry.PromText") -> None:
+        """Read observatory: per-route request/byte counters + latency
+        quantile gauges, the blocking hold/serve stage partition, SSE
+        session books, the watch-registry wake economy, and the
+        response-staleness distribution."""
+        obs = self._read_observatory()
+        if obs is None:
+            return
+        snap = obs.snapshot()
+        for route, books in snap["endpoints"].items():
+            for lane, n in books["lanes"].items():
+                if n:
+                    b.counter("nomad_read_requests_total", n,
+                              labels={"route": route, "lane": lane})
+            b.counter("nomad_read_errors_total", books["errors"],
+                      labels={"route": route})
+            b.counter("nomad_read_bytes_total", books["bytes_total"],
+                      labels={"route": route})
+            for q in ("p50", "p95", "p99"):
+                b.gauge("nomad_read_latency_ms", books["latency_ms"][q],
+                        labels={"route": route, "quantile": q})
+        for route, books in snap["blocking"].items():
+            b.counter("nomad_read_blocking_wakes_total", books["wakes"],
+                      labels={"route": route})
+            b.counter("nomad_read_blocking_timeouts_total",
+                      books["timeouts"], labels={"route": route})
+            for stage in ("hold", "serve"):
+                b.gauge("nomad_read_blocking_stage_p95_ms",
+                        books[stage + "_ms"]["p95"],
+                        labels={"route": route, "stage": stage})
+        sse = snap["sse"]
+        b.gauge("nomad_read_sse_active", sse["active"])
+        b.counter("nomad_read_sse_sessions_total", sse["started"])
+        b.counter("nomad_read_sse_frames_total", sse["frames"])
+        b.counter("nomad_read_sse_truncations_total", sse["truncations"])
+        b.counter("nomad_read_sse_heartbeats_total", sse["heartbeats"])
+        for q in ("p50", "p95", "p99"):
+            b.gauge("nomad_read_sse_lag_entries", sse["lag_entries"][q],
+                    labels={"quantile": q})
+        for registry, w in snap["watch"].items():
+            labels = {"registry": registry}
+            b.gauge("nomad_read_watchers", w["watchers"], labels=labels)
+            b.gauge("nomad_read_watchers_peak", w["peak_watchers"],
+                    labels=labels)
+            b.gauge("nomad_read_watch_bucket_max",
+                    w["bucket_max_watchers"], labels=labels)
+            b.counter("nomad_read_watch_notifies_total", w["notifies"],
+                      labels=labels)
+            b.counter("nomad_read_watch_wakes_total",
+                      w["wakes_delivered"], labels=labels)
+            b.counter("nomad_read_watch_spurious_total",
+                      w["spurious_wakes"], labels=labels)
+            b.gauge("nomad_read_watch_park_depth", w["multi_waiters"],
+                    labels=labels)
+        fresh = snap["freshness"]
+        b.gauge("nomad_read_applied_index", fresh["applied_index"])
+        b.gauge("nomad_read_commit_index", fresh["commit_index"])
+        b.counter("nomad_read_responses_stamped_total",
+                  fresh["responses_stamped"])
+        for q in ("p50", "p95", "p99"):
+            b.gauge("nomad_read_staleness_entries",
+                    fresh["staleness_entries"][q],
+                    labels={"quantile": q})
+
     def agent_solver(self, req, query) -> Tuple[Any, Optional[int]]:
         """Device-solve efficiency panel (tpu/solver.py SOLVER_PANEL):
         per-solve padding economy, bucket-occupancy histograms,
@@ -961,6 +1215,7 @@ class HTTPServer:
             self._express_prometheus(b)
             self._capacity_prometheus(b)
             self._raft_prometheus(b)
+            self._read_prometheus(b)
             _solver_prometheus(b)
             return RawResponse(
                 (telemetry.prometheus_text(sink) + b.text()).encode(),
@@ -973,6 +1228,7 @@ class HTTPServer:
                 "express": self._express_stats(),
                 "capacity": self._capacity_summary(),
                 "raft": self._raft_summary(),
+                "reads": self._read_summary(),
                 "solver_panel": _solver_panel_stats(),
                 "trace": trace.get_tracer().stats()}, None
 

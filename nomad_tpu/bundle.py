@@ -32,6 +32,11 @@ everything the observability stack retains at the moment of capture —
                   utilization, bin-pack density, per-lane usage,
                   fragmentation histograms, stranded-capacity % — the
                   utilization picture a postmortem needs
+- ``reads``       the read-path observatory (nomad_tpu/read_observe.py):
+                  per-endpoint serving attribution (lane split, blocking
+                  hold/serve partition, SSE session books), watch-registry
+                  wake economy, and the freshness/staleness distribution —
+                  what the follower read path was doing at capture time
 - ``solver``      the device-solve efficiency panel (tpu/solver.py):
                   padding waste, bucket occupancy, compile attribution,
                   device-time-per-placement
@@ -67,8 +72,8 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
     "faults", "breaker", "mirror", "plan_pipeline", "slo", "admission",
-    "express", "capacity", "raft", "solver", "timelines", "nomadlint",
-    "threads",
+    "express", "capacity", "raft", "reads", "solver", "timelines",
+    "nomadlint", "threads",
 )
 
 # Every `python -m tools.nomadlint` run writes its full report here; the
@@ -244,6 +249,20 @@ def _raft_section(agent) -> Optional[Dict[str, Any]]:
     return obs.snapshot()
 
 
+def _reads_section(agent) -> Optional[Dict[str, Any]]:
+    """Read-path observatory snapshot (nomad_tpu/read_observe.py): the
+    serving books a read-pressure postmortem needs — which routes were
+    hot, how long blocking queries held vs served, whether SSE tails
+    were lagging or truncating, and how stale the answers were. None
+    without a server or with the observatory disabled."""
+    server = getattr(agent, "server", None) if agent is not None else None
+    obs = getattr(server, "read_observatory", None)
+    if obs is None or not obs.config.enabled:
+        return None
+    obs.refresh()
+    return obs.snapshot()
+
+
 def _solver_section() -> Dict[str, Any]:
     """Device-solve efficiency panel (tpu/solver.py SOLVER_PANEL):
     padding economy, bucket occupancy, compile attribution — next to the
@@ -311,6 +330,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "express": None,
         "capacity": None,
         "raft": None,
+        "reads": None,
         "solver": None,
         "timelines": [],
         "nomadlint": None,
@@ -329,6 +349,7 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("express", lambda: _express_section(agent)),
         ("capacity", lambda: _capacity_section(agent)),
         ("raft", lambda: _raft_section(agent)),
+        ("reads", lambda: _reads_section(agent)),
         ("solver", _solver_section),
         ("timelines", lambda: _timelines_section(agent, last_events)),
         ("nomadlint", _nomadlint_section),

@@ -58,6 +58,10 @@ Capacity   CapacitySnapshot (fixed key "capacity"; OBSERVER topic — the
 Raft       RaftSnapshot (fixed key "raft"; OBSERVER topic like Capacity
            — the raft observatory's periodic replication/log-economy
            snapshots, nomad_tpu/raft_observe.py)
+Read       ReadSnapshot (fixed key "reads"; OBSERVER topic like Capacity
+           — the read-path observatory's periodic serving-attribution/
+           watch-economy/freshness snapshots,
+           nomad_tpu/read_observe.py)
 =========  ==============================================================
 
 Blocking consumption reuses the state store's watch registry
@@ -87,7 +91,7 @@ ITEM_ANY: WatchItem = ("events", "_any_")
 # construction: how many ticks a run's wall time fits is scheduling
 # noise, and an observer being ON vs OFF must be digest-invariant — the
 # observatory's decision-invariance proof depends on exactly that.
-OBSERVER_TOPICS = frozenset({"Capacity", "Raft"})
+OBSERVER_TOPICS = frozenset({"Capacity", "Raft", "Read"})
 
 
 def item_topic(topic: str) -> WatchItem:

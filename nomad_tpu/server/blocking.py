@@ -83,6 +83,12 @@ def blocking_query(
             # rely on for their no-lost-wakeup argument).
             if (get_store() is store
                     and index_of(store) <= min_index):
-                store.watch.wait(ticket, timeout=remaining)
+                fired = store.watch.wait(ticket, timeout=remaining)
+                if fired and index_of(store) <= min_index:
+                    # Bucket-sharing neighbor's publish woke us but our
+                    # index never moved: the re-probe-and-re-park cost
+                    # the coalesced registry trades for O(items)
+                    # publishes. Plain counter; read_observe drains it.
+                    store.watch.spurious_wakes += 1
         finally:
             store.watch.unregister(ticket)

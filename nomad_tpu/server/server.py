@@ -147,6 +147,15 @@ class ServerConfig:
     # None = defaults (enabled; decision-invariant by construction: the
     # observer drains bounded books the raft node keeps as plain data).
     raft_observe: Optional[Dict] = None
+    # Read-path observatory spec (ReadObserveConfig.parse mapping,
+    # nomad_tpu/read_observe.py): the read-only observer behind
+    # /v1/agent/reads — per-route serving attribution, the blocking
+    # hold/serve partition, SSE session books, watch-registry wake
+    # economy, response-staleness distribution. None = defaults
+    # (enabled; decision-invariant by construction: the HTTP layer
+    # writes plain books, nothing feeds back — pinned by the read-storm
+    # contrast arm).
+    reads: Optional[Dict] = None
     # Solver mesh spec (SolverMeshConfig.parse mapping,
     # nomad_tpu/parallel/mesh.py): shard the node axis of every device
     # solve (and the mirror's padded buffers) over a JAX device mesh —
@@ -199,6 +208,9 @@ class ServerConfig:
         from nomad_tpu.raft_observe import RaftObserveConfig
 
         self.raft_observe_config = RaftObserveConfig.parse(self.raft_observe)
+        from nomad_tpu.read_observe import ReadObserveConfig
+
+        self.reads_config = ReadObserveConfig.parse(self.reads)
         from nomad_tpu.parallel.mesh import SolverMeshConfig
 
         self.solver_mesh_config = SolverMeshConfig.parse(self.solver_mesh)
@@ -323,6 +335,20 @@ class Server:
             events=self.fsm.events,
             fsm_getter=lambda: self.fsm,
         )
+        # The read-path observatory (nomad_tpu/read_observe.py): owns
+        # the recorder the HTTP exposition layer writes per-request
+        # books into, and samples the watch registries' plain wake-
+        # economy counters. Same OBS001 composition-root contract; the
+        # getters re-read per poll (snapshot installs rebind fsm.state,
+        # ClusterServer swaps the raft node).
+        from nomad_tpu.read_observe import ReadObservatory
+
+        self.read_observatory = ReadObservatory(
+            lambda: self.fsm.state,
+            lambda: self.raft,
+            self.config.reads_config,
+            events=self.fsm.events,
+        )
         self._periodic_stop = threading.Event()
         self._started = False
 
@@ -351,6 +377,7 @@ class Server:
         self.express_lane.start()
         self.capacity_accountant.start()
         self.raft_observatory.start()
+        self.read_observatory.start()
         self.restore_eval_broker()
         for i in range(self.config.scheduler_workers):
             worker = Worker(self, i)
@@ -438,6 +465,7 @@ class Server:
         self.express_lane.stop()
         self.capacity_accountant.stop()
         self.raft_observatory.stop()
+        self.read_observatory.stop()
         if self.slo_monitor is not None:
             self.slo_monitor.stop()
         self.plan_applier.stop()
@@ -1061,6 +1089,8 @@ class Server:
             "raft_observe": (self.raft_observatory.summary()
                              if self.config.raft_observe_config.enabled
                              else None),
+            "reads": (self.read_observatory.summary()
+                      if self.config.reads_config.enabled else None),
         }
 
     @staticmethod

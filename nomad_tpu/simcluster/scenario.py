@@ -61,6 +61,7 @@ from nomad_tpu.simcluster.workload import (
     NodeChurnInjector,
     NodeRefreshInjector,
     OverdriveInjector,
+    ReadFleetInjector,
     SteadyServiceInjector,
     UpdateChurnInjector,
     build_job,
@@ -399,6 +400,89 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
                         "observatory-off contrast arm proves digest "
                         "equality (decision invariance)",
         ),
+        "read-storm": ScenarioSpec(
+            name="read-storm", n_nodes=10_000,
+            injectors=lambda seed: [
+                # The steady-10k write load, verbatim: the read books
+                # must be kept UNDER the north-star placement flow, not
+                # on an idle cell — and the leader's plan p50 under read
+                # pressure is this artifact's headline number.
+                SteadyServiceInjector(
+                    seed, jobs=24, tasks_per_job=420, over=18.0,
+                ),
+                NodeRefreshInjector(
+                    seed, count=12, every=0.9, start=0.7, until=17.5,
+                ),
+                # The impolite read fleet, leader-directed: tight-loop
+                # pollers over the list endpoints, blocking watchers
+                # advancing on X-Nomad-Index, and SSE tails riding the
+                # event firehose.
+                ReadFleetInjector(
+                    seed, pollers=6, watchers=6, sse_tails=3,
+                    poll_interval=0.3, start=1.0, duration=16.0,
+                ),
+            ],
+            server_overrides={
+                # Fresh read books: the observatory rolls every 250ms
+                # and stamps a Read event snapshot every 2s.
+                "reads": {"poll_interval": 0.25, "events_interval": 2.0},
+            },
+            # The reads-OFF arm: identical write load AND identical read
+            # fleet, observatory disabled. Its canonical digest must
+            # EQUAL the main arm's — reads never touch the decision
+            # path, observed or not.
+            contrast_overrides={
+                "reads": {"enabled": False},
+            },
+            contrast_digest_invariant=True,
+            # ack_cap=0: the post-quiesce harness acks would land as a
+            # multi-second submit_to_running observation and fail the
+            # first-round ABSOLUTE slo gate on plumbing, not placement
+            # (the express-mix bank made the same cut).
+            quiesce_timeout=300.0, ack_cap=0,
+            description="the read-path proof: the steady-10k write load "
+                        "(24 service jobs x420 tasks over ~18s, node-"
+                        "refresh writes riding along) while a seeded "
+                        "impolite read fleet (6 pollers, 6 blocking "
+                        "watchers, 3 SSE tails) hammers the leader's "
+                        "HTTP front end; the reads section banks "
+                        "per-route serving attribution, the blocking "
+                        "hold/serve partition, SSE session books, watch-"
+                        "registry wake economy and the staleness "
+                        "distribution, and a reads-observatory-OFF "
+                        "contrast arm proves digest equality",
+        ),
+        "read-storm-800": ScenarioSpec(
+            name="read-storm-800", n_nodes=800,
+            injectors=lambda seed: [
+                SteadyServiceInjector(
+                    seed, jobs=6, tasks_per_job=120, over=3.0,
+                ),
+                ReadFleetInjector(
+                    seed, pollers=2, watchers=2, sse_tails=1,
+                    poll_interval=0.15, start=0.5, duration=4.0,
+                ),
+            ],
+            server_overrides={
+                "reads": {"poll_interval": 0.2, "events_interval": 1.0},
+                "event_buffer_size": 8192,
+                # Long TTLs: loaded-box beat lag must not expire a live
+                # node mid-run (the overdrive smoke's posture).
+                "max_heartbeats_per_second": 2.0,
+            },
+            contrast_overrides={
+                "reads": {"enabled": False},
+                "event_buffer_size": 8192,
+                "max_heartbeats_per_second": 2.0,
+            },
+            contrast_digest_invariant=True,
+            quiesce_timeout=120.0, ack_cap=0, warmup_count=100,
+            description="tier-1 read-path smoke: 800 nodes, 6 service "
+                        "jobs x120 tasks under a small impolite read "
+                        "fleet (2 pollers, 2 blocking watchers, 1 SSE "
+                        "tail); reads section banked, reads-off "
+                        "contrast arm digest-equal",
+        ),
         "restart-under-load": ScenarioSpec(
             name="restart-under-load", n_nodes=10_000,
             injectors=lambda seed: [
@@ -553,6 +637,25 @@ def _quantiles(samples: List[float]) -> Dict:
     }
 
 
+class _HttpShim:
+    """Minimal agent facade for the read fleet's loopback HTTP front
+    end: the read handlers only reach ``agent.server`` (tests/
+    test_faults.py pins the same posture with its FakeAgent). Resolves
+    the runner's CURRENT server per request so a mid-run leader restart
+    swaps transparently under the fleet."""
+
+    def __init__(self, runner: "ScenarioRunner"):
+        self._runner = runner
+
+    @property
+    def server(self):
+        return self._runner._srv
+
+    def leader_addr(self) -> str:
+        srv = self._runner._srv
+        return srv.rpc_addr if srv.raft.is_leader else ""
+
+
 class ScenarioRunner:
     def __init__(self, spec: ScenarioSpec, seed: int = 42,
                  logger: Optional[logging.Logger] = None,
@@ -606,6 +709,14 @@ class ScenarioRunner:
         self._hb_carry: Dict = {}
         self._data_dir: Optional[str] = None
         self._restart: Optional[Dict] = None
+        # Read-fleet bookkeeping (ReadFleetInjector): the lazily-started
+        # loopback HTTP front end, the reader threads, and the
+        # client-side request books the artifact's reads section carries
+        # next to the observatory's server-side attribution.
+        self._http = None
+        self._readers: List[threading.Thread] = []
+        self._reader_stats: List[Dict] = []
+        self._t_actions0 = 0.0
 
     # -- observation --------------------------------------------------------
 
@@ -843,6 +954,110 @@ class ScenarioRunner:
         self.logger.info("simcluster: silenced %d nodes (%d hosting allocs)",
                          len(pick), len(hosting & set(pick)))
         return pick
+
+    def _read_storm(self, payload: Dict) -> None:
+        """Launch the impolite read fleet (ReadFleetInjector): stand the
+        loopback HTTP front end up (lazily, first storm only) and start
+        the reader threads — tight-loop pollers over the list
+        endpoints, blocking watchers advancing on X-Nomad-Index, SSE
+        tails over /v1/event/stream — each running until the payload's
+        ``until`` offset. The runner keeps only the CLIENT-side books
+        here (requests/wakes/frames as the readers experienced them);
+        per-route attribution, the hold/serve partition and the session
+        books are the read observatory's job, and the two views land
+        side by side in the artifact's reads section."""
+        from urllib.request import urlopen
+
+        from nomad_tpu.api.http import HTTPServer
+
+        if self._http is None:
+            self._http = HTTPServer(
+                _HttpShim(self), port=0,
+                logger=self.logger.getChild("readhttp"),
+            )
+            self._http.start()
+        base = self._http.addr
+        deadline = self._t_actions0 + float(payload["until"])
+        interval = float(payload.get("poll_interval", 0.2))
+        jitters = list(payload.get("poll_jitters") or [1.0])
+        paths = ("/v1/jobs", "/v1/nodes", "/v1/allocations",
+                 "/v1/evaluations")
+        stats = self._reader_stats
+        stop = self._stop
+
+        def poller(k: int) -> None:
+            jitter = float(jitters[k % len(jitters)])
+            n = errs = nbytes = 0
+            while time.monotonic() < deadline and not stop.is_set():
+                path = paths[(n + k) % len(paths)]
+                try:
+                    with urlopen(base + path, timeout=10.0) as resp:
+                        nbytes += len(resp.read())
+                except Exception:
+                    errs += 1
+                n += 1
+                time.sleep(interval * jitter)
+            stats.append({"kind": "pollers", "requests": n,
+                          "errors": errs, "bytes": nbytes})
+
+        def watcher(k: int) -> None:
+            path = paths[k % len(paths)]
+            index = 1
+            n = wakes = timeouts = errs = 0
+            while time.monotonic() < deadline and not stop.is_set():
+                try:
+                    with urlopen(f"{base}{path}?index={index}&wait=2s",
+                                 timeout=15.0) as resp:
+                        resp.read()
+                        new = int(resp.headers.get("X-Nomad-Index") or 0)
+                    if new > index:
+                        wakes += 1
+                        index = new
+                    else:
+                        timeouts += 1
+                except Exception:
+                    errs += 1
+                n += 1
+            stats.append({"kind": "watchers", "requests": n,
+                          "wakes": wakes, "timeouts": timeouts,
+                          "errors": errs})
+
+        def sse_tail(k: int) -> None:
+            sessions = frames = errs = 0
+            while time.monotonic() < deadline and not stop.is_set():
+                # Bounded sessions that reconnect until the deadline:
+                # each pass exercises the preamble, the frame loop and
+                # the wait-lapse teardown.
+                wait_s = max(min(deadline - time.monotonic(), 4.0), 0.5)
+                try:
+                    with urlopen(
+                        f"{base}/v1/event/stream?format=sse"
+                        f"&wait={wait_s:.1f}s",
+                        timeout=30.0,
+                    ) as resp:
+                        sessions += 1
+                        for line in resp:
+                            if line.startswith(b"data:"):
+                                frames += 1
+                except Exception:
+                    errs += 1
+            stats.append({"kind": "sse_tails", "sessions": sessions,
+                          "frames": frames, "errors": errs})
+
+        specs = (("pollers", poller, "sim-read-poll"),
+                 ("watchers", watcher, "sim-read-watch"),
+                 ("sse_tails", sse_tail, "sim-read-sse"))
+        for key, target, prefix in specs:
+            for k in range(int(payload.get(key, 0))):
+                t = threading.Thread(target=target, args=(k,),
+                                     daemon=True, name=f"{prefix}-{k}")
+                t.start()
+                self._readers.append(t)
+        self.logger.info(
+            "simcluster: read storm launched (%s pollers, %s watchers, "
+            "%s sse tails) until t=%.1fs",
+            payload.get("pollers", 0), payload.get("watchers", 0),
+            payload.get("sse_tails", 0), float(payload["until"]))
 
     def _cluster_config(self, bind_port: int = 0) -> ClusterConfig:
         kwargs = dict(bootstrap_expect=1, bind_port=bind_port)
@@ -1102,6 +1317,7 @@ class ScenarioRunner:
                 a for inj in injectors for a in inj.actions()
             )
             t0 = time.monotonic()
+            self._t_actions0 = t0
             expected_evals: List[str] = []
             failed_tranche: List[str] = []
             # IMPOLITE registrations (OverdriveInjector): each client's
@@ -1170,6 +1386,8 @@ class ScenarioRunner:
                     # in flight across the kill (only worker-side eval/
                     # plan work, which the durable log re-drives).
                     self._restart_leader(fleet)
+                elif action.kind == "read_storm":
+                    self._read_storm(action.payload)
             for t in blasters:
                 t.join()
             if blast_errors:
@@ -1179,6 +1397,16 @@ class ScenarioRunner:
                 ) from blast_errors[0]
             for out in blasted:
                 expected_evals.extend(ev_id for ev_id in out if ev_id)
+            # Read-fleet threads stop at their own payload deadline;
+            # every reader must be off the wire before quiescence is
+            # judged (an in-flight blocking query parks watcher tickets
+            # the registry books would still count).
+            for t in self._readers:
+                t.join(timeout=60.0)
+            live_readers = [t.name for t in self._readers if t.is_alive()]
+            if live_readers:
+                raise RuntimeError(
+                    f"read-fleet reader(s) did not stop: {live_readers}")
 
             # The restart action swaps the server instance mid-loop;
             # everything from quiescence on reads the CURRENT one.
@@ -1242,6 +1470,9 @@ class ScenarioRunner:
             tracer.enabled = tracing_was
             if spec.faults_spec is not None:
                 faults.get_registry().clear()
+            if self._http is not None:
+                self._http.shutdown()
+                self._http = None
             fleet.stop()
             self._srv.shutdown()
             if self._data_dir is not None:
@@ -1501,6 +1732,7 @@ class ScenarioRunner:
             }
         artifact["capacity"] = self._capacity_section(srv)
         artifact["raft"] = self._raft_section(srv)
+        artifact["reads"] = self._reads_section(srv)
         artifact["solver_panel"] = self._solver_panel_section()
         if self.attribution_layer:
             from nomad_tpu import lifecycle, slo
@@ -1611,6 +1843,41 @@ class ScenarioRunner:
                     f"leader restart lost placements: {surviving}/"
                     f"{len(pre)} survived the replay"
                 )
+        return out
+
+    def _reads_section(self, srv) -> Dict:
+        """The read observatory's run report (nomad_tpu/read_observe.py):
+        per-route serving attribution, the blocking hold/serve
+        partition, SSE session books, watch-registry wake economy and
+        the staleness distribution — plus the CLIENT side of any
+        injected read fleet (requests/wakes/frames as the readers
+        experienced them, cross-checkable against the server books).
+        {"enabled": False} in the reads-off contrast arm (presence
+        keeps the artifact schema stable across arms, the capacity
+        section's posture)."""
+        fleet = self._fleet_summary()
+        obs = getattr(srv, "read_observatory", None)
+        if obs is None or not srv.config.reads_config.enabled:
+            out = {"enabled": False}
+        else:
+            obs.refresh()
+            out = {"enabled": True, **obs.snapshot()}
+        if fleet:
+            out["fleet"] = fleet
+        return out
+
+    def _fleet_summary(self) -> Dict:
+        """Sum the per-reader client books by population (pollers/
+        watchers/sse_tails) — the injector's experience of the read
+        path, the admission section's injector-view posture."""
+        out: Dict[str, Dict] = {}
+        for s in self._reader_stats:
+            agg = out.setdefault(s["kind"], {})
+            for k, v in s.items():
+                if k == "kind":
+                    continue
+                agg[k] = agg.get(k, 0) + v
+            agg["readers"] = agg.get("readers", 0) + 1
         return out
 
     def _solver_panel_section(self) -> Dict:
@@ -1769,6 +2036,7 @@ def run_scenario(name: str, seed: int = 42, out_path: Optional[str] = None,
                 full["events"]["digest"] == artifact["events"]["digest"]
             )
             artifact["contrast"]["capacity"] = full.get("capacity")
+            artifact["contrast"]["reads"] = full.get("reads")
     if out_path:
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=2, sort_keys=True)

@@ -462,6 +462,65 @@ class LeaderRestartInjector(Injector):
         return [Action(at=self.at, kind="restart_leader", payload={})]
 
 
+class ReadFleetInjector(Injector):
+    """IMPOLITE read pressure: the seeded follower-read fleet the
+    read-path observatory (nomad_tpu/read_observe.py) is judged against.
+
+    One ``read_storm`` action schedules the whole fleet; the runner
+    lazily stands up a loopback HTTP front end over the live server and
+    drives three reader populations on their own threads until
+    ``until``:
+
+    - ``pollers`` tight-loop plain GETs over the list endpoints
+      (/v1/jobs, /v1/nodes, /v1/allocations, /v1/evaluations) at
+      ``poll_interval`` pacing with per-reader seeded jitter — the
+      cheap-but-rude dashboard-refresh population.
+    - ``watchers`` long-poll the same endpoints with
+      ``?index=N&wait=`` blocking queries, advancing their cursor on
+      each X-Nomad-Index — the well-behaved change-notification
+      population whose register→wake hold time the observatory's
+      hold/serve partition attributes.
+    - ``sse_tails`` hold ``/v1/event/stream?format=sse`` sessions open
+      and count frames — the firehose population the SSE session books
+      (lag vs broker head, Truncated accounting) exist for.
+
+    Reads never touch the decision path — the action list and every
+    reader's pacing jitter are seed-determined so the CLIENT-side
+    request counts replay, and the canonical event digest is
+    read-invariant by construction (reads publish nothing)."""
+
+    name = "read-fleet"
+
+    def __init__(self, seed: int, pollers: int = 4, watchers: int = 4,
+                 sse_tails: int = 2, poll_interval: float = 0.2,
+                 start: float = 0.5, duration: float = 10.0):
+        super().__init__(seed)
+        self.pollers = pollers
+        self.watchers = watchers
+        self.sse_tails = sse_tails
+        self.poll_interval = poll_interval
+        self.start = start
+        self.duration = duration
+
+    def actions(self) -> List[Action]:
+        # Per-reader pacing jitter is drawn HERE, from the injector's
+        # name-salted stream, so the fleet's offered load replays without
+        # the runner threads sharing an rng.
+        jitters = [round(0.5 + self.rng.random(), 6)
+                   for _ in range(self.pollers)]
+        return [Action(
+            at=self.start, kind="read_storm",
+            payload={
+                "pollers": self.pollers,
+                "watchers": self.watchers,
+                "sse_tails": self.sse_tails,
+                "poll_interval": self.poll_interval,
+                "poll_jitters": jitters,
+                "until": self.start + self.duration,
+            },
+        )]
+
+
 class NodeChurnInjector(Injector):
     """Node-failure churn: silence ``count`` nodes at ``at`` seconds. The
     runner resolves the tranche (preferring alloc-hosting nodes with this

@@ -76,6 +76,13 @@ EXPRESS_OBJECTIVES: Dict[str, float] = {
 #   restore + log replay) and THEN place — survival and recovery speed
 #   are the contract (the recovery gate judges those), so the placed
 #   bound absorbs the declared downtime.
+# - read-storm (and its smoke): the leader's HTTP front end serves an
+#   impolite read fleet BY DESIGN while the steady-10k write load
+#   places — the GIL contention between serving and planning is the
+#   number the artifact banks (plan p50 under read pressure), and the
+#   read lanes themselves are judged by bench_watch's read gate. The
+#   1s placed bound catches a real write-path regression without
+#   pretending the run ever promised the uncontended 250ms SLO.
 SCENARIO_OBJECTIVES: Dict[str, Dict[str, float]] = {
     "churn-fragmentation": {**DEFAULT_OBJECTIVES,
                             "submit_to_placed_p95_ms": 1000.0},
@@ -85,6 +92,10 @@ SCENARIO_OBJECTIVES: Dict[str, Dict[str, float]] = {
                            "submit_to_placed_p95_ms": 15000.0},
     "restart-800": {**DEFAULT_OBJECTIVES,
                     "submit_to_placed_p95_ms": 15000.0},
+    "read-storm": {**DEFAULT_OBJECTIVES,
+                   "submit_to_placed_p95_ms": 1000.0},
+    "read-storm-800": {**DEFAULT_OBJECTIVES,
+                       "submit_to_placed_p95_ms": 1000.0},
 }
 
 _NAME_RE = re.compile(r"^(?P<metric>[a-z_]+)_p(?P<pct>\d{1,2})_ms$")

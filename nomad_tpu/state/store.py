@@ -238,6 +238,15 @@ class _Watch:
         self.rejected = 0
         self.notifies = 0
         self.peak_watchers = 0
+        # Wake-economy books (read_observe.py drains them; plain data —
+        # this module must never import the observatory, OBS001):
+        # per-bucket occupancy, total waiters woken by notifies, and
+        # spurious wakes (callers bump after a woke-but-index-unmoved
+        # re-probe — the bucket-sharing cost this registry trades for
+        # O(touched-items) publishes).
+        self.bucket_watchers = [0] * self.NUM_BUCKETS
+        self.wakes_delivered = 0
+        self.spurious_wakes = 0
 
     @staticmethod
     def _bucket(item: WatchItem) -> int:
@@ -255,6 +264,7 @@ class _Watch:
         (see the class protocol note). Raises RejectError(WATCH_LIMIT)
         when the registration cap is reached."""
         items = list(items)
+        buckets = sorted({self._bucket(item) for item in items})
         with self._meta_lock:
             if self.max_watchers and self._watchers >= self.max_watchers:
                 self.rejected += 1
@@ -273,7 +283,8 @@ class _Watch:
                 self._kind_counts[item[0]] = (
                     self._kind_counts.get(item[0], 0) + 1
                 )
-        buckets = sorted({self._bucket(item) for item in items})
+            for b in buckets:
+                self.bucket_watchers[b] += 1
         multi = len(buckets) > 1
         multi_gen = 0
         if multi:
@@ -299,6 +310,8 @@ class _Watch:
                     self._kind_counts.pop(item[0], None)
                 else:
                     self._kind_counts[item[0]] = n
+            for b in ticket.buckets:
+                self.bucket_watchers[b] -= 1
         if ticket.multi:
             with self._multi_cond:
                 self._multi_waiters -= 1
@@ -361,14 +374,21 @@ class _Watch:
         during it."""
         return self._kind_counts.get(kind, 0) > 0
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, object]:
+        with self._meta_lock:
+            bucket_watchers = list(self.bucket_watchers)
+            watchers = self._watchers
         return {
-            "watchers": self._watchers,
+            "watchers": watchers,
             "peak_watchers": self.peak_watchers,
             "max_watchers": self.max_watchers,
             "rejected": self.rejected,
             "notifies": self.notifies,
             "buckets": self.NUM_BUCKETS,
+            "bucket_watchers": bucket_watchers,
+            "wakes_delivered": self.wakes_delivered,
+            "spurious_wakes": self.spurious_wakes,
+            "multi_waiters": self._multi_waiters,
         }
 
     # -- notification -------------------------------------------------------
@@ -390,11 +410,16 @@ class _Watch:
             if seen & bit:
                 continue
             seen |= bit
+            # Fan-out accounting: every waiter parked on this bucket is
+            # about to wake (plain int read under the GIL, the loss-free
+            # counter posture above).
+            self.wakes_delivered += self.bucket_watchers[b]
             cond = self._conds[b]
             with cond:
                 self._gens[b] += 1
                 cond.notify_all()
         if self._multi_waiters:
+            self.wakes_delivered += self._multi_waiters
             with self._multi_cond:
                 self._multi_gen += 1
                 self._multi_cond.notify_all()

@@ -428,3 +428,80 @@ def test_recovery_gate_rides_the_scan(tmp_path, monkeypatch):
     rec = next(r for r in logged if r["event"] == "recovery-gate")
     assert rec["ok"] is False
     assert rec["regressed"] == ["replay_entries_per_s"]
+
+
+# ---------------------------------------------------------------------------
+# Read gate (tools/bench_watch.read_gate)
+# ---------------------------------------------------------------------------
+
+
+def _reads_artifact(p95=10.0, staleness_p99=0.0, enabled=True):
+    art = _artifact()
+    art["scenario"] = "read-storm"
+    art["reads"] = {
+        "enabled": enabled,
+        "endpoints": {
+            "/v1/jobs": {"latency_ms": {"p95": p95}},
+            "/v1/nodes": {"latency_ms": {"p95": p95 / 2}},
+        },
+        "freshness": {"staleness_entries": {"p99": staleness_p99}},
+    }
+    return art
+
+
+def test_read_gate_scoped_to_read_carrying_families():
+    """No reads section / reads disabled → not this gate's business;
+    first-round read-carrying families report without failing (there is
+    no declared absolute read-latency bound)."""
+    assert bench_watch.read_gate(_artifact(), None) is None
+    assert bench_watch.read_gate(_reads_artifact(enabled=False),
+                                 None) is None
+    first = bench_watch.read_gate(_reads_artifact(p95=40.0), None)
+    assert first["ok"] is True
+    lat = next(c for c in first["checks"]
+               if c["check"] == "read_latency_p95_ms")
+    assert lat["value"] == 40.0 and lat["baseline"] is None
+
+
+def test_read_gate_newest_vs_previous_tolerance():
+    """Worst-route p95 gates at 50% relative; the staleness p99 carries
+    a 2-entry absolute slack on top (a healthy single-member cell sits
+    at 0-1 entries, where a pure relative bar would fail on noise)."""
+    base = _reads_artifact(p95=10.0, staleness_p99=0.0)
+    within = bench_watch.read_gate(_reads_artifact(p95=14.0), base)
+    assert within["ok"] is True
+    slow = bench_watch.read_gate(_reads_artifact(p95=20.0), base)
+    assert slow["ok"] is False
+    assert [c["check"] for c in slow["checks"] if c["regressed"]] \
+        == ["read_latency_p95_ms"]
+    # Staleness: 0 → 2 rides the slack; 0 → 3 is a regression.
+    noisy = bench_watch.read_gate(
+        _reads_artifact(staleness_p99=2.0), base)
+    assert noisy["ok"] is True
+    stale = bench_watch.read_gate(
+        _reads_artifact(staleness_p99=3.0), base)
+    assert stale["ok"] is False
+    assert [c["check"] for c in stale["checks"] if c["regressed"]] \
+        == ["staleness_age_p99_entries"]
+    # A reads-disabled baseline gives the new run a first-round pass,
+    # not a divide-by-baseline surprise.
+    off_base = _reads_artifact(enabled=False)
+    assert bench_watch.read_gate(_reads_artifact(p95=99.0),
+                                 off_base)["ok"] is True
+
+
+def test_read_gate_rides_the_scan(tmp_path, monkeypatch):
+    new = tmp_path / "SIMLOAD_read-storm_s42_r16.json"
+    old = tmp_path / "SIMLOAD_read-storm_s42_r15.json"
+    new.write_text(json.dumps(_reads_artifact(p95=30.0)))
+    old.write_text(json.dumps(_reads_artifact(p95=10.0)))
+    monkeypatch.setattr(
+        bench_watch, "_banked_simload_pairs",
+        lambda: [("read-storm_s42", str(new), str(old))])
+    logged = []
+    ok = bench_watch.slo_gate_scan(
+        log=lambda event, **kw: logged.append({"event": event, **kw}))
+    assert ok is False
+    rec = next(r for r in logged if r["event"] == "read-gate")
+    assert rec["ok"] is False
+    assert rec["regressed"] == ["read_latency_p95_ms"]

@@ -480,7 +480,7 @@ def test_sdk_parity_every_agent_get_route_has_accessor(agent):
 
     sdk_source = inspect.getsource(AgentApi)
     missing = []
-    for pattern, _handler in agent.http.routes:
+    for pattern, _template, _handler in agent.http.routes:
         path = pattern.pattern
         if not path.startswith(r"^/v1/agent/"):
             continue

@@ -1,0 +1,406 @@
+"""Read-path observatory tests (nomad_tpu/read_observe.py): config
+parse validation, the recorder's books, the blocking hold/serve stage
+partition over a live agent, SSE session books surviving ring
+truncation, the watch-registry wake economy, the uniform freshness
+stamp on EVERY read route (structural route-table walk), and the
+/v1/agent/reads + SDK surfaces."""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import ApiClient, QueryOptions
+from nomad_tpu.read_observe import (
+    ReadObserveConfig,
+    ReadObservatory,
+    ReadRecorder,
+)
+from nomad_tpu.state.store import _Watch, item_table
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    config = AgentConfig.dev()
+    config.data_dir = str(tmp_path_factory.mktemp("agent"))
+    config.http_port = 0  # auto-assign
+    config.scheduler_backend = "host"
+    # Tiny event ring so an SSE resume cursor can actually fall off it
+    # (the truncation-books test); every other test is ring-agnostic.
+    config.event_buffer_size = 8
+    a = Agent(config)
+    a.start()
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture()
+def client(agent):
+    return ApiClient(address=agent.http.addr)
+
+
+def _get(agent, path):
+    """GET returning (status, headers, body-bytes) for ANY status —
+    error responses carry headers too, and that is the point."""
+    try:
+        with urllib.request.urlopen(agent.http.addr + path,
+                                    timeout=15) as resp:
+            return resp.status, resp.headers, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def _register_job(agent, run_for="60"):
+    job = mock.job()
+    job.task_groups[0].tasks[0].driver = "mock_driver"
+    job.task_groups[0].tasks[0].config = {"run_for": run_for,
+                                          "exit_code": "0"}
+    job.task_groups[0].tasks[0].resources.networks = []
+    agent.server.job_register(job)
+    return job
+
+
+# -- config parse -------------------------------------------------------------
+
+
+def test_config_parse_defaults_and_coercion():
+    cfg = ReadObserveConfig.parse(None)
+    assert cfg.enabled is True
+    assert cfg.poll_interval == 1.0
+    assert cfg.events_interval == 10.0
+
+    cfg = ReadObserveConfig.parse(
+        {"enabled": 1, "poll_interval": "0.5", "events_interval": 0}
+    )
+    assert cfg.enabled is True
+    assert cfg.poll_interval == 0.5
+    assert cfg.events_interval == 0.0
+
+
+def test_config_parse_rejects_nonsense():
+    with pytest.raises(ValueError, match="unknown reads config key"):
+        ReadObserveConfig.parse({"pol_interval": 1.0})
+    with pytest.raises(ValueError, match="must be a mapping"):
+        ReadObserveConfig.parse("fast")
+    with pytest.raises(ValueError, match="poll_interval must be > 0"):
+        ReadObserveConfig.parse({"poll_interval": 0})
+    with pytest.raises(ValueError, match="events_interval must be >= 0"):
+        ReadObserveConfig.parse({"events_interval": -1})
+
+
+def test_file_config_validates_reads_block(tmp_path):
+    """Typos in server { reads { } } fail config LOAD, not first use."""
+    from nomad_tpu.agent_config import load_config_file
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        {"server": {"enabled": True, "reads": {"pol_interval": 1}}}
+    ))
+    with pytest.raises(ValueError, match="unknown reads config key"):
+        load_config_file(str(bad))
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(
+        {"server": {"enabled": True,
+                    "reads": {"poll_interval": 0.25, "enabled": True}}}
+    ))
+    cfg = load_config_file(str(good))
+    assert cfg.server.reads == {"poll_interval": 0.25, "enabled": True}
+
+
+# -- recorder books (unit) ----------------------------------------------------
+
+
+def test_recorder_route_and_lane_books():
+    rec = ReadRecorder()
+    rec.record_request("/v1/jobs", "plain", 200, 0.010, 512)
+    rec.record_request("/v1/jobs", "blocking", 200, 0.050, 256)
+    rec.record_request("/v1/jobs", "plain", 404, 0.001, 9)
+    snap = rec.snapshot()
+    books = snap["endpoints"]["/v1/jobs"]
+    assert books["count"] == 3
+    assert books["errors"] == 1
+    assert books["bytes_total"] == 512 + 256 + 9
+    assert books["lanes"]["plain"] == 2
+    assert books["lanes"]["blocking"] == 1
+    assert books["lanes"]["sse"] == 0
+    assert books["latency_ms"]["max"] == 50.0
+
+
+def test_recorder_hold_serve_partition_reconciles():
+    """serve = total − hold at record time, so the stage sums reconcile
+    with the total by construction — including the clamped degenerate
+    where a hold outlasts the measured total."""
+    rec = ReadRecorder()
+    rec.record_blocking("/v1/jobs", hold_s=0.8, total_s=1.0, woke=True)
+    rec.record_blocking("/v1/jobs", hold_s=2.0, total_s=2.0, woke=False)
+    rec.record_blocking("/v1/jobs", hold_s=0.5, total_s=0.4, woke=True)
+    books = rec._blocking["/v1/jobs"]
+    assert books.count == 3
+    assert books.wakes == 2 and books.timeouts == 1
+    assert books.hold.sum + books.serve.sum == pytest.approx(
+        books.total.sum)
+    assert min(books.serve.min, 0.0) == 0.0  # clamped, never negative
+    snap = rec.snapshot()["blocking"]["/v1/jobs"]
+    assert snap["wakes"] == 2 and snap["timeouts"] == 1
+    assert snap["hold_ms"]["mean"] + snap["serve_ms"]["mean"] == (
+        pytest.approx(snap["total_ms"]["mean"], abs=0.01))
+
+
+def test_recorder_sse_books_count_truncation():
+    """The Truncated frame is COUNTED, never absorbed into the ordinary
+    frame books — a lagging tail that lost events must show as loss."""
+    rec = ReadRecorder()
+    rec.sse_session_start()
+    rec.sse_delivered(5, lag_entries=2)
+    rec.sse_truncated()
+    rec.sse_delivered(3, lag_entries=0)
+    rec.sse_heartbeat()
+    rec.sse_session_end()
+    sse = rec.snapshot()["sse"]
+    assert sse["started"] == 1 and sse["active"] == 0
+    assert sse["frames"] == 8
+    assert sse["truncations"] == 1
+    assert sse["heartbeats"] == 1
+    assert sse["lag_entries"]["max"] == 2.0
+
+
+# -- watch-registry wake economy (unit) ---------------------------------------
+
+
+def test_watch_economy_counters():
+    w = _Watch()
+    t1 = w.register([item_table("jobs")])
+    t2 = w.register([item_table("jobs")])
+    t3 = w.register([item_table("nodes")])
+
+    stats = w.stats()
+    assert stats["watchers"] == 3
+    assert sum(stats["bucket_watchers"]) == 3
+    jobs_bucket = _Watch._bucket(item_table("jobs"))
+    occupancy_before = stats["bucket_watchers"][jobs_bucket]
+    assert occupancy_before >= 2  # both jobs watchers share the bucket
+
+    # One publish touching jobs wakes every watcher parked on that
+    # bucket — fan-out accounting, not per-ticket delivery.
+    w.notify([item_table("jobs")])
+    stats = w.stats()
+    assert stats["notifies"] == 1
+    assert stats["wakes_delivered"] == occupancy_before
+    assert w.wait(t1, timeout=1.0) is True
+    assert w.wait(t2, timeout=1.0) is True
+
+    # Spurious wakes are caller-bumped plain counters (the registry
+    # itself cannot know an index re-probe came up empty).
+    w.spurious_wakes += 1
+    assert w.stats()["spurious_wakes"] == 1
+
+    for t in (t1, t2, t3):
+        w.unregister(t)
+    stats = w.stats()
+    assert stats["watchers"] == 0
+    assert sum(stats["bucket_watchers"]) == 0
+    assert {"buckets", "multi_waiters", "peak_watchers",
+            "rejected"} <= set(stats)
+
+
+def test_observatory_watch_view_derivations():
+    """buckets_occupied / bucket_max_watchers / wakes_per_notify derive
+    from the plain counters; absent keys degrade to zeros."""
+    view = ReadObservatory._watch_view({
+        "watchers": 4, "notifies": 2, "wakes_delivered": 6,
+        "bucket_watchers": [0, 3, 0, 1],
+    })
+    assert view["buckets_occupied"] == 2
+    assert view["bucket_max_watchers"] == 3
+    assert view["wakes_per_notify"] == 3.0
+    empty = ReadObservatory._watch_view({})
+    assert empty["wakes_per_notify"] == 0.0
+    assert empty["buckets_occupied"] == 0
+
+
+# -- live-agent: blocking partition, SSE, freshness ---------------------------
+
+
+def test_blocking_hold_serve_partition_live(client, agent):
+    """A woken blocking query and a timed-out one both land in the
+    /v1/jobs blocking books, partitioned into hold (parked on the
+    watch) vs serve (building the response) — and the outcome lanes
+    plus stage means reconcile."""
+    _, meta = client.jobs().list()
+    # index=0 is the non-blocking list convention; on a virgin jobs
+    # table park one index ahead so both lanes actually block.
+    start_index = max(meta.last_index, 1)
+
+    # Timeout lane: nothing writes during a short wait.
+    client.jobs().list(QueryOptions(wait_index=start_index,
+                                    wait_time="300ms"))
+
+    # Wake lane: a jobs-table write lands mid-park.
+    def blocked():
+        client.jobs().list(QueryOptions(wait_index=start_index,
+                                        wait_time="10s"))
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.3)
+    _register_job(agent)
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+    reads = client.agent().reads()
+    books = reads["blocking"]["/v1/jobs"]
+    assert books["wakes"] >= 1
+    assert books["timeouts"] >= 1
+    assert books["count"] == books["wakes"] + books["timeouts"]
+    # Means reconcile (same ingest count across the three series).
+    assert books["hold_ms"]["mean"] + books["serve_ms"]["mean"] == (
+        pytest.approx(books["total_ms"]["mean"], abs=0.02))
+    # The timed-out query parked ~300ms; hold dominates serve.
+    assert books["hold_ms"]["max"] >= 250.0
+    assert books["total_ms"]["max"] >= books["hold_ms"]["max"]
+    # Lane attribution rode the same requests.
+    route = reads["endpoints"]["/v1/jobs"]
+    assert route["lanes"]["blocking"] >= 2
+
+
+def test_sse_session_books_survive_ring_truncation(client, agent):
+    """With an 8-slot event ring, a resume cursor of 1 is off the ring
+    once the cluster has published more than 8 events: the stream leads
+    with a Truncated frame and the session books count it — alongside
+    delivered frames, heartbeats, and the session open/close."""
+    # Ensure the ring has wrapped: every register writes multiple events.
+    for _ in range(4):
+        _register_job(agent, run_for="0.1")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if agent.server.fsm.events.horizon() > 1:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("event ring never wrapped")
+
+    before = client.agent().reads()["sse"]
+    status, headers, body = _get(
+        agent, "/v1/event/stream?format=sse&index=1&wait=0.5s")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/event-stream")
+    # Freshness stamped on the stream preamble too.
+    assert headers["X-Nomad-Applied-Index"] is not None
+    text = body.decode()
+    assert "event: Truncated" in text
+    assert "data:" in text
+
+    after = client.agent().reads()["sse"]
+    assert after["truncations"] >= before["truncations"] + 1
+    assert after["started"] >= before["started"] + 1
+    assert after["frames"] > before["frames"]
+    assert after["active"] == 0  # session closed out of the books
+    # And the stream rode the sse lane in route attribution.
+    route = client.agent().reads()["endpoints"]["/v1/event/stream"]
+    assert route["lanes"]["sse"] >= 1
+    assert route["bytes_total"] > 0
+
+
+def test_freshness_headers_on_every_read_route(agent):
+    """Structural: walk the live route TABLE — every route, including
+    parameterized ones hit with junk ids (404s) and write-only routes
+    answering GET with 405, carries the freshness stamp. A new route
+    cannot dodge this test by not being listed anywhere."""
+    assert len(agent.http.routes) >= 30
+    walked = 0
+    for pattern, template, _handler in agent.http.routes:
+        path = re.sub(r"\(\?P<[^>]+>[^)]+\)", "x",
+                      pattern.pattern).lstrip("^").rstrip("$")
+        status, headers, _body = _get(agent, path)
+        for header in ("X-Nomad-Applied-Index", "X-Nomad-Staleness",
+                       "X-Nomad-KnownLeader"):
+            assert headers[header] is not None, (
+                f"{template} ({status}) missing {header}")
+        assert int(headers["X-Nomad-Staleness"]) >= 0
+        assert headers["X-Nomad-KnownLeader"] in ("true", "false")
+        walked += 1
+    assert walked == len(agent.http.routes)
+
+
+def test_freshness_recorded_into_staleness_books(client):
+    before = client.agent().reads()["freshness"]
+    client.jobs().list()
+    client.nodes().list()
+    after = client.agent().reads()["freshness"]
+    assert after["responses_stamped"] >= before["responses_stamped"] + 2
+    assert after["applied_index"] >= 1
+    assert after["commit_index"] >= after["applied_index"]
+    assert "staleness_entries" in after
+    assert after["staleness_entries"]["max"] >= 0.0
+
+
+# -- surfaces -----------------------------------------------------------------
+
+
+def test_agent_reads_endpoint_and_sdk(client, agent):
+    client.jobs().list()  # ensure at least one plain read is booked
+    reads = client.agent().reads()
+    assert {"endpoints", "blocking", "sse", "freshness", "watch",
+            "observer"} <= set(reads)
+    jobs = reads["endpoints"]["/v1/jobs"]
+    assert jobs["count"] >= 1
+    assert {"p50", "p95", "p99"} <= set(jobs["latency_ms"])
+    # Watch economy view present for both registries.
+    for registry in ("state", "events"):
+        view = reads["watch"][registry]
+        assert "wakes_per_notify" in view
+        assert "spurious_wakes" in view
+        assert "buckets_occupied" in view
+
+    status, headers, body = _get(agent,
+                                 "/v1/agent/reads?format=prometheus")
+    assert status == 200
+    text = body.decode()
+    assert "nomad_read_requests_total" in text
+    assert "nomad_read_latency_ms" in text
+    assert 'route="/v1/jobs"' in text
+
+
+def test_main_scrape_and_metrics_json_carry_reads(agent, client):
+    status, _headers, body = _get(agent,
+                                  "/v1/agent/metrics?format=prometheus")
+    assert status == 200
+    assert "nomad_read_requests_total" in body.decode()
+
+    metrics, _ = client.query("/v1/agent/metrics")
+    summary = metrics["reads"]
+    assert summary["requests"] >= 1
+    assert "read_p95_ms_worst" in summary
+    assert "staleness_p99_entries" in summary
+
+
+def test_reads_disabled_404_but_headers_stay(tmp_path_factory):
+    """reads { enabled = false } kills the books and the endpoint, but
+    the freshness headers are a protocol feature and survive."""
+    cfg = AgentConfig.dev()
+    cfg.data_dir = str(tmp_path_factory.mktemp("reads-off"))
+    cfg.http_port = 0
+    cfg.scheduler_backend = "host"
+    cfg.reads = {"enabled": False}
+    a = Agent(cfg)
+    a.start()
+    try:
+        status, headers, _body = _get(a, "/v1/agent/reads")
+        assert status == 404
+        assert headers["X-Nomad-Applied-Index"] is not None
+        assert headers["X-Nomad-Staleness"] is not None
+        # Plain reads still answer; nothing is recorded.
+        status, headers, _body = _get(a, "/v1/jobs")
+        assert status == 200
+        assert headers["X-Nomad-Applied-Index"] is not None
+        rec = a.server.read_observatory.recorder
+        assert rec.snapshot()["endpoints"] == {}
+    finally:
+        a.shutdown()

@@ -434,6 +434,100 @@ def test_churn_frag_smoke_is_seed_deterministic():
     assert a["events"]["by_type"] == b["events"]["by_type"]
 
 
+def test_read_storm_800_smoke(tmp_path):
+    """The read-path observatory at smoke scale, contrast arm included:
+    800 nodes under 6x120 service placements while a small impolite
+    read fleet (2 pollers, 2 blocking watchers, 1 SSE tail) hammers the
+    loopback HTTP front end. The artifact must carry all three books
+    (serving attribution, watch economy, freshness) PLUS the fleet's
+    client-side view, and the reads-OFF contrast arm must reproduce the
+    main arm's canonical digest — the read-path decision-invariance
+    proof."""
+    out = tmp_path / "SIMLOAD_read-storm-800_smoke.json"
+    art = run_scenario("read-storm-800", seed=42, out_path=str(out))
+    assert art["placements"]["placed"] == 6 * 120
+    assert art["events"]["truncated"] is False
+
+    reads = art["reads"]
+    assert reads["enabled"] is True
+    # Serving attribution keyed on route templates: the pollers rotate
+    # the four list endpoints, the watchers long-poll them, the SSE
+    # tail rides the event stream.
+    for route in ("/v1/jobs", "/v1/nodes", "/v1/allocations",
+                  "/v1/evaluations", "/v1/event/stream"):
+        assert reads["endpoints"][route]["count"] > 0, route
+        assert reads["endpoints"][route]["bytes_total"] > 0, route
+    assert reads["endpoints"]["/v1/event/stream"]["lanes"]["sse"] >= 1
+    # The blocking hold/serve partition: watchers parked on ?index=N,
+    # every finished query is a wake or a timeout, and the stage means
+    # reconcile with the total by construction.
+    blocking = reads["blocking"]
+    assert blocking, "no blocking books despite long-poll watchers"
+    for route, books in blocking.items():
+        assert books["count"] == books["wakes"] + books["timeouts"]
+        assert (books["hold_ms"]["mean"] + books["serve_ms"]["mean"]
+                == pytest.approx(books["total_ms"]["mean"], abs=0.02))
+    # SSE session books and the freshness stamp both saw traffic.
+    assert reads["sse"]["started"] >= 1
+    assert reads["sse"]["frames"] > 0
+    assert reads["sse"]["active"] == 0
+    assert reads["freshness"]["responses_stamped"] > 0
+    assert reads["freshness"]["applied_index"] > 0
+    # Watch economy: the long-pollers parked on the state registry.
+    assert reads["watch"]["state"]["notifies"] > 0
+    assert reads["watch"]["state"]["wakes_delivered"] >= 0
+    # The client-side fleet view, cross-checkable against the server
+    # books: every population actually hit the wire.
+    fleet = reads["fleet"]
+    assert fleet["pollers"]["readers"] == 2
+    assert fleet["watchers"]["readers"] == 2
+    assert fleet["sse_tails"]["readers"] == 1
+    assert fleet["pollers"]["requests"] > 0
+    assert fleet["watchers"]["wakes"] + fleet["watchers"]["timeouts"] > 0
+    assert fleet["sse_tails"]["frames"] > 0
+
+    # The contrast arm ran the SAME fleet with the observatory off:
+    # books empty, digest identical (reads never touch decisions).
+    contrast = art["contrast"]
+    assert contrast["reads"]["enabled"] is False
+    assert contrast["reads"]["fleet"]["pollers"]["requests"] > 0
+    assert contrast["digest_matches"] is True
+
+
+def test_read_storm_smoke_is_seed_deterministic():
+    """The read fleet is wall-clock-paced and WHICH requests land
+    between placements is scheduling noise — but reader traffic rides
+    GETs and observer-topic events only, so the canonical digest (and
+    the per-key lifecycle multiset) must replay under the same seed
+    with the fleet running."""
+    a = run_scenario("read-storm-800", seed=11, contrast=False)
+    b = run_scenario("read-storm-800", seed=11, contrast=False)
+    assert a["events"]["digest"] == b["events"]["digest"]
+    assert a["events"]["by_type"] == b["events"]["by_type"]
+
+
+@pytest.mark.slow
+def test_read_storm_scenario():
+    """The full 10k-node read-path proof (the committed
+    SIMLOAD_read-storm_* artifacts use tools/simload.py; this keeps it
+    executable in-suite): the steady-10k write load under a 15-reader
+    fleet, with the leader's plan latency banked as the headline
+    read-pressure number."""
+    art = run_scenario("read-storm", seed=42)
+    assert art["placements"]["placed"] == 24 * 420
+    assert art["plan_latency_ms"]["n"] == 24
+    reads = art["reads"]
+    assert reads["enabled"] is True
+    assert reads["blocking"]
+    assert reads["sse"]["frames"] > 0
+    assert reads["freshness"]["responses_stamped"] > 0
+    fleet = reads["fleet"]
+    assert (fleet["pollers"]["readers"] + fleet["watchers"]["readers"]
+            + fleet["sse_tails"]["readers"]) == 15
+    assert art["contrast"]["reads"]["enabled"] is False
+    assert art["contrast"]["digest_matches"] is True
+
+
 def test_express_smoke_is_seed_deterministic():
     """Express placements ride seeded streams (express.pick /
     express.lease_jitter) and publish ONE deterministic event per

@@ -454,6 +454,64 @@ def recovery_gate(new_artifact: dict, baseline_artifact: dict | None,
     return {"ok": ok, "tolerance": tolerance, "checks": checks}
 
 
+# Read-gate tolerance: serving latency under an impolite read fleet is
+# box-noise-sensitive (GIL contention with the placement path is the
+# scenario's POINT), so the newest-vs-previous bar is deliberately loose
+# — it exists to catch a real serving regression (2x-class), not
+# scheduler jitter.
+READ_GATE_TOLERANCE = 0.5
+
+
+def read_gate(new_artifact: dict, baseline_artifact: dict | None,
+              tolerance: float = READ_GATE_TOLERANCE) -> dict | None:
+    """Gate a read-carrying family's serving story (the read-path
+    observatory's artifact section, nomad_tpu/read_observe.py). Scoped:
+    None when the artifact's reads section is absent or disabled — only
+    families that actually drove a read fleet gate here. RELATIVE
+    newest-vs-previous when the prior bank also carries an enabled reads
+    section: the worst per-route read latency p95 must not grow more
+    than ``tolerance``, and the staleness distribution's p99 (raft
+    entries behind the leader commit) must not grow more than
+    ``tolerance`` plus a 2-entry absolute slack (the distribution sits
+    at 0-1 entries on a healthy single-member cell, where a pure
+    relative bar would fail on noise). First-round families report the
+    observed values without failing — there is no declared absolute
+    bound for read latency; the family's write-path SLOs gate
+    separately."""
+    reads = new_artifact.get("reads") or {}
+    if not reads.get("enabled"):
+        return None
+
+    def worst_p95(r: dict):
+        vals = [(ep.get("latency_ms") or {}).get("p95")
+                for ep in (r.get("endpoints") or {}).values()]
+        vals = [v for v in vals if v is not None]
+        return max(vals) if vals else None
+
+    def staleness_p99(r: dict):
+        return ((r.get("freshness") or {}).get("staleness_entries")
+                or {}).get("p99")
+
+    base_reads = (baseline_artifact or {}).get("reads") or {}
+    if not base_reads.get("enabled"):
+        base_reads = {}
+    checks, ok = [], True
+    for name, fn, slack in (
+        ("read_latency_p95_ms", worst_p95, 0.0),
+        ("staleness_age_p99_entries", staleness_p99, 2.0),
+    ):
+        value = fn(reads)
+        if value is None:
+            continue
+        baseline = fn(base_reads) if base_reads else None
+        regressed = (baseline is not None
+                     and value > baseline * (1.0 + tolerance) + slack)
+        checks.append({"check": name, "value": value,
+                       "baseline": baseline, "regressed": regressed})
+        ok = ok and not regressed
+    return {"ok": ok, "tolerance": tolerance, "checks": checks}
+
+
 def slo_gate_scan(log=log) -> bool:
     """Run the SLO gate over every banked artifact family: newest-vs-
     previous where a prior round exists, absolute-against-objectives for
@@ -470,12 +528,14 @@ def slo_gate_scan(log=log) -> bool:
                 verdict = slo_gate_absolute(new, objectives)
                 solver_verdict = None
                 recovery_verdict = recovery_gate(new, None)
+                read_verdict = read_gate(new, None)
             else:
                 with open(base_path) as f:
                     base = json.load(f)
                 verdict = slo_gate(new, base, objectives)
                 solver_verdict = solver_gate(new, base)
                 recovery_verdict = recovery_gate(new, base)
+                read_verdict = read_gate(new, base)
         except (OSError, ValueError, KeyError) as e:
             log("slo-gate-error", family=fam, error=str(e))
             ok = False
@@ -499,6 +559,11 @@ def slo_gate_scan(log=log) -> bool:
                 regressed=[c["check"] for c in recovery_verdict["checks"]
                            if c["regressed"]])
             ok = ok and recovery_verdict["ok"]
+        if read_verdict is not None:
+            log("read-gate", family=fam, ok=read_verdict["ok"],
+                regressed=[c["check"] for c in read_verdict["checks"]
+                           if c["regressed"]])
+            ok = ok and read_verdict["ok"]
     return ok
 
 

@@ -1,13 +1,15 @@
 """Observatory pass (OBS001): the observatories are read-only.
 
-``nomad_tpu/capacity.py`` (the capacity observatory) and
-``nomad_tpu/raft_observe.py`` (the raft & recovery observatory) observe
-cluster state through change logs and the raft node's plain-data books,
-and must stay invisible to every decision path — the decision-invariance
-proofs (the churn-fragmentation observatory-off contrast arm's digest
-equality; the steady-10k digest staying byte-equal with the raft
-observatory on) only mean something if no placement, verify, or apply
-path can even *reach* an observer's books. This pass enforces that
+``nomad_tpu/capacity.py`` (the capacity observatory),
+``nomad_tpu/raft_observe.py`` (the raft & recovery observatory) and
+``nomad_tpu/read_observe.py`` (the read-path observatory) observe
+cluster state through change logs and plain-data books, and must stay
+invisible to every decision path — the decision-invariance proofs (the
+churn-fragmentation observatory-off contrast arm's digest equality; the
+steady-10k digest staying byte-equal with the raft observatory on; the
+read-storm reads-off contrast arm's digest equality) only mean
+something if no placement, verify, or apply path can even *reach* an
+observer's books. This pass enforces that
 statically: any ``import`` of an observatory module (module-level or
 function-local, plain or from-import) inside the decision scope is a
 finding.
@@ -53,7 +55,8 @@ OBSERVATORY_SCOPE = (
 # import, and the composition root needs exactly that.
 COMPOSITION_ROOTS = ("nomad_tpu/server/server.py",)
 
-TARGET_MODULES = ("nomad_tpu.capacity", "nomad_tpu.raft_observe")
+TARGET_MODULES = ("nomad_tpu.capacity", "nomad_tpu.raft_observe",
+                  "nomad_tpu.read_observe")
 _TARGET_LEAVES = tuple(m.rsplit(".", 1)[1] for m in TARGET_MODULES)
 
 
