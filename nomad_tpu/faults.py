@@ -47,6 +47,18 @@ so for a fixed seed the n-th check at a site always decides the same way,
 run after run, regardless of what other sites do. The decision trace per
 site is therefore replayable (NOMAD_TPU_CHAOS_SEED posture).
 
+Flap windows (the chaos compiler's partition-flap vocabulary): a rule may
+carry ``windows=[(start, end), ...]`` — offsets in seconds from arm time
+during which the rule is live; outside every window it is disarmed and
+consumes NO draw, so the in-window decision trace stays a pure function of
+(seed, site, in-window check ordinal). ``flap={period, duty, count,
+jitter}`` is generator sugar: ``count`` windows of ``period*duty`` seconds,
+one per period, each start jittered by a draw from a SEPARATELY salted
+stream (``seed ^ crc32(site + ".flap")``) so window layout never shifts the
+decide() draws. Armed/disarmed transitions are counted per rule
+(``transitions``) and in telemetry (``faults.<site>.window_armed`` /
+``window_disarmed``); a rule past its last window's end is spent.
+
 The disabled path costs one module-global read and a falsy check — cheap
 enough for rpc/fsm hot paths. Every injected fault is counted in telemetry
 (``nomad.faults.<site>.<mode>``) and annotated on the active trace span.
@@ -121,15 +133,23 @@ class FaultRule:
     delay        sleep seconds for mode='delay' (ignored otherwise).
     match        substring the call's target must contain ('' matches all) —
                  how a one-way partition names its edge.
+    windows      [(start, end), ...] offsets from arm time (seconds) during
+                 which the rule is live; disarmed outside all of them.
+    flap         {period, duty, count, jitter} generator sugar for windows
+                 (mutually exclusive with an explicit windows list).
     """
 
     __slots__ = ("site", "mode", "probability", "count", "duration",
-                 "delay", "match", "fired", "checked", "armed_at", "_rng")
+                 "delay", "match", "fired", "checked", "armed_at", "_rng",
+                 "windows", "flap", "transitions", "_window_armed",
+                 "_window_edges", "_window_prev")
 
     def __init__(self, site: str, mode: str = "error",
                  probability: float = 1.0, count: int = 0,
                  duration: float = 0.0, delay: float = 0.0,
-                 match: str = "", seed: int = 0):
+                 match: str = "", seed: int = 0,
+                 windows: Optional[List] = None,
+                 flap: Optional[Dict] = None):
         honored = SITE_MODES.get(site)
         if honored is None:
             raise ValueError(f"unknown fault site {site!r} (sites: {SITES})")
@@ -151,11 +171,92 @@ class FaultRule:
         self.match = str(match)
         self.fired = 0
         self.checked = 0
+        self.transitions = 0
         self.armed_at = time.monotonic()
         # Site-salted seed: rules at different sites draw from independent
         # deterministic streams, so adding a rule at one site never shifts
         # another site's decision sequence.
         self._rng = Random(seed ^ zlib.crc32(site.encode()))
+        if windows is not None and flap is not None:
+            raise ValueError("windows and flap are mutually exclusive")
+        self.flap = dict(flap) if flap else None
+        if flap is not None:
+            windows = self._flap_windows(self.flap, site, seed)
+        if windows is not None:
+            windows = self._validate_windows(windows)
+        self.windows = windows
+        # The transition books are TIMELINE-derived, not observation-
+        # derived: every window boundary is an edge on the seeded
+        # timeline, and each observation (a decide() or a snapshot read)
+        # books every edge crossed since the previous observation. A
+        # sparse check cadence (a dropped RPC stalling its caller past a
+        # whole disarmed gap) therefore books the missed disarm+arm PAIR
+        # instead of silently skipping it, and a rule read after its
+        # last window always reports exactly 2*len(windows) transitions.
+        # The cursor starts BELOW t=0 so a first window opening exactly
+        # at arm time still books its arm edge — every window always
+        # contributes its full edge pair.
+        self._window_edges: List = []
+        self._window_armed = False
+        self._window_prev = -1.0
+        for start, end in windows or ():
+            self._window_edges.append((start, True))
+            self._window_edges.append((end, False))
+
+    @staticmethod
+    def _flap_windows(flap: Dict, site: str, seed: int) -> List:
+        """Expand {period, duty, count, jitter} into an explicit window
+        list: ``count`` cycles of ``period`` seconds, armed for
+        ``period*duty`` at the (jittered) head of each. Start jitter draws
+        from a SEPARATELY salted stream so the flap layout never consumes
+        decide()'s draws, and each window is clamped inside its own cycle
+        so windows cannot overlap or reorder."""
+        unknown = set(flap) - {"period", "duty", "count", "jitter"}
+        if unknown:
+            raise ValueError(f"unknown flap keys {sorted(unknown)}")
+        period = float(flap.get("period", 1.0))
+        duty = float(flap.get("duty", 0.5))
+        count = int(flap.get("count", 0))
+        jitter = float(flap.get("jitter", 0.0))
+        if period <= 0.0:
+            raise ValueError("flap.period must be > 0")
+        if not 0.0 < duty <= 1.0:
+            raise ValueError("flap.duty must be within (0, 1]")
+        if count < 1:
+            raise ValueError("flap.count must be >= 1")
+        if jitter < 0.0 or jitter + period * duty > period:
+            raise ValueError(
+                "flap.jitter must satisfy 0 <= jitter <= period*(1-duty)"
+            )
+        rng = Random(seed ^ zlib.crc32((site + ".flap").encode()))
+        windows = []
+        for i in range(count):
+            base = i * period
+            start = base + (rng.uniform(0.0, jitter) if jitter else 0.0)
+            end = min(start + period * duty, base + period)
+            windows.append((round(start, 6), round(end, 6)))
+        return windows
+
+    @staticmethod
+    def _validate_windows(windows) -> List:
+        if not isinstance(windows, (list, tuple)) or not windows:
+            raise ValueError("windows must be a non-empty list of"
+                             " [start, end] pairs")
+        out = []
+        prev_end = None
+        for w in windows:
+            if (not isinstance(w, (list, tuple)) or len(w) != 2):
+                raise ValueError(f"window {w!r} must be a [start, end] pair")
+            start, end = float(w[0]), float(w[1])
+            if start < 0.0 or end <= start:
+                raise ValueError(
+                    f"window {w!r} must satisfy 0 <= start < end")
+            if prev_end is not None and start < prev_end:
+                raise ValueError(
+                    "windows must be sorted and non-overlapping")
+            prev_end = end
+            out.append((start, end))
+        return out
 
     @property
     def spent(self) -> bool:
@@ -166,13 +267,39 @@ class FaultRule:
             (self.count and self.fired >= self.count)
             or (self.duration
                 and time.monotonic() - self.armed_at > self.duration)
+            or (self.windows is not None
+                and time.monotonic() - self.armed_at >= self.windows[-1][1])
         )
+
+    def _observe_windows(self) -> None:
+        """Advance the window edge books to now: book every timeline edge
+        in (last observation, now], flipping the armed state through each
+        so the armed/disarmed telemetry stays per-edge accurate even when
+        several edges are crossed in one gap."""
+        if self.windows is None:
+            return
+        now = time.monotonic() - self.armed_at
+        for t, armed in self._window_edges:
+            if self._window_prev < t <= now:
+                self._window_armed = armed
+                self.transitions += 1
+                telemetry.incr_counter((
+                    "faults", self.site,
+                    "window_armed" if armed else "window_disarmed"))
+        self._window_prev = max(self._window_prev, now)
 
     def decide(self, target: str) -> bool:
         """One check (lock held by the registry). Consumes exactly one draw
         whenever the rule is live, even on a target mismatch — the decision
-        ordinal stays aligned with the site's check ordinal."""
+        ordinal stays aligned with the site's check ordinal. A windowed
+        rule checked outside every window is disarmed: it consumes NO draw
+        (the in-window decision trace stays seed-pure), and every timeline
+        edge crossed since the previous check bumps the transition
+        books."""
+        self._observe_windows()
         if self.spent:
+            return False
+        if self.windows is not None and not self._window_armed:
             return False
         self.checked += 1
         hit = self.probability >= 1.0 or self._rng.random() < self.probability
@@ -184,13 +311,22 @@ class FaultRule:
         return True
 
     def to_dict(self) -> Dict:
-        return {
+        # Snapshot reads settle the books: a rule read after its last
+        # window closed reports the full 2*count transition timeline.
+        self._observe_windows()
+        d = {
             "site": self.site, "mode": self.mode,
             "probability": self.probability, "count": self.count,
             "duration": self.duration, "delay": self.delay,
             "match": self.match, "fired": self.fired,
             "checked": self.checked,
         }
+        if self.windows is not None:
+            d["windows"] = [list(w) for w in self.windows]
+            d["transitions"] = self.transitions
+            if self.flap is not None:
+                d["flap"] = dict(self.flap)
+        return d
 
 
 class FaultRegistry:
@@ -213,10 +349,13 @@ class FaultRegistry:
     def configure(self, site: str, mode: str = "error",
                   probability: float = 1.0, count: int = 0,
                   duration: float = 0.0, delay: float = 0.0,
-                  match: str = "", seed: Optional[int] = None) -> FaultRule:
+                  match: str = "", seed: Optional[int] = None,
+                  windows: Optional[List] = None,
+                  flap: Optional[Dict] = None) -> FaultRule:
         rule = FaultRule(
             site, mode, probability, count, duration, delay, match,
             seed=self.seed if seed is None else int(seed),
+            windows=windows, flap=flap,
         )
         with self._lock:
             self._rules.setdefault(site, []).append(rule)
@@ -262,6 +401,8 @@ class FaultRegistry:
                     delay=float(r.get("delay", 0.0)),
                     match=str(r.get("match", "")),
                     seed=int(r.get("seed", seed)),
+                    windows=r.get("windows"),
+                    flap=r.get("flap"),
                 )
                 for r in rules
             ]

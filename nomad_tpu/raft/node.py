@@ -22,7 +22,12 @@ framework RPC layer. Scope notes vs the paper:
 Persistence: term/vote/log journal + snapshot files to ``data_dir`` when
 set; on restart the newest valid snapshot is restored into the FSM and the
 log tail replayed (fsm.go:313-410 posture). In-memory otherwise (the
-reference's DevMode InmemStore, server.go:420-427).
+reference's DevMode InmemStore, server.go:420-427). Journal lines carry a
+crc32 prefix (``<crc32:08x> <json body>``): a torn or bit-flipped tail is
+truncated back to the last whole checksummed entry on load — counted
+(``raft.journal.truncated_tail``), never a crash — and the clean prefix is
+rewritten so the next append lands on a valid journal. Legacy unprefixed
+lines still load (json-parse is their only check).
 
 Log indexing is absolute: ``self.log[k]`` holds entry ``log_offset+k+1``,
 where ``log_offset <= snapshot_index`` (the gap is the retained trailing
@@ -39,6 +44,7 @@ import os
 import random
 import threading
 import time
+import zlib
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -83,6 +89,12 @@ class RaftConfig:
     # lagging followers replicate normally instead of taking a full
     # InstallSnapshot (hashicorp/raft TrailingLogs posture).
     trailing_logs: int = 1024
+    # InstallSnapshot transfer chunk size (raw snapshot bytes per RPC,
+    # paper §7's offset/done framing): a multi-MB FSM snapshot must not
+    # ride one RPC — each chunk resets the follower's election timer and
+    # interleaves with live AppendEntries instead of stalling behind one
+    # giant frame.
+    snapshot_chunk_bytes: int = 256 * 1024
 
 
 @dataclass
@@ -200,6 +212,23 @@ class RaftNode:
         self.snapshot_disk_bytes = 0
         self.snapshots_installed = 0
         self.snapshots_sent = 0
+        self.snapshot_chunks_sent = 0
+        self.snapshot_chunks_received = 0
+        # In-flight chunked InstallSnapshot reassembly (follower side):
+        # buffer plus its (index, term) identity; an offset or identity
+        # mismatch discards the transfer and the leader restarts it.
+        self._snap_chunks: Optional[bytearray] = None
+        self._snap_chunks_key: Optional[Tuple[int, int]] = None
+        # Per-peer replication in-flight guard (leader side). A chunked
+        # snapshot transfer outlives _broadcast_append's 1s join, and
+        # without the guard every later heartbeat tick would start a
+        # SECOND stream to the same peer whose offset-0 chunk resets the
+        # follower's reassembly buffer — the competing transfers then
+        # fail each other's offset checks forever and the follower never
+        # installs. One stream per peer at a time; heartbeats to that
+        # peer are unnecessary while it streams (every chunk resets the
+        # follower's election timer).
+        self._replicating_peers: set = set()
         # Restart-replay timeline: populated by _load_persistent (cold
         # start), advanced by the replaying applies, closed out by
         # leadership + mark_serving(). All ms fields are relative to
@@ -212,6 +241,7 @@ class RaftNode:
             "snapshot_index": 0,
             "snapshot_bytes": 0,
             "log_entries_loaded": 0,
+            "journal_truncated_tail": 0,
             "replay_target": 0,
             "entries_replayed": 0,
             "replayed_by_type": {},
@@ -465,6 +495,8 @@ class RaftNode:
                     "disk_bytes": self.snapshot_disk_bytes,
                     "installs_received": self.snapshots_installed,
                     "installs_sent": self.snapshots_sent,
+                    "chunks_sent": self.snapshot_chunks_sent,
+                    "chunks_received": self.snapshot_chunks_received,
                 },
             }
 
@@ -486,22 +518,51 @@ class RaftNode:
              "peers": dict(self.config.peers)}
         ))
 
-    def _persist_entry_line(self, line: str) -> None:
-        """Append one pre-serialized journal line (apply() builds the
-        line once so the byte measurement and the journal share one
-        dumps)."""
+    @staticmethod
+    def _journal_frame(body: str) -> str:
+        """Checksummed journal line: crc32 of the JSON body, fixed-width
+        hex, one space, body. The crc covers torn writes AND bit flips;
+        the body alone stays the wire-byte measure so leader/follower/
+        reloaded byte books agree."""
+        return f"{zlib.crc32(body.encode()):08x} {body}"
+
+    @staticmethod
+    def _journal_parse(raw: str) -> Optional[str]:
+        """Validate one journal line; returns the JSON body, or None when
+        the line is torn/corrupt. Legacy lines (pre-checksum journals
+        start straight at ``{``) pass through — json-parse downstream is
+        their only integrity check."""
+        if raw.startswith("{"):
+            return raw
+        if len(raw) < 10 or raw[8] != " ":
+            return None
+        prefix, body = raw[:8], raw[9:]
+        try:
+            want = int(prefix, 16)
+        except ValueError:
+            return None
+        if zlib.crc32(body.encode()) != want:
+            return None
+        return body
+
+    def _persist_entry_line(self, body: str) -> None:
+        """Append one pre-serialized journal body (apply() builds it once
+        so the byte measurement and the journal share one dumps); the
+        crc32 frame is added here."""
         if not self.config.data_dir:
             return
         _, log_path = self._paths()
         with open(log_path, "a") as f:
-            f.write(line + "\n")
+            f.write(self._journal_frame(body) + "\n")
 
     def _truncate_persisted_log(self) -> None:
         if not self.config.data_dir:
             return
         _, log_path = self._paths()
         _atomic_write(log_path, "".join(
-            json.dumps({"index": i, **entry.to_wire()}) + "\n"
+            self._journal_frame(
+                json.dumps({"index": i, **entry.to_wire()})
+            ) + "\n"
             for i, entry in enumerate(self.log, start=self.log_offset + 1)
         ))
 
@@ -596,10 +657,24 @@ class RaftNode:
         # successor entries were already compacted away) would mis-index
         # every entry, so the tail is discarded and re-fetched from the
         # leader instead.
+        torn = False
         try:
             with open(log_path) as f:
                 for line in f:
-                    d = json.loads(line)
+                    raw = line.rstrip("\n")
+                    body = self._journal_parse(raw) if raw else None
+                    if body is None:
+                        # Torn/corrupt line: a crash mid-append (or a bit
+                        # flip) must not brick the node. Everything before
+                        # this line replayed cleanly; everything from it
+                        # on is untrustworthy and is truncated below.
+                        torn = True
+                        break
+                    try:
+                        d = json.loads(body)
+                    except ValueError:
+                        torn = True
+                        break
                     if d["index"] <= self.log_offset:
                         continue
                     if d["index"] != self.log_offset + len(self.log) + 1:
@@ -610,13 +685,24 @@ class RaftNode:
                         )
                         break
                     entry = _Entry.from_wire(d)
-                    # The journal line's own length IS the byte measure
+                    # The journal body's own length IS the byte measure
                     # (the convention apply() stamps) — no re-dump on
                     # the cold-start path the recovery timeline clocks.
-                    entry.wire_bytes = len(line.rstrip("\n"))
+                    entry.wire_bytes = len(body)
                     self.log.append(entry)
-        except (OSError, ValueError):
+        except OSError:
             pass
+        if torn:
+            telemetry.incr_counter(("raft", "journal", "truncated_tail"))
+            self.recovery["journal_truncated_tail"] += 1
+            self.logger.warning(
+                "raft: journal tail torn/corrupt; truncated to last whole "
+                "checksummed entry (index %d)",
+                self.log_offset + len(self.log),
+            )
+            # Rewrite the clean prefix so the NEXT append lands on a valid
+            # journal instead of extending a corrupt tail.
+            self._truncate_persisted_log()
         # Close out the recovery bookkeeping for this load: the tail past
         # last_applied is what leadership (or the next leader's commit
         # advance) will REPLAY into the FSM; an empty tail means replay
@@ -847,6 +933,17 @@ class RaftNode:
 
     def _replicate_to(self, pid: str, addr: str) -> None:
         with self._lock:
+            if self.role != LEADER or pid in self._replicating_peers:
+                return
+            self._replicating_peers.add(pid)
+        try:
+            self._replicate_to_locked_out(pid, addr)
+        finally:
+            with self._lock:
+                self._replicating_peers.discard(pid)
+
+    def _replicate_to_locked_out(self, pid: str, addr: str) -> None:
+        with self._lock:
             if self.role != LEADER:
                 return
             term = self.current_term
@@ -918,22 +1015,52 @@ class RaftNode:
     def _send_snapshot(self, pid: str, addr: str, term: int,
                        snap_index: int, snap_term: int,
                        data: Optional[bytes]) -> None:
+        """Stream one snapshot in ``snapshot_chunk_bytes`` pieces (paper
+        §7's offset/done framing). Each chunk is a bounded RPC, so a
+        multi-MB snapshot interleaves with live traffic and keeps
+        resetting the follower's election timer; leadership is re-checked
+        between chunks so a deposed leader stops streaming immediately.
+        match/next advance only after the final chunk's ack — a transfer
+        aborted midway retries whole on the next replication pass."""
         if data is None:
             return
-        try:
-            resp = self.pool.call(addr, "Raft.InstallSnapshot", {
-                "term": term,
-                "leader_id": self.config.node_id,
-                "last_included_index": snap_index,
-                "last_included_term": snap_term,
-                "data": base64.b64encode(data).decode("ascii"),
-            }, timeout=10.0)
-        except (RPCError, RemoteError):
-            return
-        with self._lock:
-            if resp["term"] > self.current_term:
-                self._become_follower(resp["term"], None)
+        chunk = max(1, int(self.config.snapshot_chunk_bytes))
+        total = len(data)
+        offset = 0
+        while True:
+            with self._lock:
+                if self.role != LEADER or self.current_term != term:
+                    return
+            piece = data[offset:offset + chunk]
+            done = offset + len(piece) >= total
+            try:
+                resp = self.pool.call(addr, "Raft.InstallSnapshot", {
+                    "term": term,
+                    "leader_id": self.config.node_id,
+                    "last_included_index": snap_index,
+                    "last_included_term": snap_term,
+                    "offset": offset,
+                    "done": done,
+                    "data": base64.b64encode(piece).decode("ascii"),
+                }, timeout=5.0)
+            except (RPCError, RemoteError):
                 return
+            with self._lock:
+                if resp["term"] > self.current_term:
+                    self._become_follower(resp["term"], None)
+                    return
+                if self.role != LEADER or self.current_term != term:
+                    return
+                self.snapshot_chunks_sent += 1
+            if not resp.get("success", True):
+                # The follower discarded the reassembly (identity/offset
+                # mismatch — e.g. it restarted mid-transfer): abort; the
+                # next pass restarts from offset 0.
+                return
+            if done:
+                break
+            offset += len(piece)
+        with self._lock:
             if self.role != LEADER or self.current_term != term:
                 return
             self.match_index[pid] = max(self.match_index.get(pid, 0), snap_index)
@@ -950,7 +1077,7 @@ class RaftNode:
         with self._lock:
             term = args["term"]
             if term < self.current_term:
-                return {"term": self.current_term}
+                return {"term": self.current_term, "success": False}
             if term > self.current_term or self.role != FOLLOWER:
                 self._become_follower(term, args["leader_id"])
             self.leader_id = args["leader_id"]
@@ -958,11 +1085,36 @@ class RaftNode:
 
             snap_index = args["last_included_index"]
             snap_term = args["last_included_term"]
+            # Chunk reassembly (legacy single-shot senders omit offset/
+            # done: one whole-payload chunk). Identity- and offset-checked:
+            # any mismatch — a competing transfer, a dropped chunk, our own
+            # restart mid-transfer — discards the buffer and fails the RPC
+            # so the leader restarts from offset 0. Live AppendEntries
+            # interleave freely between chunks; the suffix-retention rule
+            # below reconciles whatever appended during the transfer.
+            offset = int(args.get("offset", 0))
+            done = bool(args.get("done", True))
+            key = (snap_index, snap_term)
+            if offset == 0:
+                self._snap_chunks = bytearray()
+                self._snap_chunks_key = key
+            elif (self._snap_chunks is None
+                    or self._snap_chunks_key != key
+                    or len(self._snap_chunks) != offset):
+                self._snap_chunks = None
+                self._snap_chunks_key = None
+                return {"term": self.current_term, "success": False}
+            self._snap_chunks.extend(decoded)
+            self.snapshot_chunks_received += 1
+            if not done:
+                return {"term": self.current_term, "success": True}
+            data = bytes(self._snap_chunks)
+            self._snap_chunks = None
+            self._snap_chunks_key = None
             if snap_index <= self.commit_index:
                 # Stale snapshot: we already have (and applied) everything
                 # it contains.
-                return {"term": self.current_term}
-            data = decoded
+                return {"term": self.current_term, "success": True}
             self.fsm.restore_bytes(data)
             # Paper §7: retain any log suffix that extends past the snapshot
             # and agrees with it; otherwise discard the whole log.
@@ -987,7 +1139,7 @@ class RaftNode:
                 "raft: node %s installed snapshot at index %d",
                 self.config.node_id, snap_index,
             )
-            return {"term": self.current_term}
+            return {"term": self.current_term, "success": True}
 
     def _advance_commit_locked(self) -> None:
         """Advance commit index over majority-matched entries of the current

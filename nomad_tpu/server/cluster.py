@@ -64,6 +64,9 @@ class ClusterConfig:
     # Entries retained past the snapshot at compaction (hashicorp/raft
     # TrailingLogs; RaftConfig.trailing_logs).
     trailing_logs: int = 1024
+    # InstallSnapshot transfer chunk size (RaftConfig.snapshot_chunk_bytes):
+    # raw snapshot bytes per RPC on the catch-up path.
+    snapshot_chunk_bytes: int = 256 * 1024
     # Gossip-style failure detection (serf memberlist probing, serf.go:136-
     # 194): each server pings its same-region peers every probe_interval;
     # suspicion_threshold consecutive failures mark a member failed. The
@@ -135,6 +138,7 @@ class ClusterServer(Server):
                 snapshot_threshold=self.cluster.snapshot_threshold,
                 snapshot_retain=self.cluster.snapshot_retain,
                 trailing_logs=self.cluster.trailing_logs,
+                snapshot_chunk_bytes=self.cluster.snapshot_chunk_bytes,
             ),
             self.fsm,
             self.rpc,

@@ -165,7 +165,12 @@ class HeartbeatManager:
                 if self._stopped or self._thread is not me:
                     return
                 now = time.monotonic()
-                while self._heap and not expired:
+                # Collect EVERYTHING already due in one pass: correlated
+                # death (a rack's whole TTL cohort landing together) must
+                # expire as a batch so the re-placement evals ride one
+                # raft apply + one broker enqueue instead of storming the
+                # broker one node at a time.
+                while self._heap:
                     deadline, gen, node_id = self._heap[0]
                     live = self._timers.get(node_id)
                     if live is None or live.gen != gen:
@@ -183,8 +188,10 @@ class HeartbeatManager:
                         timeout = max(self._heap[0][0] - now, 0.0)
                     self._wake.wait(timeout)
                     continue
-            for node_id in expired:
-                self._invalidate_heartbeat(node_id)
+            if len(expired) == 1:
+                self._invalidate_heartbeat(expired[0])
+            else:
+                self._expire_batch(expired)
 
     def _invalidate_heartbeat(self, node_id: str) -> None:
         """Missed TTL: mark the node down (heartbeat.go:84-104)."""
@@ -202,6 +209,27 @@ class HeartbeatManager:
         except Exception:
             self.server.logger.exception(
                 "heartbeat: failed to update status for node %s", node_id
+            )
+
+    def _expire_batch(self, node_ids: List[str]) -> None:
+        """Mass expiry: the same per-node expiry event each node would get
+        alone, then ONE server call that batches every node's down-status
+        raft apply and coalesces the re-placement evaluations into a
+        single eval_upsert — the broker sees one enqueue for the whole
+        dead rack, not a per-node storm."""
+        self.server.logger.warning(
+            "heartbeat: %d node TTLs expired together, marking down",
+            len(node_ids),
+        )
+        for node_id in node_ids:
+            self.server.fsm.events.publish(
+                "Node", "NodeHeartbeatExpired", key=node_id
+            )
+        try:
+            self.server.node_batch_expire(node_ids)
+        except Exception:
+            self.server.logger.exception(
+                "heartbeat: failed batch expiry for %d nodes", len(node_ids)
             )
 
     # -- cancel/stats -------------------------------------------------------

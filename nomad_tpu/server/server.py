@@ -935,15 +935,65 @@ class Server:
         """node_endpoint.go:385-457 (Node.UpdateAlloc)"""
         return self.raft.apply("alloc_client_update", {"allocs": allocs}).result()
 
+    def node_batch_expire(self, node_ids: List[str]) -> Dict:
+        """Mass TTL expiry (the heartbeat wheel's batch path): mark every
+        node down and fan out the re-placement evaluations in ONE
+        eval_upsert / broker enqueue instead of a per-node storm. Per-node
+        semantics stay IDENTICAL to node_update_status(down) +
+        create_node_evals: same per-node status applies (pipelined rather
+        than serialized), same per-node eval fan-out with NO cross-node
+        dedup — which nodes die in the same wheel pass is timing, and a
+        node's eval set must not depend on it."""
+        status = structs.NODE_STATUS_DOWN
+        staged: List[Tuple[str, object, int]] = []
+        for node_id in node_ids:
+            node = self.state_store.node_by_id(node_id)
+            if node is None:
+                continue
+            if node.status != status:
+                fut = self.raft.apply(
+                    "node_status_update",
+                    {"node_id": node_id, "status": status},
+                )
+                staged.append((node_id, fut, 0))
+            else:
+                staged.append((node_id, None, node.modify_index))
+        settled: List[Tuple[str, int]] = []
+        for node_id, fut, index in staged:
+            if fut is not None:
+                index = fut.result()
+            settled.append((node_id, index))
+        # One snapshot for the whole batch: every status apply above has
+        # committed, and the fan-out reads only allocs-by-node + system
+        # jobs, which those applies don't change.
+        snap = self.state_store.snapshot()
+        evals: List[Evaluation] = []
+        reply: Dict = {"eval_ids": [], "nodes": len(settled)}
+        for node_id, node_index in settled:
+            evals.extend(self._node_eval_fanout(snap, node_id, node_index))
+        if evals:
+            reply["eval_create_index"] = self.eval_upsert(evals)
+            reply["eval_ids"] = [e.id for e in evals]
+        return reply
+
     def create_node_evals(self, node_id: str, node_index: int) -> Tuple[List[str], int]:
         """Fan out node-update evals: one per job with allocs on the node,
         plus every system job (node_endpoint.go:459-551)."""
         snap = self.state_store.snapshot()
+        if (not snap.allocs_by_node(node_id)
+                and not snap.jobs_by_scheduler(structs.JOB_TYPE_SYSTEM)):
+            return [], 0
+        evals = self._node_eval_fanout(snap, node_id, node_index)
+        index = self.eval_upsert(evals)
+        return [e.id for e in evals], index
+
+    def _node_eval_fanout(self, snap, node_id: str,
+                          node_index: int) -> List[Evaluation]:
+        """One node's node-update eval set (the create_node_evals body,
+        shared with the batch-expiry path so single and mass expiry build
+        byte-identical evals from the same snapshot reads)."""
         allocs = snap.allocs_by_node(node_id)
         sys_jobs = snap.jobs_by_scheduler(structs.JOB_TYPE_SYSTEM)
-
-        if not allocs and not sys_jobs:
-            return [], 0
 
         evals: List[Evaluation] = []
         job_ids = set()
@@ -980,8 +1030,7 @@ class Server:
                 )
             )
 
-        index = self.eval_upsert(evals)
-        return [e.id for e in evals], index
+        return evals
 
     # -- Eval endpoint (eval_endpoint.go) ------------------------------------
 

@@ -35,3 +35,7 @@ from nomad_tpu.simcluster.workload import (  # noqa: F401
     SteadyServiceInjector,
     UpdateChurnInjector,
 )
+
+# Imported last (chaos builds on scenario + workload above); importing
+# the compiler also registers the shipped chaos families in SCENARIOS.
+from nomad_tpu.simcluster import chaos  # noqa: E402,F401

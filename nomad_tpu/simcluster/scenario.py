@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional
 
 from nomad_tpu import faults, structs
 from nomad_tpu.api.codec import to_dict
+from nomad_tpu.rpc import RemoteError
 from nomad_tpu.server import ServerConfig
 from nomad_tpu.server.cluster import ClusterConfig, ClusterServer, wait_for_leader
 from nomad_tpu.simcluster.simnode import SimFleet, sim_node
@@ -111,6 +112,19 @@ class ScenarioSpec:
     # the restart scenario compresses the compaction cadence so a cold
     # restart exercises snapshot restore AND log-tail replay.
     cluster_overrides: Dict = field(default_factory=dict)
+    # Raft cluster size. 1 keeps the classic single-member runner path
+    # byte-for-byte (every banked digest rides it); >1 stands up a real
+    # multi-member cell (shared peers table, one elected leader, the
+    # fleet pointed at it) — the partition-flap / follower-crash-rejoin
+    # chaos families' substrate.
+    cluster_members: int = 1
+    # Chaos verdict hook (nomad_tpu/simcluster/chaos.py): called as
+    # chaos_check(runner, srv, artifact) after the artifact is built;
+    # returns the artifact's "chaos" section and RAISES on a violated
+    # invariant (exactly-once re-placement, duplicate PlanApplied, a
+    # rejoined follower whose FSM digest diverged) — the _raft_section
+    # placements-survived posture.
+    chaos_check: Optional[Callable] = None
     description: str = ""
 
 
@@ -599,13 +613,20 @@ def canonical_events(events) -> Dict:
     wall-clock cadence, so how many land in a run is box-speed noise,
     and an observer being on vs off must be digest-invariant — that
     exclusion is what lets the churn-fragmentation contrast arm prove
-    the observatory decision-invariant."""
+    the observatory decision-invariant.
+
+    The "Fault" topic (faults.py's FaultInjected broadcast) is excluded
+    for the same reason: an armed flap window fires per RETRY attempt,
+    and how many retries land inside an armed window is wall-clock
+    cadence, not a per-entity lifecycle — the chaos families assert
+    their fault books from the artifact's faults section instead."""
     from nomad_tpu.events import OBSERVER_TOPICS
 
+    excluded = OBSERVER_TOPICS | {"Fault"}
     groups: Dict[str, List[str]] = {}
     by_type: Dict[str, int] = {}
     for e in events:
-        if e.topic in OBSERVER_TOPICS:
+        if e.topic in excluded:
             continue
         groups.setdefault(e.key, []).append(e.type)
         by_type[e.type] = by_type.get(e.type, 0) + 1
@@ -709,6 +730,17 @@ class ScenarioRunner:
         self._hb_carry: Dict = {}
         self._data_dir: Optional[str] = None
         self._restart: Optional[Dict] = None
+        # Multi-member bookkeeping (cluster_members > 1): every live
+        # member (leader first after election), the shared peers table a
+        # restarted member must rejoin through, the killed-follower book
+        # (kill_follower → restart_follower), the rejoin-poll thread,
+        # and the free-form chaos book the spec's chaos_check reduces
+        # into the artifact's chaos section.
+        self._members: List[ClusterServer] = []
+        self._peers: Dict[str, str] = {}
+        self._downed: Optional[Dict] = None
+        self._rejoin_thread: Optional[threading.Thread] = None
+        self._chaos: Dict = {}
         # Read-fleet bookkeeping (ReadFleetInjector): the lazily-started
         # loopback HTTP front end, the reader threads, and the
         # client-side request books the artifact's reads section carries
@@ -936,24 +968,155 @@ class ScenarioRunner:
         )
 
     def _fail_nodes(self, fleet: SimFleet, payload: Dict) -> List[str]:
-        rng = payload["rng"]
-        count = int(payload["count"])
+        """Silence nodes. Two modes: a seeded ``count`` sample preferring
+        alloc-hosting nodes (the classic churn tranche), or an explicit
+        ``node_ids`` list — a chaos kill schedule's correlated failure
+        domain (one whole rack dying together). Either way the hosted
+        alloc map at kill time lands in the chaos book, so a chaos_check
+        can judge exactly-once re-placement per lost alloc."""
         snap = self._srv.state_store.snapshot()
-        hosting = set()
+        live = set(fleet.live_nodes())
+        explicit = payload.get("node_ids")
+        if explicit:
+            pick: List[str] = [n for n in explicit if n in live]
+        else:
+            rng = payload["rng"]
+            count = int(payload["count"])
+            hosting = set()
+            for job in self._jobs.values():
+                for a in snap.allocs_by_job(job.id):
+                    if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN:
+                        hosting.add(a.node_id)
+            hosting &= live
+            pick = rng.sample(sorted(hosting), min(count, len(hosting)))
+            if len(pick) < count:
+                rest = sorted(live - set(pick))
+                pick += rng.sample(rest, min(count - len(pick), len(rest)))
+        killed = set(pick)
+        hosted: Dict[str, List[str]] = {}
         for job in self._jobs.values():
             for a in snap.allocs_by_job(job.id):
-                if a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN:
-                    hosting.add(a.node_id)
-        live = set(fleet.live_nodes())
-        hosting &= live
-        pick: List[str] = rng.sample(sorted(hosting), min(count, len(hosting)))
-        if len(pick) < count:
-            rest = sorted(live - set(pick))
-            pick += rng.sample(rest, min(count - len(pick), len(rest)))
+                if (a.desired_status == structs.ALLOC_DESIRED_STATUS_RUN
+                        and a.node_id in killed):
+                    hosted.setdefault(job.id, []).append(a.id)
+        book = self._chaos.setdefault(
+            "killed_nodes", {"nodes": [], "hosted_jobs": {}})
+        book["nodes"].extend(pick)
+        for jid, aids in sorted(hosted.items()):
+            book["hosted_jobs"].setdefault(jid, []).extend(aids)
         fleet.fail(pick)
-        self.logger.info("simcluster: silenced %d nodes (%d hosting allocs)",
-                         len(pick), len(hosting & set(pick)))
+        self.logger.info(
+            "simcluster: silenced %d nodes (%d jobs hosted there)",
+            len(pick), len(hosted))
         return pick
+
+    def _expand_fleet(self, fleet: SimFleet, payload: Dict) -> None:
+        """Register ``count`` fresh nodes starting at index ``start``
+        mid-run — the rack-failure family's spare tranche: capacity
+        that exists only AFTER the fill is fully placed (a barrier
+        enforces it), so every re-placement after the rack kill can
+        only land on spares and the exactly-once verdict is also a
+        where-did-it-go verdict."""
+        start = int(payload["start"])
+        count = int(payload["count"])
+        nodes = [sim_node(i, "dc1" if i % 2 == 0 else "dc2")
+                 for i in range(start, start + count)]
+        fleet.register(nodes)
+        self._chaos.setdefault("expanded", []).append(
+            {"start": start, "count": count})
+        self.logger.info(
+            "simcluster: expanded fleet by %d spare nodes", count)
+
+    def _followers(self) -> List[ClusterServer]:
+        # Re-resolve the live leader first: bring-up churn (a loaded
+        # one-GIL cell can stall a heartbeat past an election timeout)
+        # may have moved leadership after self._srv was chosen, and a
+        # stale view here would turn a follower-kill into a LEADER
+        # kill — seconds of leaderless forwarding, delivery-limit eval
+        # failures, and a digest that depends on wall clock.
+        for m in self._members:
+            if m.raft.is_leader:
+                self._srv = m
+                break
+        srv = self._srv
+        return sorted((m for m in self._members if m is not srv),
+                      key=lambda m: m.cluster.node_id)
+
+    def _kill_follower(self, payload: Dict) -> None:
+        """Kill one follower outright mid-load (``index`` over the
+        sorted non-leader members). The cell keeps serving on the
+        remaining quorum; the kill book carries everything
+        restart_follower needs to bring the SAME member back from its
+        durable state on the same port."""
+        followers = self._followers()
+        target = followers[int(payload.get("index", 0))]
+        book = {
+            "node_id": target.cluster.node_id,
+            "port": int(target.rpc_addr.rsplit(":", 1)[1]),
+            "data_dir": target.cluster.raft_data_dir,
+            "killed_at_s": round(
+                time.perf_counter() - self._t_measure0, 2),
+            "leader_applied_at_kill": self._srv.raft.applied_index,
+            "_index": self._members.index(target),
+        }
+        target.shutdown()
+        self._downed = book
+        self._chaos["follower_kill"] = {
+            k: v for k, v in book.items() if not k.startswith("_")}
+        self.logger.info("simcluster: killed follower %s at t=%.2fs",
+                         book["node_id"], book["killed_at_s"])
+
+    def _restart_follower(self, payload: Dict) -> None:
+        """Restart the killed follower from its durable raft state on
+        the SAME port and node id, while the cell keeps serving. With
+        the kill-to-restart window sized past the leader's snapshot
+        threshold, the rejoin rides the chunked InstallSnapshot path
+        (raft/node.py) racing live appends; a background poll stamps
+        time-to-rejoin (follower applied index reaching the leader's
+        commit floor at restart) into the chaos book, and the spec's
+        chaos_check joins it before judging digest equality."""
+        book = self._downed
+        if book is None:
+            raise RuntimeError(
+                "restart_follower without a killed follower")
+        self._downed = None
+        name = book["node_id"]
+        cfg = ServerConfig(**{**self._cfg_kwargs, "node_name": name})
+        ccfg = self._cluster_config(bind_port=book["port"],
+                                    data_dir=book["data_dir"])
+        ccfg.node_id = name
+        ccfg.bootstrap_expect = len(self._members)
+        ccfg.peers = self._peers
+        srv2 = ClusterServer(cfg, ccfg, logger=self.logger.getChild(name))
+        self._members[book["_index"]] = srv2
+        commit_floor = self._srv.raft.commit_index
+        t_restart = time.perf_counter()
+        srv2.start()
+        restart_book = {
+            "node_id": name,
+            "restarted_at_s": round(t_restart - self._t_measure0, 2),
+            "downtime_s": round(t_restart - self._t_measure0
+                                - book["killed_at_s"], 2),
+            "commit_floor": commit_floor,
+            "time_to_rejoin_ms": None,
+        }
+        self._chaos["follower_restart"] = restart_book
+
+        def _poll_rejoin() -> None:
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if srv2.raft.applied_index >= commit_floor:
+                    restart_book["time_to_rejoin_ms"] = round(
+                        (time.perf_counter() - t_restart) * 1000.0, 1)
+                    return
+                time.sleep(0.02)
+
+        self._rejoin_thread = threading.Thread(
+            target=_poll_rejoin, daemon=True, name="sim-rejoin")
+        self._rejoin_thread.start()
+        self.logger.info(
+            "simcluster: follower %s restarting from %s (commit floor "
+            "%d)", name, book["data_dir"], commit_floor)
 
     def _read_storm(self, payload: Dict) -> None:
         """Launch the impolite read fleet (ReadFleetInjector): stand the
@@ -1059,12 +1222,78 @@ class ScenarioRunner:
             payload.get("pollers", 0), payload.get("watchers", 0),
             payload.get("sse_tails", 0), float(payload["until"]))
 
-    def _cluster_config(self, bind_port: int = 0) -> ClusterConfig:
+    def _resolve_fault_plan(self, plan: Dict) -> Dict:
+        """Bind member-role placeholders in an armed fault plan:
+        ``{leader}`` -> the elected leader's node id, ``{followerN}`` ->
+        the Nth sorted non-leader member. Chaos specs are written
+        before the seeded election resolves who leads, so the plan
+        speaks in roles and the runner substitutes the winners here
+        (recursively, over every string in the plan — site match rules
+        are where they matter)."""
+        if len(self._members) <= 1:
+            return plan
+        subs = {"{leader}": self._srv.cluster.node_id}
+        for i, m in enumerate(self._followers()):
+            subs[f"{{follower{i}}}"] = m.cluster.node_id
+
+        def sub(v):
+            if isinstance(v, str):
+                for k, s in subs.items():
+                    v = v.replace(k, s)
+                return v
+            if isinstance(v, dict):
+                return {k: sub(x) for k, x in v.items()}
+            if isinstance(v, list):
+                return [sub(x) for x in v]
+            return v
+
+        return sub(plan)
+
+    def _cluster_config(self, bind_port: int = 0,
+                        data_dir: Optional[str] = None) -> ClusterConfig:
         kwargs = dict(bootstrap_expect=1, bind_port=bind_port)
-        if self._data_dir:
-            kwargs["raft_data_dir"] = self._data_dir
+        data_dir = data_dir or self._data_dir
+        if data_dir:
+            kwargs["raft_data_dir"] = data_dir
         kwargs.update(self.spec.cluster_overrides)
         return ClusterConfig(**kwargs)
+
+    def _build_cluster(self, cfg_kwargs: Dict) -> List[ClusterServer]:
+        """Construct the run's server(s). cluster_members == 1 is the
+        classic single-member path, byte-for-byte. >1 builds a real
+        cell: every member shares ONE peers dict (each registers its
+        rpc_addr at construction — RPCServer binds in __init__, so the
+        table is complete before anyone starts), bootstrap_expect =
+        members, and — when the spec is durable — each member journals
+        into its own subdirectory of the run's temp data dir (a shared
+        dir would interleave three journals into one file)."""
+        members = int(self.spec.cluster_members or 1)
+        if members <= 1:
+            cfg = ServerConfig(**cfg_kwargs)
+            srv = ClusterServer(
+                cfg, self._cluster_config(), logger=self.logger,
+            )
+            self._members = [srv]
+            return self._members
+        import os as _os
+
+        self._peers = {}
+        out: List[ClusterServer] = []
+        for i in range(members):
+            name = f"server-{i}"
+            data_dir = None
+            if self._data_dir is not None:
+                data_dir = _os.path.join(self._data_dir, name)
+                _os.makedirs(data_dir, exist_ok=True)
+            ccfg = self._cluster_config(data_dir=data_dir)
+            ccfg.node_id = name
+            ccfg.bootstrap_expect = members
+            ccfg.peers = self._peers
+            cfg = ServerConfig(**{**cfg_kwargs, "node_name": name})
+            out.append(ClusterServer(
+                cfg, ccfg, logger=self.logger.getChild(name)))
+        self._members = out
+        return out
 
     def _restart_leader(self, fleet: SimFleet) -> None:
         """Kill the leader outright and restart it from its durable raft
@@ -1125,6 +1354,8 @@ class ScenarioRunner:
             cfg2, self._cluster_config(bind_port=port), logger=self.logger,
         )
         self._srv = srv2
+        if self._members:
+            self._members[self._members.index(old)] = srv2
         # The write-path books must span both server lives: the new
         # observatory adopts the dead one's cumulative aggregates.
         srv2.raft_observatory.absorb(old.raft_observatory)
@@ -1191,10 +1422,8 @@ class ScenarioRunner:
             import tempfile
 
             self._data_dir = tempfile.mkdtemp(prefix="nomad-sim-raft-")
-        cfg = ServerConfig(**cfg_kwargs)
-        srv = self._srv = ClusterServer(
-            cfg, self._cluster_config(), logger=self.logger,
-        )
+        members = self._build_cluster(cfg_kwargs)
+        srv = self._srv = members[0]
         fleet = SimFleet(srv.rpc_addr, logger=self.logger)
         threads: List[threading.Thread] = []
         from nomad_tpu import trace as trace_mod
@@ -1205,8 +1434,20 @@ class ScenarioRunner:
             tracer.enabled = False
         t_run0 = time.perf_counter()
         try:
-            srv.start()
-            wait_for_leader([srv])
+            for m in members:
+                m.start()
+            if len(members) == 1:
+                wait_for_leader([srv])
+            else:
+                # Whoever won the seeded election is the cell's front
+                # door for the whole run: the runner's RPC surface
+                # (self._srv) and the fleet both point at it. Followers
+                # forward writes anyway, but pointing at the leader
+                # keeps the paced loop's latency story clean.
+                srv = self._srv = wait_for_leader(members, timeout=30.0)
+                members.sort(key=lambda m: (m is not srv,
+                                            m.cluster.node_id))
+                fleet.addr = srv.rpc_addr
 
             # Phase 1: fleet bring-up (batched registration + TTL arms).
             # The beater starts FIRST: it idles on an empty schedule, and
@@ -1218,7 +1459,21 @@ class ScenarioRunner:
                 for i in range(self.n_nodes)
             ]
             fleet.start_heartbeats()
-            reg = fleet.register(nodes)
+            try:
+                reg = fleet.register(nodes)
+            except RemoteError as e:
+                if len(members) == 1 or "NotLeaderError" not in str(e):
+                    raise
+                # An election churned between wait_for_leader and
+                # bring-up (3 servers in one GIL can stall a heartbeat
+                # past the deadline): re-resolve the front door and
+                # re-register — registration is an idempotent upsert,
+                # so nodes admitted before the flip just re-land.
+                srv = self._srv = wait_for_leader(members, timeout=30.0)
+                members.sort(key=lambda m: (m is not srv,
+                                            m.cluster.node_id))
+                fleet.addr = srv.rpc_addr
+                reg = fleet.register(nodes)
             timers = srv.heartbeat.num_timers()
             if timers != self.n_nodes:
                 raise RuntimeError(
@@ -1290,7 +1545,7 @@ class ScenarioRunner:
             if spec.faults_spec is not None:
                 plan = dict(spec.faults_spec)
                 plan.setdefault("seed", self.seed)
-                faults.get_registry().load(plan)
+                faults.get_registry().load(self._resolve_fault_plan(plan))
             broker = srv.fsm.events
             cursor = broker.get_index()
             self._hb0 = hb0 = srv.heartbeat.stats()
@@ -1388,6 +1643,29 @@ class ScenarioRunner:
                     self._restart_leader(fleet)
                 elif action.kind == "read_storm":
                     self._read_storm(action.payload)
+                elif action.kind == "barrier":
+                    # Structural determinism point for chaos phases:
+                    # everything injected so far must be terminal and
+                    # the broker drained before the next phase exists
+                    # (e.g. the rack fill fully placed BEFORE the spare
+                    # tranche registers).
+                    self._wait_quiesced(
+                        self._srv, list(expected_evals), [],
+                        time.monotonic()
+                        + float(action.payload.get("timeout", 60.0)))
+                elif action.kind == "expand_fleet":
+                    self._expand_fleet(fleet, action.payload)
+                elif action.kind == "kill_follower":
+                    self._kill_follower(action.payload)
+                elif action.kind == "restart_follower":
+                    self._restart_follower(action.payload)
+                elif action.kind == "settle":
+                    # Pure pacing point: the sleep above already held
+                    # the loop open to this action's time. The chaos
+                    # compiler emits one past the storm horizon so a
+                    # fast workload cannot quiesce while scheduled
+                    # fault windows are still in the future.
+                    pass
             for t in blasters:
                 t.join()
             if blast_errors:
@@ -1474,7 +1752,12 @@ class ScenarioRunner:
                 self._http.shutdown()
                 self._http = None
             fleet.stop()
-            self._srv.shutdown()
+            for m in (self._members or [self._srv]):
+                try:
+                    m.shutdown()
+                except Exception:
+                    self.logger.exception(
+                        "simcluster: member shutdown failed")
             if self._data_dir is not None:
                 import shutil
 
@@ -1766,6 +2049,13 @@ class ScenarioRunner:
             artifact["slo"] = None
         if self.spec.faults_spec is not None:
             artifact["faults"] = faults.get_registry().snapshot()
+        if self.spec.chaos_check is not None:
+            # The chaos verdict (nomad_tpu/simcluster/chaos.py): judges
+            # the family's declared invariants against the finished
+            # artifact + live cluster state and RAISES on a violation —
+            # exactly-once re-placement and digest equality are the
+            # contract, not statistics (the _raft_section posture).
+            artifact["chaos"] = self.spec.chaos_check(self, srv, artifact)
         return artifact
 
     def _capacity_section(self, srv) -> Dict:

@@ -96,6 +96,28 @@ SCENARIO_OBJECTIVES: Dict[str, Dict[str, float]] = {
                    "submit_to_placed_p95_ms": 1000.0},
     "read-storm-800": {**DEFAULT_OBJECTIVES,
                        "submit_to_placed_p95_ms": 1000.0},
+    # Chaos families (nomad_tpu/simcluster/chaos.py; the specs declare
+    # the SAME bounds and register() re-merges them — declared here too
+    # so a process that never imports the chaos compiler, like the
+    # bench_watch slo-gate scan, judges the banked artifacts against
+    # the declared bounds, and test_chaos.py pins the two in sync):
+    # - rack-failure drains a 256-job full-node fill through ONE
+    #   scheduler worker (determinism) — the fill's serial queue
+    #   backlog IS the p95, and the chaos gate separately judges the
+    #   expiry->re-placement quantiles the family actually promises.
+    # - partition-flap drops the leader's append stream half of every
+    #   flap period BY DESIGN — commit stalls during the storm are the
+    #   scenario's point; the bound catches a real scheduling
+    #   regression on top of the declared partition stalls.
+    # - follower-crash-rejoin runs a 2-worker raft cell while a
+    #   chunked snapshot streams to the rejoining follower; plans
+    #   queued behind the kill/restart window wait it out.
+    "rack-failure": {**DEFAULT_OBJECTIVES,
+                     "submit_to_placed_p95_ms": 15000.0},
+    "partition-flap": {**DEFAULT_OBJECTIVES,
+                       "submit_to_placed_p95_ms": 5000.0},
+    "follower-crash-rejoin": {**DEFAULT_OBJECTIVES,
+                              "submit_to_placed_p95_ms": 5000.0},
 }
 
 _NAME_RE = re.compile(r"^(?P<metric>[a-z_]+)_p(?P<pct>\d{1,2})_ms$")

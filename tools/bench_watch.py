@@ -512,6 +512,61 @@ def read_gate(new_artifact: dict, baseline_artifact: dict | None,
     return {"ok": ok, "tolerance": tolerance, "checks": checks}
 
 
+# Chaos-gate tolerance: rejoin and expiry-replacement times ride TTL
+# jitter, snapshot transfer and re-election noise, so the newest-vs-
+# previous bar is deliberately loose — it exists to catch a real
+# recovery regression (2x-class), not scheduler jitter. The invariant
+# half of the gate (exactly-once, digest equality) is absolute.
+CHAOS_GATE_TOLERANCE = 0.5
+
+
+def chaos_gate(new_artifact: dict, baseline_artifact: dict | None,
+               tolerance: float = CHAOS_GATE_TOLERANCE) -> dict | None:
+    """Gate a chaos-family artifact (nomad_tpu/simcluster/chaos.py).
+    ABSOLUTE (every round, baseline or not): every declared chaos check
+    — exactly-once re-placement, no duplicate PlanApplied, leader
+    stability, flap-transition books, rejoin digest equality — must
+    hold; the runner refuses to even bank a violating artifact, so a
+    banked artifact with a failed check means someone hand-edited the
+    bank. RELATIVE (newest-vs-previous when the prior bank carries the
+    same metric): time-to-rejoin and the expiry->re-placement p95 must
+    not grow more than ``tolerance``. None when the artifact has no
+    chaos section (not a chaos family)."""
+    chaos = new_artifact.get("chaos")
+    if not chaos:
+        return None
+    failed = [c["check"] for c in chaos.get("checks", ())
+              if not c.get("ok")]
+    checks = [{
+        "check": "chaos_invariants",
+        "value": len(chaos.get("checks", ())) - len(failed),
+        "baseline": None,
+        "regressed": bool(failed) or chaos.get("ok") is not True,
+        "failed": failed,
+    }]
+    ok = not checks[0]["regressed"]
+    base_chaos = (baseline_artifact or {}).get("chaos") or {}
+
+    def rejoin_ms(c: dict):
+        return c.get("time_to_rejoin_ms")
+
+    def expiry_p95(c: dict):
+        return (c.get("expiry_replacement_ms") or {}).get("p95_ms")
+
+    for name, fn in (("time_to_rejoin_ms", rejoin_ms),
+                     ("expiry_replacement_p95_ms", expiry_p95)):
+        value = fn(chaos)
+        if value is None:
+            continue
+        baseline = fn(base_chaos)
+        regressed = (baseline is not None and baseline > 0
+                     and value > baseline * (1.0 + tolerance))
+        checks.append({"check": name, "value": value,
+                       "baseline": baseline, "regressed": regressed})
+        ok = ok and not regressed
+    return {"ok": ok, "tolerance": tolerance, "checks": checks}
+
+
 def slo_gate_scan(log=log) -> bool:
     """Run the SLO gate over every banked artifact family: newest-vs-
     previous where a prior round exists, absolute-against-objectives for
@@ -529,6 +584,7 @@ def slo_gate_scan(log=log) -> bool:
                 solver_verdict = None
                 recovery_verdict = recovery_gate(new, None)
                 read_verdict = read_gate(new, None)
+                chaos_verdict = chaos_gate(new, None)
             else:
                 with open(base_path) as f:
                     base = json.load(f)
@@ -536,6 +592,7 @@ def slo_gate_scan(log=log) -> bool:
                 solver_verdict = solver_gate(new, base)
                 recovery_verdict = recovery_gate(new, base)
                 read_verdict = read_gate(new, base)
+                chaos_verdict = chaos_gate(new, base)
         except (OSError, ValueError, KeyError) as e:
             log("slo-gate-error", family=fam, error=str(e))
             ok = False
@@ -564,6 +621,11 @@ def slo_gate_scan(log=log) -> bool:
                 regressed=[c["check"] for c in read_verdict["checks"]
                            if c["regressed"]])
             ok = ok and read_verdict["ok"]
+        if chaos_verdict is not None:
+            log("chaos-gate", family=fam, ok=chaos_verdict["ok"],
+                regressed=[c["check"] for c in chaos_verdict["checks"]
+                           if c["regressed"]])
+            ok = ok and chaos_verdict["ok"]
     return ok
 
 

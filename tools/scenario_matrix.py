@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Scenario matrix sweep: run a declared grid of compiled scenarios,
+bank one SIMLOAD artifact per cell, and diff the matrix against the
+previous banked round.
+
+    python tools/scenario_matrix.py --round 17
+    python tools/scenario_matrix.py --round 17 --verify-determinism
+    python tools/scenario_matrix.py --scenarios rack-failure,partition-flap \
+        --seeds 42,43 --round 17
+
+The grid is scenarios x seeds (default: the three chaos families x seed
+42 — the declared chaos matrix). Each cell shells out to tools/simload.py
+so a cell run is EXACTLY a banked run (same artifact schema, same
+determinism verification, same one-line summary), banked as
+``SIMLOAD_<scenario>_s<seed>_r<round>.json`` — the round-suffixed family
+naming tools/bench_watch.py's gates already scan. After the sweep the
+matrix diff compares every cell against the newest earlier round of the
+same family (headline placement/latency numbers, canonical digest
+equality, chaos verdicts, recovery metrics) and writes
+``SIMLOAD_MATRIX_r<round>.json`` plus one JSON line per cell.
+
+A cell whose scenario run FAILS (violated chaos invariant, determinism
+mismatch, crash) is banked as a failed cell and the sweep continues —
+the matrix is an observatory, one dead cell must not hide the others —
+but the exit code reports the failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_SCENARIOS = ["rack-failure", "partition-flap",
+                     "follower-crash-rejoin"]
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _previous_artifact(family: str, current_round: int):
+    """Newest banked artifact of ``family`` (= ``<scenario>_s<seed>``)
+    from a round before ``current_round``; an unsuffixed bank counts as
+    the oldest round."""
+    best = None  # (round, path)
+    for path in glob.glob(os.path.join(REPO, f"SIMLOAD_{family}*.json")):
+        base = os.path.basename(path)
+        if not base.startswith(f"SIMLOAD_{family}"):
+            continue
+        tail = base[len(f"SIMLOAD_{family}"):]
+        m = _ROUND_RE.match(tail) if tail != ".json" else None
+        if tail == ".json":
+            rnd = -1
+        elif m:
+            rnd = int(m.group(1))
+        else:
+            continue  # some other family sharing the prefix
+        if rnd >= current_round:
+            continue
+        if best is None or rnd > best[0]:
+            best = (rnd, path)
+    return best
+
+
+def _rel(new, old):
+    if new is None or old is None or not old:
+        return None
+    return round(new / old - 1.0, 4)
+
+
+def _cell_headline(artifact: dict) -> dict:
+    chaos = artifact.get("chaos") or {}
+    return {
+        "placed": (artifact.get("placements") or {}).get("placed"),
+        "placements_per_sec": (artifact.get("placements") or {}).get(
+            "placements_per_sec"),
+        "plan_p50_ms": (artifact.get("plan_latency_ms") or {}).get("p50_ms"),
+        "plan_p95_ms": (artifact.get("plan_latency_ms") or {}).get("p95_ms"),
+        "digest": (artifact.get("events") or {}).get("digest"),
+        "determinism_verified": (artifact.get("determinism") or {}).get(
+            "verified"),
+        "chaos_ok": chaos.get("ok"),
+        "chaos_checks": sum(1 for c in chaos.get("checks", ())
+                            if c.get("ok")),
+        "time_to_rejoin_ms": chaos.get("time_to_rejoin_ms"),
+        "expiry_replacement_p95_ms": (chaos.get("expiry_replacement_ms")
+                                      or {}).get("p95_ms"),
+    }
+
+
+def _diff_cell(new: dict, old: dict) -> dict:
+    nh, oh = _cell_headline(new), _cell_headline(old)
+    return {
+        "placed_delta": ((nh["placed"] - oh["placed"])
+                         if None not in (nh["placed"], oh["placed"])
+                         else None),
+        "placements_per_sec_rel": _rel(nh["placements_per_sec"],
+                                       oh["placements_per_sec"]),
+        "plan_p95_ms_rel": _rel(nh["plan_p95_ms"], oh["plan_p95_ms"]),
+        "digest_match": (nh["digest"] == oh["digest"]
+                         if nh["digest"] and oh["digest"] else None),
+        "time_to_rejoin_ms_rel": _rel(nh["time_to_rejoin_ms"],
+                                      oh["time_to_rejoin_ms"]),
+        "expiry_replacement_p95_ms_rel": _rel(
+            nh["expiry_replacement_p95_ms"],
+            oh["expiry_replacement_p95_ms"]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenarios", default=",".join(DEFAULT_SCENARIOS),
+                    help="comma-separated scenario names (the grid rows)")
+    ap.add_argument("--seeds", default="42",
+                    help="comma-separated seeds (the grid columns)")
+    ap.add_argument("--round", type=int, required=True, dest="round_",
+                    help="round number to bank under (_rNN suffix)")
+    ap.add_argument("--verify-determinism", action="store_true",
+                    help="pass through to simload: run each cell twice "
+                         "and assert canonical digests match")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="per-cell wall clock budget (seconds)")
+    ap.add_argument("--out", default=None,
+                    help="matrix path (default SIMLOAD_MATRIX_r<NN>.json)")
+    args = ap.parse_args()
+
+    scenarios = [s for s in args.scenarios.split(",") if s]
+    seeds = [int(s) for s in args.seeds.split(",") if s]
+
+    from nomad_tpu.simcluster import SCENARIOS
+    unknown = sorted(set(scenarios) - set(SCENARIOS))
+    if unknown:
+        print(f"unknown scenario(s) {unknown}; have {sorted(SCENARIOS)}",
+              file=sys.stderr)
+        return 2
+
+    cells = []
+    failed = 0
+    for name in scenarios:
+        for seed in seeds:
+            family = f"{name}_s{seed}"
+            out_path = os.path.join(
+                REPO, f"SIMLOAD_{family}_r{args.round_:02d}.json")
+            cmd = [sys.executable, os.path.join(REPO, "tools/simload.py"),
+                   "--scenario", name, "--seed", str(seed),
+                   "--out", out_path]
+            if args.verify_determinism:
+                cmd.append("--verify-determinism")
+            cell = {"scenario": name, "seed": seed, "family": family,
+                    "artifact": out_path, "round": args.round_}
+            try:
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True,
+                    timeout=args.timeout, cwd=REPO)
+                cell["exit_code"] = proc.returncode
+                if proc.returncode != 0:
+                    failed += 1
+                    cell["error"] = (proc.stderr or proc.stdout
+                                     or "").strip()[-2000:]
+            except subprocess.TimeoutExpired:
+                failed += 1
+                cell["exit_code"] = None
+                cell["error"] = f"cell timed out after {args.timeout}s"
+            if os.path.exists(out_path):
+                with open(out_path) as f:
+                    artifact = json.load(f)
+                cell["headline"] = _cell_headline(artifact)
+                prev = _previous_artifact(family, args.round_)
+                if prev is not None:
+                    prev_round, prev_path = prev
+                    with open(prev_path) as f:
+                        old = json.load(f)
+                    cell["previous"] = {
+                        "round": prev_round,
+                        "artifact": os.path.basename(prev_path),
+                    }
+                    cell["diff"] = _diff_cell(artifact, old)
+            cells.append(cell)
+            print(json.dumps({
+                "metric": "scenario_matrix.cell",
+                "family": family,
+                "ok": cell.get("exit_code") == 0,
+                "chaos_ok": (cell.get("headline") or {}).get("chaos_ok"),
+                "digest_match_prev": (cell.get("diff") or {}).get(
+                    "digest_match"),
+            }))
+
+    matrix = {
+        "round": args.round_,
+        "grid": {"scenarios": scenarios, "seeds": seeds},
+        "cells": cells,
+        "failed_cells": failed,
+    }
+    matrix_path = args.out or os.path.join(
+        REPO, f"SIMLOAD_MATRIX_r{args.round_:02d}.json")
+    with open(matrix_path, "w") as f:
+        json.dump(matrix, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "scenario_matrix",
+        "round": args.round_,
+        "cells": len(cells),
+        "failed_cells": failed,
+        "matrix": matrix_path,
+    }))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
