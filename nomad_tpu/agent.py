@@ -59,6 +59,9 @@ class AgentConfig:
     # Read-path observatory spec (nomad_tpu/read_observe.py):
     # None = defaults (enabled).
     reads: Optional[Dict] = None
+    # Runtime self-observatory spec (nomad_tpu/profile_observe.py):
+    # sampling profiler + byte-economy ledger. None = defaults (enabled).
+    profile: Optional[Dict] = None
     # Solver device mesh spec (nomad_tpu/parallel/mesh.py): None =
     # single-device solves.
     solver_mesh: Optional[Dict] = None
@@ -70,6 +73,13 @@ class AgentConfig:
     # traces (0 = default 256) and the master enable.
     trace_buffer_size: int = 0
     disable_tracing: bool = False
+    # Lock-ordering + contention watchdog (telemetry.LockWatchdog):
+    # wraps every lock the nomadlint lock-order analysis knows about to
+    # check acquisition order and time contention. Installed at agent
+    # CONSTRUCTION (locks are wrapped as they are built, so installing
+    # any later would observe nothing). Default off: the uncontended
+    # fast path is cheap but not free.
+    lock_watchdog: bool = False
     # Cluster event stream (nomad_tpu.events): ring size of retained
     # events (0 = default 2048) — the /v1/event/stream resume window.
     event_buffer_size: int = 0
@@ -157,6 +167,8 @@ class AgentConfig:
                           if fc.server.raft_observe is not None else None),
             reads=(dict(fc.server.reads)
                    if fc.server.reads is not None else None),
+            profile=(dict(fc.server.profile)
+                     if fc.server.profile is not None else None),
             solver_mesh=(dict(fc.server.solver_mesh)
                          if fc.server.solver_mesh is not None else None),
             enable_debug=fc.enable_debug,
@@ -165,6 +177,7 @@ class AgentConfig:
             disable_hostname_metrics=fc.telemetry.disable_hostname,
             trace_buffer_size=fc.telemetry.trace_buffer_size,
             disable_tracing=fc.telemetry.disable_tracing,
+            lock_watchdog=fc.telemetry.lock_watchdog,
             event_buffer_size=fc.telemetry.event_buffer_size,
             histogram_buckets=list(fc.telemetry.histogram_buckets),
             # None (no slo{} block) = default objectives; an explicit
@@ -228,12 +241,44 @@ class Agent:
 
             _split_endpoint(config.atlas_endpoint)
 
+        self.lock_watchdog = None
+        if config.lock_watchdog:
+            # Must precede _setup_server(): the watchdog patches
+            # threading.Lock/RLock, so only locks CONSTRUCTED after
+            # install() are wrapped — and the server builds all of its
+            # locks in __init__.
+            self._install_lock_watchdog()
         if config.server_enabled:
             self._setup_server()
         if config.client_enabled:
             self._setup_client()
         if self.server is None and self.client is None:
             raise ValueError("must have at least client or server mode enabled")
+
+    def _install_lock_watchdog(self) -> None:
+        """telemetry{lock_watchdog = true}: wrap lock construction so every
+        named lock checks acquisition order against the nomadlint analysis
+        and times contention. The analysis needs the repo's source tree
+        (tools/nomadlint); in a stripped deployment without it the knob
+        degrades to a warning rather than failing agent construction."""
+        from nomad_tpu import telemetry
+
+        try:
+            from tools.nomadlint import lockorder
+            from tools.nomadlint.project import Project
+
+            an = lockorder.analyze(Project())
+            # closure= switches violation semantics to "inversion of a
+            # statically proven edge": pairs the analysis never related
+            # (cross-function acquisitions it cannot resolve) are
+            # recorded as observed edges, not flagged.
+            wd = telemetry.LockWatchdog(order=an.order, sites=an.sites(),
+                                        closure=an.closure())
+            self.lock_watchdog = wd.install()
+        except Exception as e:
+            self.logger.warning(
+                "lock_watchdog requested but unavailable "
+                "(tools.nomadlint analysis failed): %s", e)
 
     def _setup_server(self) -> None:
         """agent.go:153-173. Dev mode runs the in-process server (the
@@ -258,6 +303,8 @@ class Agent:
                           if self.config.raft_observe is not None else None),
             reads=(dict(self.config.reads)
                    if self.config.reads is not None else None),
+            profile=(dict(self.config.profile)
+                     if self.config.profile is not None else None),
             solver_mesh=(dict(self.config.solver_mesh)
                          if self.config.solver_mesh is not None else None),
         )
@@ -429,6 +476,11 @@ class Agent:
             self.client.shutdown(destroy_allocs=self.config.dev_mode)
         if self.server is not None:
             self.server.shutdown()
+        if self.lock_watchdog is not None:
+            # Restore the real lock constructors; locks wrapped during
+            # this agent's lifetime keep their (harmless) proxies.
+            self.lock_watchdog.uninstall()
+            self.lock_watchdog = None
 
     # -- info for the agent HTTP endpoints -----------------------------------
 

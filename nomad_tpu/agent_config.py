@@ -114,6 +114,11 @@ class ServerBlock:
     # ``reads { }`` sub-block tunes the read-only observer behind
     # /v1/agent/reads (poll/event cadence). None = defaults (enabled).
     reads: Optional[Dict[str, object]] = None
+    # Runtime self-observatory (nomad_tpu/profile_observe.py): the
+    # ``profile { }`` sub-block tunes the read-only observer behind
+    # /v1/agent/profile and /v1/agent/runtime (sampling cadence/jitter/
+    # seed, byte-ledger and event cadence). None = defaults (enabled).
+    profile: Optional[Dict[str, object]] = None
     # Solver device mesh (nomad_tpu/parallel/mesh.py): the
     # ``solver_mesh { }`` sub-block shards the node axis of every device
     # solve over a JAX mesh — ``node_shards`` devices per eval row,
@@ -136,7 +141,12 @@ class Telemetry:
     ``slo { }`` sub-block declares latency objectives
     (``submit_to_placed_p95_ms = 250`` style, nomad_tpu.slo). Absent vs
     explicitly empty matters for ``slo``: no block (None) means the
-    default objective set, an empty ``slo { }`` disables the monitor."""
+    default objective set, an empty ``slo { }`` disables the monitor.
+    ``lock_watchdog`` installs the telemetry.LockWatchdog at agent
+    construction (BEFORE any server lock is built): runtime lock-order
+    assertion plus per-site contention/hold timing, surfaced through
+    /v1/agent/runtime and the ``nomad_lock_*`` metric family. Default
+    off — wrapping costs a try-acquire per tracked acquisition."""
 
     statsite_address: str = ""
     statsd_address: str = ""
@@ -146,6 +156,7 @@ class Telemetry:
     event_buffer_size: int = 0
     histogram_buckets: List[float] = field(default_factory=list)
     slo: Optional[Dict[str, float]] = None
+    lock_watchdog: bool = False
 
 
 @dataclass
@@ -333,6 +344,14 @@ class FileConfig:
                 if self.server.reads is None
                 else {**self.server.reads, **other.server.reads}
             ),
+            # Runtime-observatory knobs merge key-by-key like capacity.
+            profile=(
+                self.server.profile
+                if other.server.profile is None
+                else other.server.profile
+                if self.server.profile is None
+                else {**self.server.profile, **other.server.profile}
+            ),
             # Solver-mesh knobs merge key-by-key like the blocks above.
             solver_mesh=(
                 self.server.solver_mesh if other.server.solver_mesh is None
@@ -384,6 +403,10 @@ class FileConfig:
                 else other.telemetry.slo if (not other.telemetry.slo
                                              or self.telemetry.slo is None)
                 else {**self.telemetry.slo, **other.telemetry.slo}
+            ),
+            lock_watchdog=(
+                other.telemetry.lock_watchdog
+                or self.telemetry.lock_watchdog
             ),
         )
         out.atlas = Atlas(
@@ -549,6 +572,19 @@ def _from_mapping(data: dict) -> FileConfig:
 
                     ReadObserveConfig.parse(dict(v))
                     cfg.server.reads = dict(v)
+                elif k == "profile":
+                    if not isinstance(v, dict):
+                        raise ValueError(
+                            "server.profile must be a mapping")
+                    # Same posture: a typo'd observatory knob fails
+                    # config load (ProfileObserveConfig.parse), not
+                    # start.
+                    from nomad_tpu.profile_observe import (
+                        ProfileObserveConfig,
+                    )
+
+                    ProfileObserveConfig.parse(dict(v))
+                    cfg.server.profile = dict(v)
                 elif k == "solver_mesh":
                     if not isinstance(v, dict):
                         raise ValueError(
@@ -587,6 +623,13 @@ def _from_mapping(data: dict) -> FileConfig:
                     v = {name: float(ms) for name, ms in v.items()}
                     for name, ms in v.items():
                         Objective.parse(name, ms)
+                elif k == "lock_watchdog":
+                    # Parse-time validated: the knob is process-global
+                    # (it patches threading.Lock), so a stringly-typed
+                    # truthy surprise must fail config load.
+                    if not isinstance(v, bool):
+                        raise ValueError(
+                            "telemetry.lock_watchdog must be a boolean")
                 setattr(cfg.telemetry, k, v)
         elif key == "atlas":
             for k, v in value.items():

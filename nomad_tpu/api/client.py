@@ -450,6 +450,24 @@ class AgentApi:
         out, _ = self.client.query("/v1/agent/reads")
         return out
 
+    def profile(self) -> Dict:
+        """Sampling-profiler state (/v1/agent/profile): collapsed-stack
+        aggregates and per-thread-role wall shares from the continuous
+        stack sampler (nomad_tpu/profile_observe.py). For renderable
+        exports hit the endpoint directly with ``?format=collapsed``
+        (flamegraph.pl text) or ``?format=speedscope``."""
+        out, _ = self.client.query("/v1/agent/profile")
+        return out
+
+    def runtime(self) -> Dict:
+        """Runtime economy ledgers (/v1/agent/runtime): the
+        lock-contention table (telemetry{lock_watchdog}) and the
+        byte-economy ledger — mirror buffers by bucket x dtype with the
+        projected 1M-node footprint, bounded rings, state store, RSS
+        (nomad_tpu/profile_observe.py)."""
+        out, _ = self.client.query("/v1/agent/runtime")
+        return out
+
     def traces(self, n: int = 0) -> List[Dict]:
         """Retained trace summaries (/v1/agent/traces), newest first;
         ``n`` limits (0 = all retained)."""

@@ -252,6 +252,8 @@ class HTTPServer:
             (r"^/v1/agent/capacity$", self.agent_capacity),
             (r"^/v1/agent/raft$", self.agent_raft),
             (r"^/v1/agent/reads$", self.agent_reads),
+            (r"^/v1/agent/profile$", self.agent_profile),
+            (r"^/v1/agent/runtime$", self.agent_runtime),
             (r"^/v1/agent/solver$", self.agent_solver),
             (r"^/v1/agent/metrics$", self.agent_metrics),
             (r"^/v1/agent/traces$", self.agent_traces),
@@ -1158,6 +1160,140 @@ class HTTPServer:
                     fresh["staleness_entries"][q],
                     labels={"quantile": q})
 
+    def agent_profile(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Continuous sampling profiler (nomad_tpu/profile_observe.py):
+        collapsed-stack aggregates per thread role, per-subsystem wall
+        shares, and the sampling schedule. Formats: default JSON
+        (profiler view), ``?format=collapsed`` is flamegraph.pl /
+        inferno collapsed-stack text, ``?format=speedscope`` is a
+        https://speedscope.app sampled-profile document — both render
+        the live agent's profile with zero external tooling in the
+        loop."""
+        obs = self._runtime_observatory()
+        if obs is None:
+            raise HTTPCodedError(404, "runtime observatory not running "
+                                      "(no server, or profile "
+                                      "{ enabled = false })")
+        fmt = query.get("format")
+        if fmt == "collapsed":
+            return RawResponse(
+                obs.collapsed().encode(), "text/plain; charset=utf-8"
+            ), None
+        if fmt == "speedscope":
+            return RawResponse(
+                json.dumps(obs.speedscope(), indent=2).encode(),
+                "application/json",
+            ), None
+        return obs.profile_view(), None
+
+    def agent_runtime(self, req, query) -> Tuple[Any, Optional[int]]:
+        """Runtime economy ledgers (nomad_tpu/profile_observe.py): the
+        lock-contention table (when telemetry{lock_watchdog} is on),
+        and the byte-economy ledger — mirror device buffers by
+        bucket x dtype with the measured-per-row 1M-node projection,
+        every bounded ring, state-store footprint, observatory tables,
+        and RSS. The handler refreshes the ledger before answering so
+        the body reflects the process NOW, not the last poll tick.
+        ``?format=prometheus`` serves just the runtime + lock families
+        as text exposition."""
+        obs = self._runtime_observatory()
+        if obs is None:
+            raise HTTPCodedError(404, "runtime observatory not running "
+                                      "(no server, or profile "
+                                      "{ enabled = false })")
+        obs.refresh()
+        if query.get("format") == "prometheus":
+            b = telemetry.PromText()
+            self._profile_prometheus(b)
+            self._lock_prometheus(b)
+            return RawResponse(
+                b.text().encode(), "text/plain; version=0.0.4"
+            ), None
+        return obs.runtime_view(), None
+
+    def _runtime_observatory(self):
+        """The server's runtime observatory, or None (no server /
+        disabled) — same posture as _read_observatory."""
+        server = getattr(self.agent, "server", None)
+        obs = getattr(server, "runtime_observatory", None)
+        if obs is None or not obs.config.enabled:
+            return None
+        return obs
+
+    def _runtime_summary(self) -> Optional[Dict[str, Any]]:
+        obs = self._runtime_observatory()
+        return obs.summary() if obs is not None else None
+
+    def _lock_stats(self) -> Optional[Dict[str, Any]]:
+        """Live lock watchdog books, or None when the
+        telemetry{lock_watchdog} knob is off — installation is
+        process-global, so this reads the module registry rather than
+        any agent field."""
+        wd = telemetry.active_lock_watchdog()
+        return wd.stats() if wd is not None else None
+
+    def _profile_prometheus(self, b: "telemetry.PromText") -> None:
+        """Profiler + byte-economy families: per-role wall shares and
+        sample counts, RSS, tracked bytes, and the mirror ledger with
+        its projected million-row footprint."""
+        obs = self._runtime_observatory()
+        if obs is None:
+            return
+        view = obs.runtime_view()
+        prof = obs.profile_view()["profiler"]
+        b.counter("nomad_profile_samples_total", prof["samples"])
+        b.counter("nomad_profile_stack_overflow_total",
+                  prof["stack_overflow"])
+        for role, books in prof["roles"].items():
+            b.gauge("nomad_profile_role_share", books["wall_share"],
+                    labels={"role": role})
+            b.counter("nomad_profile_role_samples_total",
+                      books["samples"], labels={"role": role})
+        ledger = view["bytes"]
+        rss = ledger.get("rss") or {}
+        if rss.get("current_bytes") is not None:
+            b.gauge("nomad_runtime_rss_bytes", rss["current_bytes"])
+        if rss.get("peak_bytes") is not None:
+            b.gauge("nomad_runtime_rss_peak_bytes", rss["peak_bytes"])
+        b.gauge("nomad_runtime_tracked_bytes",
+                ledger.get("tracked_bytes", 0))
+        mirror = ledger.get("mirror") or {}
+        if "total_bytes" in mirror:
+            b.gauge("nomad_runtime_mirror_bytes", mirror["total_bytes"])
+            b.gauge("nomad_runtime_mirror_rows", mirror.get("rows", 0))
+        if mirror.get("per_row_bytes") is not None:
+            b.gauge("nomad_runtime_mirror_per_row_bytes",
+                    mirror["per_row_bytes"])
+        if mirror.get("projected_1m_bytes") is not None:
+            b.gauge("nomad_runtime_mirror_projected_1m_bytes",
+                    mirror["projected_1m_bytes"])
+        for ring, books in (ledger.get("rings") or {}).items():
+            b.gauge("nomad_runtime_ring_bytes",
+                    books.get("approx_bytes", 0), labels={"ring": ring})
+
+    def _lock_prometheus(self, b: "telemetry.PromText") -> None:
+        """Lock watchdog contention table: acquisition/contention
+        counters, total + quantile wait, and hold p95 per lock id."""
+        stats = self._lock_stats()
+        if not stats:
+            return
+        b.gauge("nomad_lock_watchdog_installed",
+                1 if stats["installed"] else 0)
+        b.gauge("nomad_lock_order_violations", stats["violations"])
+        for row in stats["contention"]:
+            labels = {"lock": row["lock"]}
+            b.counter("nomad_lock_acquisitions_total",
+                      row["acquisitions"], labels=labels)
+            b.counter("nomad_lock_contended_total", row["contended"],
+                      labels=labels)
+            b.counter("nomad_lock_wait_ms_total", row["wait_total_ms"],
+                      labels=labels)
+            for q in ("p50", "p95", "p99"):
+                b.gauge("nomad_lock_wait_ms", row["wait_ms"][q],
+                        labels={"lock": row["lock"], "quantile": q})
+            b.gauge("nomad_lock_hold_ms", row["hold_ms"]["p95"],
+                    labels={"lock": row["lock"], "quantile": "p95"})
+
     def agent_solver(self, req, query) -> Tuple[Any, Optional[int]]:
         """Device-solve efficiency panel (tpu/solver.py SOLVER_PANEL):
         per-solve padding economy, bucket-occupancy histograms,
@@ -1216,6 +1352,8 @@ class HTTPServer:
             self._capacity_prometheus(b)
             self._raft_prometheus(b)
             self._read_prometheus(b)
+            self._profile_prometheus(b)
+            self._lock_prometheus(b)
             _solver_prometheus(b)
             return RawResponse(
                 (telemetry.prometheus_text(sink) + b.text()).encode(),
@@ -1229,6 +1367,8 @@ class HTTPServer:
                 "capacity": self._capacity_summary(),
                 "raft": self._raft_summary(),
                 "reads": self._read_summary(),
+                "runtime": self._runtime_summary(),
+                "locks": self._lock_stats(),
                 "solver_panel": _solver_panel_stats(),
                 "trace": trace.get_tracer().stats()}, None
 

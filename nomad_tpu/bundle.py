@@ -37,6 +37,15 @@ everything the observability stack retains at the moment of capture —
                   hold/serve partition, SSE session books), watch-registry
                   wake economy, and the freshness/staleness distribution —
                   what the follower read path was doing at capture time
+- ``profile``     the continuous sampling profiler
+                  (nomad_tpu/profile_observe.py): collapsed-stack
+                  aggregates and per-thread-role wall shares — where the
+                  process was spending its time at capture
+- ``runtime``     the runtime economy ledgers (same module): the
+                  lock-contention table when telemetry{lock_watchdog}
+                  is on, and the byte-economy ledger — mirror buffers by
+                  bucket x dtype with the projected 1M-node footprint,
+                  bounded rings, state store, RSS
 - ``solver``      the device-solve efficiency panel (tpu/solver.py):
                   padding waste, bucket occupancy, compile attribution,
                   device-time-per-placement
@@ -72,8 +81,8 @@ BUNDLE_FORMAT = "nomad-tpu-debug-bundle/v1"
 BUNDLE_SECTIONS = (
     "format", "captured_at", "metrics", "traces", "events", "config",
     "faults", "breaker", "mirror", "plan_pipeline", "slo", "admission",
-    "express", "capacity", "raft", "reads", "solver", "timelines",
-    "nomadlint", "threads",
+    "express", "capacity", "raft", "reads", "profile", "runtime",
+    "solver", "timelines", "nomadlint", "threads",
 )
 
 # Every `python -m tools.nomadlint` run writes its full report here; the
@@ -263,6 +272,34 @@ def _reads_section(agent) -> Optional[Dict[str, Any]]:
     return obs.snapshot()
 
 
+def _runtime_observatory(agent):
+    server = getattr(agent, "server", None) if agent is not None else None
+    obs = getattr(server, "runtime_observatory", None)
+    if obs is None or not obs.config.enabled:
+        return None
+    return obs
+
+
+def _profile_section(agent) -> Optional[Dict[str, Any]]:
+    """Sampling-profiler view (nomad_tpu/profile_observe.py): the
+    collapsed-stack aggregates and per-role wall shares at capture time
+    — a bundle attached to a "the agent was slow" report carries its own
+    profile. None without a server or with the observatory disabled."""
+    obs = _runtime_observatory(agent)
+    return obs.profile_view() if obs is not None else None
+
+
+def _runtime_section(agent) -> Optional[Dict[str, Any]]:
+    """Runtime economy ledgers (nomad_tpu/profile_observe.py): lock
+    contention + the byte-economy ledger, refreshed at capture so the
+    footprint numbers describe the process NOW."""
+    obs = _runtime_observatory(agent)
+    if obs is None:
+        return None
+    obs.refresh()
+    return obs.runtime_view()
+
+
 def _solver_section() -> Dict[str, Any]:
     """Device-solve efficiency panel (tpu/solver.py SOLVER_PANEL):
     padding economy, bucket occupancy, compile attribution — next to the
@@ -331,6 +368,8 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         "capacity": None,
         "raft": None,
         "reads": None,
+        "profile": None,
+        "runtime": None,
         "solver": None,
         "timelines": [],
         "nomadlint": None,
@@ -350,6 +389,8 @@ def collect(agent=None, last_events: int = 512) -> Dict[str, Any]:
         ("capacity", lambda: _capacity_section(agent)),
         ("raft", lambda: _raft_section(agent)),
         ("reads", lambda: _reads_section(agent)),
+        ("profile", lambda: _profile_section(agent)),
+        ("runtime", lambda: _runtime_section(agent)),
         ("solver", _solver_section),
         ("timelines", lambda: _timelines_section(agent, last_events)),
         ("nomadlint", _nomadlint_section),

@@ -62,6 +62,10 @@ Read       ReadSnapshot (fixed key "reads"; OBSERVER topic like Capacity
            — the read-path observatory's periodic serving-attribution/
            watch-economy/freshness snapshots,
            nomad_tpu/read_observe.py)
+Runtime    RuntimeSnapshot (fixed key "runtime"; OBSERVER topic like
+           Capacity — the runtime self-observatory's periodic
+           profiler/lock-contention/byte-economy snapshots,
+           nomad_tpu/profile_observe.py)
 =========  ==============================================================
 
 Blocking consumption reuses the state store's watch registry
@@ -91,7 +95,7 @@ ITEM_ANY: WatchItem = ("events", "_any_")
 # construction: how many ticks a run's wall time fits is scheduling
 # noise, and an observer being ON vs OFF must be digest-invariant — the
 # observatory's decision-invariance proof depends on exactly that.
-OBSERVER_TOPICS = frozenset({"Capacity", "Raft", "Read"})
+OBSERVER_TOPICS = frozenset({"Capacity", "Raft", "Read", "Runtime"})
 
 
 def item_topic(topic: str) -> WatchItem:

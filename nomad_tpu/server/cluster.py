@@ -194,6 +194,7 @@ class ClusterServer(Server):
         self.express_lane.start()
         self.capacity_accountant.start()
         self.raft_observatory.start()
+        self.runtime_observatory.start()
         from nomad_tpu.server.worker import Worker
 
         for i in range(self.config.scheduler_workers):

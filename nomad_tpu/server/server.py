@@ -156,6 +156,17 @@ class ServerConfig:
     # writes plain books, nothing feeds back — pinned by the read-storm
     # contrast arm).
     reads: Optional[Dict] = None
+    # Runtime self-observatory spec (ProfileObserveConfig.parse mapping,
+    # nomad_tpu/profile_observe.py): the read-only observer behind
+    # /v1/agent/profile and /v1/agent/runtime — continuous stack-
+    # sampling profiler (seeded-jittered cadence, thread-role wall
+    # shares, flamegraph exports), lock-contention table (read from the
+    # installed telemetry.LockWatchdog), and the byte-economy ledger
+    # with the measured-per-row 1M-node mirror projection. None =
+    # defaults (enabled; decision-invariant by construction: it samples
+    # frames and reads array metadata, nothing feeds back — pinned by
+    # the steady-10k profiler-off contrast arm).
+    profile: Optional[Dict] = None
     # Solver mesh spec (SolverMeshConfig.parse mapping,
     # nomad_tpu/parallel/mesh.py): shard the node axis of every device
     # solve (and the mirror's padded buffers) over a JAX device mesh —
@@ -211,6 +222,9 @@ class ServerConfig:
         from nomad_tpu.read_observe import ReadObserveConfig
 
         self.reads_config = ReadObserveConfig.parse(self.reads)
+        from nomad_tpu.profile_observe import ProfileObserveConfig
+
+        self.profile_config = ProfileObserveConfig.parse(self.profile)
         from nomad_tpu.parallel.mesh import SolverMeshConfig
 
         self.solver_mesh_config = SolverMeshConfig.parse(self.solver_mesh)
@@ -349,8 +363,56 @@ class Server:
             self.config.reads_config,
             events=self.fsm.events,
         )
+        # The runtime self-observatory (nomad_tpu/profile_observe.py):
+        # stack-sampling profiler + lock-contention table + byte-economy
+        # ledger. Same OBS001 composition-root contract. The ring/table
+        # getters re-read the live handles per poll so restarts and
+        # snapshot installs never leave it holding a dead object.
+        from nomad_tpu.profile_observe import RuntimeObservatory
+
+        self.runtime_observatory = RuntimeObservatory(
+            self.config.profile_config,
+            events=self.fsm.events,
+            store_getter=lambda: self.fsm.state,
+            rings_getter=self._runtime_rings,
+            tables_getter=self._runtime_tables,
+        )
         self._periodic_stop = threading.Event()
         self._started = False
+
+    def _runtime_rings(self):
+        """The bounded rings the byte-economy ledger accounts: event
+        broker, trace ring, admission decision ring, express
+        pending/outcome queues, plan-pipeline commit log. getattr-
+        guarded — a ring that doesn't exist on this composition simply
+        doesn't appear in the ledger."""
+        from nomad_tpu import trace
+
+        return {
+            "events": getattr(self.fsm.events, "_events", None),
+            "traces": getattr(trace.get_tracer(), "_traces", None),
+            "admission_decisions": getattr(
+                self.admission, "_decisions", None),
+            "express_pending": getattr(
+                self.express_lane, "_pending", None),
+            "express_outcomes": getattr(
+                self.express_lane, "_outcomes", None),
+            "plan_commit_log": getattr(
+                self.plan_applier, "_commit_log", None),
+        }
+
+    def _runtime_tables(self):
+        """The sibling observatories' in-memory books, approximated via
+        their summary views (deep-sized by the ledger) — the 'what does
+        watching cost' line of the byte economy."""
+        out = {}
+        if self.config.capacity_config.enabled:
+            out["capacity"] = self.capacity_accountant.snapshot()
+        if self.config.raft_observe_config.enabled:
+            out["raft_observe"] = self.raft_observatory.snapshot()
+        if self.config.reads_config.enabled:
+            out["read_observe"] = self.read_observatory.snapshot()
+        return out
 
     @property
     def plan_pipeline(self) -> PlanPipeline:
@@ -378,6 +440,7 @@ class Server:
         self.capacity_accountant.start()
         self.raft_observatory.start()
         self.read_observatory.start()
+        self.runtime_observatory.start()
         self.restore_eval_broker()
         for i in range(self.config.scheduler_workers):
             worker = Worker(self, i)
@@ -466,6 +529,7 @@ class Server:
         self.capacity_accountant.stop()
         self.raft_observatory.stop()
         self.read_observatory.stop()
+        self.runtime_observatory.stop()
         if self.slo_monitor is not None:
             self.slo_monitor.stop()
         self.plan_applier.stop()
@@ -1140,6 +1204,8 @@ class Server:
                              else None),
             "reads": (self.read_observatory.summary()
                       if self.config.reads_config.enabled else None),
+            "runtime": (self.runtime_observatory.summary()
+                        if self.config.profile_config.enabled else None),
         }
 
     @staticmethod

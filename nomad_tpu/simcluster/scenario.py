@@ -47,7 +47,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from nomad_tpu import faults, structs
+from nomad_tpu import faults, structs, telemetry
 from nomad_tpu.api.codec import to_dict
 from nomad_tpu.rpc import RemoteError
 from nomad_tpu.server import ServerConfig
@@ -155,6 +155,13 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
                 ),
             ],
             quiesce_timeout=300.0, ack_cap=300,
+            # Profiler-off contrast arm: the runtime self-observatory
+            # (continuous stack sampler + byte ledger) on vs off must
+            # leave the canonical event digest byte-identical — the
+            # read-storm posture, applied to the process's own
+            # profiler.
+            contrast_overrides={"profile": {"enabled": False}},
+            contrast_digest_invariant=True,
             description="the north-star control-plane scale: 10k live "
                         "nodes, 24 service jobs x420 tasks over ~18s "
                         "(10,080 placements) under steady node-refresh "
@@ -1418,6 +1425,28 @@ class ScenarioRunner:
         if not self.attribution_layer:
             cfg_kwargs["slo_objectives"] = {}
         self._cfg_kwargs = cfg_kwargs
+        # Lock-contention attribution for the run: install the timing
+        # watchdog (telemetry.LockWatchdog with the statically proven
+        # closure — same posture as the agent's telemetry{lock_watchdog}
+        # knob) so the banked profile section carries the ranked
+        # contention table. Timing-only: decisions cannot observe it,
+        # so the canonical digest is unaffected. Skipped in the
+        # profiler-off contrast arm and the attribution-off overhead arm.
+        self._watchdog = None
+        prof_enabled = (cfg_kwargs.get("profile") or {}).get("enabled", True)
+        if self.attribution_layer and prof_enabled:
+            try:
+                from tools.nomadlint import lockorder
+                from tools.nomadlint.project import Project
+
+                an = lockorder.analyze(Project())
+                wd = telemetry.LockWatchdog(
+                    order=an.order, sites=an.sites(), closure=an.closure())
+                self._watchdog = wd.install()
+            except Exception as e:
+                self.logger.warning(
+                    "simcluster: lock watchdog unavailable "
+                    "(tools.nomadlint analysis failed): %s", e)
         if spec.durable_raft and self._data_dir is None:
             import tempfile
 
@@ -1746,6 +1775,13 @@ class ScenarioRunner:
             self._stop.set()
             self._stop_watcher()
             tracer.enabled = tracing_was
+            if self._watchdog is not None:
+                try:
+                    self._watchdog.uninstall()
+                except Exception:
+                    self.logger.exception(
+                        "simcluster: lock watchdog uninstall failed")
+                self._watchdog = None
             if spec.faults_spec is not None:
                 faults.get_registry().clear()
             if self._http is not None:
@@ -2016,6 +2052,7 @@ class ScenarioRunner:
         artifact["capacity"] = self._capacity_section(srv)
         artifact["raft"] = self._raft_section(srv)
         artifact["reads"] = self._reads_section(srv)
+        artifact["profile"] = self._profile_section(srv)
         artifact["solver_panel"] = self._solver_panel_section()
         if self.attribution_layer:
             from nomad_tpu import lifecycle, slo
@@ -2155,6 +2192,21 @@ class ScenarioRunner:
         if fleet:
             out["fleet"] = fleet
         return out
+
+    def _profile_section(self, srv) -> Dict:
+        """The runtime self-observatory's run report
+        (nomad_tpu/profile_observe.py): per-thread-role wall shares from
+        the continuous stack sampler, the lock-contention table when the
+        watchdog is installed, and the byte-economy ledger — mirror
+        buffers by bucket x dtype with the measured-per-row projected
+        1M-node footprint, bounded rings, state store, RSS.
+        {"enabled": False} in the profiler-off contrast arm (presence
+        keeps the artifact schema stable across arms)."""
+        obs = getattr(srv, "runtime_observatory", None)
+        if obs is None or not srv.config.profile_config.enabled:
+            return {"enabled": False}
+        obs.refresh()
+        return {"enabled": True, **obs.snapshot()}
 
     def _fleet_summary(self) -> Dict:
         """Sum the per-reader client books by population (pollers/
@@ -2327,6 +2379,21 @@ def run_scenario(name: str, seed: int = 42, out_path: Optional[str] = None,
             )
             artifact["contrast"]["capacity"] = full.get("capacity")
             artifact["contrast"]["reads"] = full.get("reads")
+            artifact["contrast"]["profile"] = full.get("profile")
+        if ((spec.contrast_overrides.get("profile") or {})
+                .get("enabled") is False):
+            # Profiler-overhead verdict: the sampler walking
+            # sys._current_frames() 20x/s must not move the write path.
+            # Same-seed arms, so the plan populations are identical
+            # work; the p50 delta IS the profiler's cost.
+            p_on = (artifact.get("plan_latency_ms") or {}).get("p50_ms")
+            p_off = (full.get("plan_latency_ms") or {}).get("p50_ms")
+            if p_on and p_off:
+                artifact["contrast"]["profiler_overhead"] = {
+                    "plan_p50_ms_profiled": p_on,
+                    "plan_p50_ms_disabled": p_off,
+                    "overhead_fraction": round(p_on / p_off - 1.0, 4),
+                }
     if out_path:
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=2, sort_keys=True)

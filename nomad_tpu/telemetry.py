@@ -738,12 +738,35 @@ class LockOrderViolation:
                 f"acquired={self.acquired!r}, thread={self.thread!r})")
 
 
+class _LockTiming:
+    """Per-lock-id contention/hold books. Mutated lock-free from every
+    acquiring thread (the watchdog deliberately owns no lock — it would
+    join the very graph it checks): counter increments and reservoir
+    ingests are CPython-atomic enough that a rare racing pair costs one
+    sample, never a crash — approximate books, honestly so."""
+
+    __slots__ = ("acquisitions", "contended", "wait_total_s", "wait",
+                 "hold")
+
+    def __init__(self):
+        self.acquisitions = 0
+        self.contended = 0
+        self.wait_total_s = 0.0
+        self.wait = AggregateSample()   # contended wait only, ms
+        self.hold = AggregateSample()   # every timed hold, ms
+
+
 class _WatchedLock:
     """Transparent wrapper around a threading lock that reports
     acquisitions/releases to a LockWatchdog under one canonical lock id.
     Reentrant acquires (RLocks, two instances of one lock class) only
     report the 0->1 transition, mirroring the static model where
-    instances of a class share one graph node."""
+    instances of a class share one graph node.
+
+    Timing rides the same seam: a free lock takes the try-acquire fast
+    path (no clock reads); only an actually-contended acquisition pays
+    two monotonic stamps, so the books attribute WAIT precisely where
+    it happens."""
 
     __slots__ = ("_nl_inner", "_nl_wd", "_nl_id")
 
@@ -753,9 +776,18 @@ class _WatchedLock:
         self._nl_id = lock_id
 
     def acquire(self, *args, **kwargs):
+        blocking = args[0] if args else kwargs.get("blocking", True)
+        # Uncontended fast path (correct for RLock reentry too).
+        if self._nl_inner.acquire(blocking=False):
+            self._nl_wd._on_acquire(self._nl_id)
+            return True
+        if not blocking:
+            return False
+        t0 = time.monotonic()
         got = self._nl_inner.acquire(*args, **kwargs)
         if got:
-            self._nl_wd._on_acquire(self._nl_id)
+            self._nl_wd._on_acquire(
+                self._nl_id, wait_s=time.monotonic() - t0, contended=True)
         return got
 
     def release(self):
@@ -783,7 +815,8 @@ class _WatchedLock:
 
 
 class LockWatchdog:
-    """Debug-mode runtime assertion of the nomadlint lock-order pass.
+    """Runtime validation + contention attribution of the nomadlint
+    lock-order pass.
 
     ``install()`` patches ``threading.Lock``/``threading.RLock`` so that
     every lock constructed at a KNOWN construction site (the ``sites``
@@ -797,14 +830,34 @@ class LockWatchdog:
     ``violations == []`` after driving a real workload, which validates
     the statically computed order against real interleavings.
 
-    Test-only by design: wrapping costs a dict lookup + list append per
-    acquisition, and installation is process-global. Use as a context
-    manager around server construction + workload."""
+    The same wrappers keep per-lock-site TIMING books: contended-
+    acquisition counts, wait p50/p95/p99, and hold-time distributions —
+    ``stats()`` surfaces them as a contention table ranked by total
+    wait (the runtime observatory's lock ledger, the group-commit
+    arc's evidence).
 
-    def __init__(self, order, sites, repo: Optional[str] = None):
+    Two ways in: tests use it as a context manager around server
+    construction + workload; agents opt in at runtime via the
+    ``telemetry { lock_watchdog = true }`` config knob (default off —
+    wrapping costs a try-acquire + dict lookup per acquisition, and
+    installation is process-global). The installed instance is
+    published via :func:`active_lock_watchdog` so read-only observers
+    can find the books without any plumbing through decision paths."""
+
+    def __init__(self, order, sites, repo: Optional[str] = None,
+                 closure=None):
         import os
 
         self._rank = {lock_id: i for i, lock_id in enumerate(order)}
+        # With the static edge CLOSURE (analyze().closure()), a violation
+        # is an observed inversion of a statically proven edge — a real
+        # potential deadlock. Without it, fall back to comparing topo
+        # ranks, which also flags pairs the analysis never constrained
+        # (their relative order is a tie-break artifact): stricter, and
+        # right for tests that drive one subsystem, but too noisy for the
+        # whole-agent runtime knob.
+        self._closure = ({tuple(e) for e in closure}
+                         if closure is not None else None)
         self._sites = {tuple(k): v for k, v in dict(sites).items()}
         self._repo = os.path.abspath(
             repo
@@ -816,22 +869,33 @@ class LockWatchdog:
         self.violations: List[LockOrderViolation] = []
         self._observed: set = set()
         self._orig = None
+        # Timing books, pre-created for every statically known lock so
+        # the hot path never mutates the dict; watch()-registered ids
+        # outside the order join via atomic setdefault.
+        self._books: Dict[str, _LockTiming] = {
+            lock_id: _LockTiming() for lock_id in order
+        }
 
     # -- wiring --------------------------------------------------------------
 
     def install(self) -> "LockWatchdog":
+        global _ACTIVE_LOCK_WATCHDOG
         if self._orig is not None:
             raise RuntimeError("LockWatchdog already installed")
         self._orig = (threading.Lock, threading.RLock)
         threading.Lock = self._factory(self._orig[0])  # type: ignore
         threading.RLock = self._factory(self._orig[1])  # type: ignore
+        _ACTIVE_LOCK_WATCHDOG = self
         return self
 
     def uninstall(self) -> None:
+        global _ACTIVE_LOCK_WATCHDOG
         if self._orig is None:
             return
         threading.Lock, threading.RLock = self._orig  # type: ignore
         self._orig = None
+        if _ACTIVE_LOCK_WATCHDOG is self:
+            _ACTIVE_LOCK_WATCHDOG = None
 
     def __enter__(self) -> "LockWatchdog":
         return self.install()
@@ -869,21 +933,34 @@ class LockWatchdog:
             held = self._tls.held = []
         return held
 
-    def _on_acquire(self, lock_id: str) -> None:
+    def _on_acquire(self, lock_id: str, wait_s: float = 0.0,
+                    contended: bool = False) -> None:
         held = self._held()
         rank = self._rank.get(lock_id)
-        for h in held:
+        for h, _t0 in held:
             if h == lock_id:
                 continue  # instance identity is invisible statically
             self._observed.add((h, lock_id))
-            hr = self._rank.get(h)
-            if hr is not None and rank is not None and hr > rank:
+            if self._closure is not None:
+                bad = (lock_id, h) in self._closure
+            else:
+                hr = self._rank.get(h)
+                bad = hr is not None and rank is not None and hr > rank
+            if bad:
                 self.violations.append(LockOrderViolation(
                     held=h, acquired=lock_id,
                     thread=threading.current_thread().name,
                     stack="".join(traceback.format_stack(limit=12)),
                 ))
-        held.append(lock_id)
+        held.append((lock_id, time.monotonic()))
+        books = self._books.get(lock_id)
+        if books is None:
+            books = self._books.setdefault(lock_id, _LockTiming())
+        books.acquisitions += 1
+        if contended:
+            books.contended += 1
+            books.wait_total_s += wait_s
+            books.wait.ingest(wait_s * 1000.0)
 
     def _on_release(self, lock_id: str) -> None:
         held = getattr(self._tls, "held", None)
@@ -891,11 +968,52 @@ class LockWatchdog:
             # Remove the most recent entry for this id: releases are
             # typically LIFO, but out-of-order release is legal.
             for i in range(len(held) - 1, -1, -1):
-                if held[i] == lock_id:
+                if held[i][0] == lock_id:
+                    hold_s = time.monotonic() - held[i][1]
                     del held[i]
+                    books = self._books.get(lock_id)
+                    if books is not None:
+                        books.hold.ingest(hold_s * 1000.0)
                     break
 
     # -- results -------------------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        """The contention table, ranked by total wait: the runtime
+        observatory's lock ledger and the ``nomad_lock_*`` prom
+        families. Only ids that were actually acquired appear."""
+        rows = []
+        for lock_id, t in sorted(self._books.items()):
+            if not t.acquisitions:
+                continue
+            rows.append({
+                "lock": lock_id,
+                "acquisitions": t.acquisitions,
+                "contended": t.contended,
+                "contention_rate": round(
+                    t.contended / t.acquisitions, 6),
+                "wait_total_ms": round(t.wait_total_s * 1000.0, 3),
+                "wait_ms": {
+                    "mean": round(t.wait.mean, 4),
+                    "max": round(t.wait.max, 4),
+                    **{k: round(v, 4)
+                       for k, v in t.wait.quantiles().items()},
+                },
+                "hold_ms": {
+                    "mean": round(t.hold.mean, 4),
+                    "max": round(t.hold.max, 4),
+                    **{k: round(v, 4)
+                       for k, v in t.hold.quantiles().items()},
+                },
+            })
+        rows.sort(key=lambda r: (-r["wait_total_ms"], r["lock"]))
+        return {
+            "installed": self._orig is not None,
+            "locks_tracked": sum(
+                1 for t in self._books.values() if t.acquisitions),
+            "violations": len(self.violations),
+            "contention": rows,
+        }
 
     def observed_edges(self) -> set:
         """(held, acquired) pairs actually exercised while installed."""
@@ -908,3 +1026,14 @@ class LockWatchdog:
             raise AssertionError(
                 "lock-order violations observed:\n" + "\n".join(lines)
             )
+
+
+# The currently installed watchdog (None when off): read-only surfaces
+# (the runtime observatory, /v1/agent/metrics) discover the books here
+# instead of having an instance plumbed through decision-path
+# constructors.
+_ACTIVE_LOCK_WATCHDOG: Optional[LockWatchdog] = None
+
+
+def active_lock_watchdog() -> Optional[LockWatchdog]:
+    return _ACTIVE_LOCK_WATCHDOG

@@ -249,6 +249,51 @@ class NodeMirror:
         self._express_usage: Optional[Tuple] = None
         self._express_roll_lock = threading.Lock()
 
+    # -- byte economy ------------------------------------------------------
+
+    def byte_ledger(self) -> dict:
+        """Per-buffer byte accounting of this mirror (the runtime
+        observatory's mirror ledger): named device/host buffers with
+        dtype and nbytes, plus the mask/usage caches summed. Reads
+        array metadata only — no device sync, no transfer."""
+        buffers = {}
+        for name in ("total", "totals_np", "reserved_np", "sched_cap",
+                     "bw_avail", "bw_reserved", "base_mask"):
+            arr = getattr(self, name, None)
+            if arr is None:
+                continue
+            buffers[name] = {
+                "dtype": str(arr.dtype),
+                "nbytes": int(arr.nbytes),
+            }
+
+        def _arr_bytes(v) -> int:
+            nb = getattr(v, "nbytes", None)
+            if nb is not None:
+                return int(nb)
+            if isinstance(v, (tuple, list)):
+                return sum(_arr_bytes(x) for x in v)
+            return 0
+
+        cache_bytes = 0
+        for cache_name in ("_driver_mask_cache", "_constraint_mask_cache",
+                           "_target_col_cache", "_target_code_cache",
+                           "_device_mask_cache"):
+            cache = getattr(self, cache_name, None) or {}
+            cache_bytes += sum(_arr_bytes(v) for v in cache.values())
+        for extra in ("_clean_usage_dev", "_base_usage", "_express_usage",
+                      "_id_array"):
+            cache_bytes += _arr_bytes(getattr(self, extra, None))
+        buffer_bytes = sum(b["nbytes"] for b in buffers.values())
+        return {
+            "rows": self.n,
+            "padded": self.padded,
+            "buffers": buffers,
+            "buffer_bytes": buffer_bytes,
+            "cache_bytes": cache_bytes,
+            "total_bytes": buffer_bytes + cache_bytes,
+        }
+
     # -- delta maintenance -------------------------------------------------
 
     def apply_delta(self, changes, state, datacenters: List[str]):
@@ -1266,6 +1311,52 @@ class MirrorCache:
                     m.padded for _n, m in self._entries.values()
                 }),
             }
+
+    def byte_ledger(self) -> dict:
+        """The cache-wide byte economy: resident mirrors' buffers
+        grouped by padding bucket × dtype, the MEASURED per-padded-row
+        cost, and the projected 1M-node footprint — per_row_bytes ×
+        bucket(1_000_000) rows, i.e. what ROADMAP item 7's cell would
+        pin in memory at today's row shape (the fit-check a paper
+        number can't answer; a measured one can). Projection is None
+        until a mirror is resident (no rows, no measurement)."""
+        from nomad_tpu.ops.binpack import bucket
+
+        with self._lock:
+            mirrors = [m for _n, m in self._entries.values()]
+        by_bucket: dict = {}
+        buffer_bytes = 0
+        cache_bytes = 0
+        padded_rows = 0
+        live_rows = 0
+        for m in mirrors:
+            ledger = m.byte_ledger()
+            buffer_bytes += ledger["buffer_bytes"]
+            cache_bytes += ledger["cache_bytes"]
+            padded_rows += ledger["padded"]
+            live_rows += ledger["rows"]
+            row = by_bucket.setdefault(ledger["padded"], {})
+            for buf in ledger["buffers"].values():
+                row[buf["dtype"]] = row.get(buf["dtype"], 0) + buf["nbytes"]
+        total = buffer_bytes + cache_bytes
+        per_row = (total / padded_rows) if padded_rows else None
+        return {
+            "mirrors": len(mirrors),
+            "rows": live_rows,
+            "padded_rows": padded_rows,
+            "by_bucket_dtype": {
+                str(b): dict(sorted(row.items()))
+                for b, row in sorted(by_bucket.items())
+            },
+            "buffer_bytes": buffer_bytes,
+            "cache_bytes": cache_bytes,
+            "total_bytes": total,
+            "per_row_bytes": round(per_row, 2) if per_row else None,
+            "projected_1m_rows": bucket(1_000_000) if per_row else None,
+            "projected_1m_bytes": (
+                int(per_row * bucket(1_000_000)) if per_row else None
+            ),
+        }
 
 
 # Process-wide cache shared by every TPU scheduler instance (the workers

@@ -512,6 +512,63 @@ def read_gate(new_artifact: dict, baseline_artifact: dict | None,
     return {"ok": ok, "tolerance": tolerance, "checks": checks}
 
 
+# Runtime-gate tolerance: RSS rides allocator noise and per-row mirror
+# bytes only move when buffer/dtype layout changes, so the bar is loose
+# — it exists to catch a real footprint regression (a new per-row
+# buffer, a float64 slip, a leak past the bounded rings), not GC
+# timing. Lock-wait p95 is scheduler-noisy at sim scale for the same
+# reason.
+RUNTIME_GATE_TOLERANCE = 0.5
+
+
+def runtime_gate(new_artifact: dict, baseline_artifact: dict | None,
+                 tolerance: float = RUNTIME_GATE_TOLERANCE) -> dict | None:
+    """Gate a family's runtime economy (the runtime self-observatory's
+    artifact section, nomad_tpu/profile_observe.py). Scoped: None when
+    the artifact's profile section is absent or disabled. RELATIVE
+    newest-vs-previous when the prior bank also carries an enabled
+    profile section: peak RSS, the mirror's measured bytes-per-row (the
+    1M-node projection's slope), and the worst per-site lock-wait p95
+    must not grow more than ``tolerance``. First-round families report
+    the observed values without failing."""
+    prof = new_artifact.get("profile") or {}
+    if not prof.get("enabled"):
+        return None
+
+    def rss_peak(p: dict):
+        return ((p.get("bytes") or {}).get("rss") or {}).get("peak_bytes")
+
+    def mirror_per_row(p: dict):
+        return ((p.get("bytes") or {}).get("mirror")
+                or {}).get("per_row_bytes")
+
+    def worst_lock_wait_p95(p: dict):
+        rows = (p.get("locks") or {}).get("contention") or []
+        vals = [(r.get("wait_ms") or {}).get("p95") for r in rows]
+        vals = [v for v in vals if v is not None]
+        return max(vals) if vals else None
+
+    base_prof = (baseline_artifact or {}).get("profile") or {}
+    if not base_prof.get("enabled"):
+        base_prof = {}
+    checks, ok = [], True
+    for name, fn in (
+        ("rss_peak_bytes", rss_peak),
+        ("mirror_per_row_bytes", mirror_per_row),
+        ("lock_wait_p95_ms", worst_lock_wait_p95),
+    ):
+        value = fn(prof)
+        if value is None:
+            continue
+        baseline = fn(base_prof) if base_prof else None
+        regressed = (baseline is not None and baseline > 0
+                     and value > baseline * (1.0 + tolerance))
+        checks.append({"check": name, "value": value,
+                       "baseline": baseline, "regressed": regressed})
+        ok = ok and not regressed
+    return {"ok": ok, "tolerance": tolerance, "checks": checks}
+
+
 # Chaos-gate tolerance: rejoin and expiry-replacement times ride TTL
 # jitter, snapshot transfer and re-election noise, so the newest-vs-
 # previous bar is deliberately loose — it exists to catch a real
@@ -584,6 +641,7 @@ def slo_gate_scan(log=log) -> bool:
                 solver_verdict = None
                 recovery_verdict = recovery_gate(new, None)
                 read_verdict = read_gate(new, None)
+                runtime_verdict = runtime_gate(new, None)
                 chaos_verdict = chaos_gate(new, None)
             else:
                 with open(base_path) as f:
@@ -592,6 +650,7 @@ def slo_gate_scan(log=log) -> bool:
                 solver_verdict = solver_gate(new, base)
                 recovery_verdict = recovery_gate(new, base)
                 read_verdict = read_gate(new, base)
+                runtime_verdict = runtime_gate(new, base)
                 chaos_verdict = chaos_gate(new, base)
         except (OSError, ValueError, KeyError) as e:
             log("slo-gate-error", family=fam, error=str(e))
@@ -621,6 +680,11 @@ def slo_gate_scan(log=log) -> bool:
                 regressed=[c["check"] for c in read_verdict["checks"]
                            if c["regressed"]])
             ok = ok and read_verdict["ok"]
+        if runtime_verdict is not None:
+            log("runtime-gate", family=fam, ok=runtime_verdict["ok"],
+                regressed=[c["check"] for c in runtime_verdict["checks"]
+                           if c["regressed"]])
+            ok = ok and runtime_verdict["ok"]
         if chaos_verdict is not None:
             log("chaos-gate", family=fam, ok=chaos_verdict["ok"],
                 regressed=[c["check"] for c in chaos_verdict["checks"]

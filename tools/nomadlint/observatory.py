@@ -1,8 +1,9 @@
 """Observatory pass (OBS001): the observatories are read-only.
 
 ``nomad_tpu/capacity.py`` (the capacity observatory),
-``nomad_tpu/raft_observe.py`` (the raft & recovery observatory) and
-``nomad_tpu/read_observe.py`` (the read-path observatory) observe
+``nomad_tpu/raft_observe.py`` (the raft & recovery observatory),
+``nomad_tpu/read_observe.py`` (the read-path observatory) and
+``nomad_tpu/profile_observe.py`` (the runtime self-observatory) observe
 cluster state through change logs and plain-data books, and must stay
 invisible to every decision path — the decision-invariance proofs (the
 churn-fragmentation observatory-off contrast arm's digest equality; the
@@ -56,7 +57,7 @@ OBSERVATORY_SCOPE = (
 COMPOSITION_ROOTS = ("nomad_tpu/server/server.py",)
 
 TARGET_MODULES = ("nomad_tpu.capacity", "nomad_tpu.raft_observe",
-                  "nomad_tpu.read_observe")
+                  "nomad_tpu.read_observe", "nomad_tpu.profile_observe")
 _TARGET_LEAVES = tuple(m.rsplit(".", 1)[1] for m in TARGET_MODULES)
 
 
