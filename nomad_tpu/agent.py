@@ -59,6 +59,11 @@ class AgentConfig:
     # Read-path observatory spec (nomad_tpu/read_observe.py):
     # None = defaults (enabled).
     reads: Optional[Dict] = None
+    # Consistency-lane read plane spec (nomad_tpu/server/read_path.py):
+    # stale-lane bound + linearizable read-index timeouts. None =
+    # defaults (enabled); {"enabled": False} pins every read to the
+    # pre-lane local-serving posture.
+    read_path: Optional[Dict] = None
     # Runtime self-observatory spec (nomad_tpu/profile_observe.py):
     # sampling profiler + byte-economy ledger. None = defaults (enabled).
     profile: Optional[Dict] = None
@@ -167,6 +172,8 @@ class AgentConfig:
                           if fc.server.raft_observe is not None else None),
             reads=(dict(fc.server.reads)
                    if fc.server.reads is not None else None),
+            read_path=(dict(fc.server.read_path)
+                       if fc.server.read_path is not None else None),
             profile=(dict(fc.server.profile)
                      if fc.server.profile is not None else None),
             solver_mesh=(dict(fc.server.solver_mesh)
@@ -303,6 +310,8 @@ class Agent:
                           if self.config.raft_observe is not None else None),
             reads=(dict(self.config.reads)
                    if self.config.reads is not None else None),
+            read_path=(dict(self.config.read_path)
+                       if self.config.read_path is not None else None),
             profile=(dict(self.config.profile)
                      if self.config.profile is not None else None),
             solver_mesh=(dict(self.config.solver_mesh)

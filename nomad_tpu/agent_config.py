@@ -114,6 +114,11 @@ class ServerBlock:
     # ``reads { }`` sub-block tunes the read-only observer behind
     # /v1/agent/reads (poll/event cadence). None = defaults (enabled).
     reads: Optional[Dict[str, object]] = None
+    # Consistency-lane read plane (nomad_tpu/server/read_path.py): the
+    # ``read_path { }`` sub-block tunes the SERVING-path lane machinery
+    # (stale-lane default bound, linearizable read-index/apply-wait
+    # timeouts). None = defaults (enabled).
+    read_path: Optional[Dict[str, object]] = None
     # Runtime self-observatory (nomad_tpu/profile_observe.py): the
     # ``profile { }`` sub-block tunes the read-only observer behind
     # /v1/agent/profile and /v1/agent/runtime (sampling cadence/jitter/
@@ -344,6 +349,14 @@ class FileConfig:
                 if self.server.reads is None
                 else {**self.server.reads, **other.server.reads}
             ),
+            # Read-plane knobs merge key-by-key like the blocks above.
+            read_path=(
+                self.server.read_path
+                if other.server.read_path is None
+                else other.server.read_path
+                if self.server.read_path is None
+                else {**self.server.read_path, **other.server.read_path}
+            ),
             # Runtime-observatory knobs merge key-by-key like capacity.
             profile=(
                 self.server.profile
@@ -572,6 +585,16 @@ def _from_mapping(data: dict) -> FileConfig:
 
                     ReadObserveConfig.parse(dict(v))
                     cfg.server.reads = dict(v)
+                elif k == "read_path":
+                    if not isinstance(v, dict):
+                        raise ValueError(
+                            "server.read_path must be a mapping")
+                    # Same posture: a typo'd lane knob fails config
+                    # load (ReadPathConfig.parse), not first request.
+                    from nomad_tpu.server.read_path import ReadPathConfig
+
+                    ReadPathConfig.parse(dict(v))
+                    cfg.server.read_path = dict(v)
                 elif k == "profile":
                     if not isinstance(v, dict):
                         raise ValueError(

@@ -21,6 +21,7 @@ from nomad_tpu.structs import (
     MAX_QUERY_TIME,
     MAX_QUERY_TIME_PAD,
     REJECT_RATE_LIMITED,
+    REJECT_STALE_BOUND,
     Allocation,
     Evaluation,
     Job,
@@ -78,6 +79,14 @@ class QueryOptions:
 
     region: str = ""
     allow_stale: bool = False
+    # Client-side staleness bound for the stale lane (ms of the serving
+    # server's leader-contact age): past it the server refuses with a
+    # typed retriable STALE_BOUND instead of answering stale. None =
+    # the server's configured default bound.
+    max_stale_ms: Optional[float] = None
+    # Linearizable lane: a read as strong as a write, confirmed via the
+    # leader's read index (no raft log write). Wins over allow_stale.
+    consistent: bool = False
     wait_index: int = 0
     wait_time: str = ""
     prefix: str = ""
@@ -88,8 +97,16 @@ class QueryMeta:
     """api.go:139-155"""
 
     last_index: int = 0
+    # Serving server's measured leader-contact age in ms at response
+    # time (X-Nomad-LastContact; 0 when the leader itself answered).
     last_contact: float = 0.0
     known_leader: bool = False
+    # Serving server's last-applied raft index (X-Nomad-LastIndex) —
+    # how fresh the state this response was read from actually was.
+    applied_index: int = 0
+    # Confirmed read index on linearizable-lane responses
+    # (X-Nomad-Read-Index); 0 on other lanes.
+    read_index: int = 0
 
 
 class ApiClient:
@@ -104,24 +121,70 @@ class ApiClient:
     budget is spent. Rejections are raised BEFORE any server-side effect
     (the admission contract), so replaying even writes is safe."""
 
-    def __init__(self, address: str = DEFAULT_ADDRESS, region: str = "",
-                 client_id: str = "", reject_retries: int = 2):
-        self.address = address.rstrip("/")
+    def __init__(self, address=DEFAULT_ADDRESS, region: str = "",
+                 client_id: str = "", reject_retries: int = 2,
+                 allow_stale: bool = False,
+                 max_stale_ms: Optional[float] = None):
+        # ``address`` is one base URL or a list of them (the server
+        # fleet). With a list the client is follower-aware: stale-lane
+        # GETs round-robin the whole fleet (any server may answer from
+        # its own FSM within the bound), everything else sticks to a
+        # preferred server and rotates only when it stops answering.
+        if isinstance(address, str):
+            addresses = [address]
+        else:
+            addresses = list(address) or [DEFAULT_ADDRESS]
+        self.addresses = [a.rstrip("/") for a in addresses]
+        self.address = self.addresses[0]
         self.region = region
         self.client_id = client_id
         self.reject_retries = max(0, int(reject_retries))
+        # Client-level lane defaults: every plain GET issued without
+        # explicit QueryOptions opts into the stale lane (with the
+        # bound) when allow_stale is set — the read-fleet posture.
+        self.allow_stale = bool(allow_stale)
+        self.max_stale_ms = max_stale_ms
+        import threading as _threading
+
+        self._addr_lock = _threading.Lock()
+        self._rr = 0
+        self._preferred = 0
 
     # -- raw verbs (api.go:243-376) -----------------------------------------
 
-    def _url(self, path: str, q: Optional[QueryOptions], params: Dict) -> str:
+    def _pick_address(self, stale: bool) -> str:
+        with self._addr_lock:
+            if stale and len(self.addresses) > 1:
+                # Stale reads spread over the fleet — the whole point of
+                # the lane is that followers absorb this load.
+                i = self._rr % len(self.addresses)
+                self._rr += 1
+                return self.addresses[i]
+            return self.addresses[self._preferred % len(self.addresses)]
+
+    def _rotate_preferred(self, failed: str) -> None:
+        with self._addr_lock:
+            if self.addresses[self._preferred % len(self.addresses)] \
+                    == failed:
+                self._preferred = (self._preferred + 1) \
+                    % len(self.addresses)
+
+    def _url(self, path: str, q: Optional[QueryOptions], params: Dict,
+             base: Optional[str] = None) -> str:
         query = dict(params)
         if q is not None:
             if q.wait_index:
                 query["index"] = str(q.wait_index)
             if q.wait_time:
                 query["wait"] = q.wait_time
-            if q.allow_stale:
+            if q.consistent:
+                query["consistent"] = "1"
+            elif q.allow_stale:
                 query["stale"] = "1"
+                bound = (q.max_stale_ms if q.max_stale_ms is not None
+                         else self.max_stale_ms)
+                if bound is not None:
+                    query["max_stale"] = str(bound)
             if q.region:
                 query["region"] = q.region
             if q.prefix:
@@ -129,18 +192,22 @@ class ApiClient:
         # doseq: list-valued params (repeatable ?topic= filters) expand to
         # repeated keys; scalars encode exactly as before.
         qs = urllib.parse.urlencode(query, doseq=True)
-        return f"{self.address}{path}" + (f"?{qs}" if qs else "")
+        return f"{base or self.address}{path}" + (f"?{qs}" if qs else "")
 
     def _do(self, method: str, path: str, body: Any = None,
             q: Optional[QueryOptions] = None,
             params: Optional[Dict] = None) -> Tuple[Any, QueryMeta]:
         from nomad_tpu.backoff import MAX_RETRY_AFTER_SLEEP, Backoff
 
-        url = self._url(path, q, params or {})
+        stale = bool(method == "GET" and q is not None and q.allow_stale
+                     and not q.consistent)
         data = json.dumps(to_dict(body)).encode() if body is not None else None
         bo = Backoff(base=0.05, max_delay=1.0)
         attempt = 0
+        unreachable: set = set()
         while True:
+            base = self._pick_address(stale)
+            url = self._url(path, q, params or {}, base=base)
             req = urllib.request.Request(url, data=data, method=method)
             if data is not None:
                 req.add_header("Content-Type", "application/json")
@@ -157,6 +224,10 @@ class ApiClient:
                         ),
                         known_leader=resp.headers.get("X-Nomad-KnownLeader")
                         == "true",
+                        applied_index=int(
+                            resp.headers.get("X-Nomad-LastIndex", 0)),
+                        read_index=int(
+                            resp.headers.get("X-Nomad-Read-Index", 0)),
                     )
                     payload = resp.read()
                     return (json.loads(payload) if payload else None), meta
@@ -174,6 +245,15 @@ class ApiClient:
                 # exists to break. A hint past the sleep ceiling also
                 # surfaces: sleeping a clamped slice of it guarantees
                 # another rejection — the caller owns waits that long.
+                # STALE_BOUND is the one read-lane exception: the refusal
+                # is per-SERVER (this follower's contact age), so with a
+                # fleet the retry goes straight to the next server in the
+                # rotation instead of sleeping.
+                if (rejection.reason == REJECT_STALE_BOUND and stale
+                        and len(self.addresses) > 1
+                        and attempt < self.reject_retries):
+                    attempt += 1
+                    continue
                 if (rejection.reason != REJECT_RATE_LIMITED
                         or attempt >= self.reject_retries
                         or rejection.retry_after > MAX_RETRY_AFTER_SLEEP):
@@ -183,12 +263,22 @@ class ApiClient:
 
                 _time.sleep(max(rejection.retry_after, bo.next_delay()))
             except urllib.error.URLError as e:
-                raise ApiError(
-                    0, f"failed to reach agent at {self.address}: {e.reason}"
-                ) from e
+                # A dead server is a routing event, not (yet) a failure:
+                # rotate the preferred server and try the rest of the
+                # fleet once each before surfacing.
+                unreachable.add(base)
+                self._rotate_preferred(base)
+                if len(unreachable) >= len(self.addresses):
+                    raise ApiError(
+                        0,
+                        f"failed to reach agent at {base}: {e.reason}"
+                    ) from e
 
     def query(self, path: str, q: Optional[QueryOptions] = None,
               params: Optional[Dict] = None) -> Tuple[Any, QueryMeta]:
+        if q is None and self.allow_stale:
+            q = QueryOptions(allow_stale=True,
+                             max_stale_ms=self.max_stale_ms)
         return self._do("GET", path, q=q, params=params)
 
     def write(self, path: str, body: Any = None,

@@ -23,6 +23,11 @@ from nomad_tpu import telemetry, trace
 from nomad_tpu.api.codec import from_dict, to_dict
 from nomad_tpu.jobspec import parse_duration
 from nomad_tpu.server.blocking import blocking_query
+from nomad_tpu.server.read_path import (
+    LANE_DEFAULT,
+    LANE_LINEARIZABLE,
+    LANE_STALE,
+)
 from nomad_tpu.state.store import (
     item_table,
 )
@@ -295,11 +300,19 @@ class HTTPServer:
             if m is None:
                 continue
             ctx = {"template": template, "lane": "plain", "status": 200,
-                   "bytes": 0, "hold_s": 0.0, "woke": None}
+                   "bytes": 0, "hold_s": 0.0, "woke": None,
+                   "consistency": LANE_DEFAULT, "role": None,
+                   "read_meta": None}
             self._local.ctx = ctx
             t0 = _time.monotonic()
             try:
                 try:
+                    if req.command == "GET":
+                        # Consistency lane resolves BEFORE the handler: a
+                        # stale-bound or read-index refusal must cost
+                        # nothing and a linearizable read must not touch
+                        # state until applied >= the confirmed index.
+                        self._enter_read_lane(req, query, ctx)
                     out, index = handler(req, query, **m.groupdict())
                 except HTTPCodedError as e:
                     self._respond_error(req, e.code, str(e))
@@ -343,6 +356,39 @@ class HTTPServer:
             rec.record_blocking(ctx["template"], ctx["hold_s"],
                                 duration_s, bool(ctx["woke"]))
 
+    def _enter_read_lane(self, req, query: Dict[str, str],
+                         ctx: Dict[str, Any]) -> None:
+        """Resolve one GET's consistency lane (the reference QueryOptions
+        AllowStale posture plus Consul's ``?consistent=``): ``?stale=`` /
+        ``X-Nomad-Consistency: stale`` opts into bounded staleness
+        (``?max_stale=`` ms tightens the server default), ``?consistent=``
+        / ``X-Nomad-Consistency: linearizable`` demands a read-index-
+        confirmed answer. ReadPath.enter may raise a typed retriable
+        RejectError (stale bound exceeded, no confirmed read index) which
+        the dispatcher maps to 429 + Retry-After. No-op on a client-only
+        agent."""
+        rp = getattr(getattr(self.agent, "server", None), "read_path", None)
+        if rp is None:
+            return
+        hdr = (req.headers.get("X-Nomad-Consistency") or "").strip().lower()
+        if hdr == LANE_LINEARIZABLE or "consistent" in query:
+            lane = LANE_LINEARIZABLE
+        elif hdr == LANE_STALE or "stale" in query:
+            lane = LANE_STALE
+        else:
+            lane = LANE_DEFAULT
+        max_stale_ms = None
+        if query.get("max_stale"):
+            try:
+                max_stale_ms = float(query["max_stale"])
+            except ValueError:
+                raise HTTPCodedError(
+                    400, f"invalid max_stale (ms): {query['max_stale']!r}")
+        meta = rp.enter(lane, max_stale_ms)
+        ctx["consistency"] = meta["lane"]
+        ctx["role"] = meta["role"]
+        ctx["read_meta"] = meta
+
     def _freshness_headers(self, req) -> None:
         """Stamp the response with read-freshness meta: the serving
         server's last-applied raft index, whether it currently knows a
@@ -365,14 +411,37 @@ class HTTPServer:
             known_leader = bool(self.agent.leader_addr())
         except Exception:
             known_leader = False
+        ctx = getattr(self._local, "ctx", None) or {}
+        meta = ctx.get("read_meta") or {}
         req.send_header("X-Nomad-Applied-Index", str(applied))
+        req.send_header("X-Nomad-LastIndex",
+                        str(int(meta.get("applied_index", applied))))
         req.send_header("X-Nomad-Staleness", str(age))
         req.send_header("X-Nomad-KnownLeader",
                         "true" if known_leader else "false")
+        # Measured leader-contact age in ms (0 on the leader) — the value
+        # a stale-lane client compares against its max_stale bound.
+        # Omitted only when a follower has never heard from any leader
+        # (the stale lane refuses such a server before reaching here).
+        contact_ms = meta.get("last_contact_ms")
+        if not meta:
+            rp = getattr(server, "read_path", None)
+            contact_ms = (rp.last_contact_ms() if rp is not None
+                          else None)
+        if contact_ms is not None:
+            req.send_header("X-Nomad-LastContact",
+                            str(int(round(contact_ms))))
+        if meta.get("read_index") is not None:
+            req.send_header("X-Nomad-Read-Index",
+                            str(int(meta["read_index"])))
         if req.command == "GET":
             obs = self._read_observatory()
             if obs is not None:
-                obs.recorder.record_staleness(age)
+                obs.recorder.record_staleness(
+                    age,
+                    role=ctx.get("role") or "",
+                    lane=ctx.get("consistency") or LANE_DEFAULT,
+                )
 
     def _respond_json(self, req, out: Any, index: Optional[int]) -> None:
         body = json.dumps(to_dict(out)).encode()
@@ -383,10 +452,10 @@ class HTTPServer:
         req.send_header("Content-Type", "application/json")
         req.send_header("Content-Length", str(len(body)))
         if index is not None:
-            # Query meta headers (http.go setMeta; known-leader now
-            # rides the uniform freshness stamp below)
+            # Query meta headers (http.go setMeta; known-leader and the
+            # MEASURED last-contact age ride the uniform freshness stamp
+            # below — the old hardcoded "0" here lied on followers)
             req.send_header("X-Nomad-Index", str(index))
-            req.send_header("X-Nomad-LastContact", "0")
         self._freshness_headers(req)
         req.end_headers()
         req.wfile.write(body)
@@ -1080,7 +1149,15 @@ class HTTPServer:
             return RawResponse(
                 b.text().encode(), "text/plain; version=0.0.4"
             ), None
-        return obs.snapshot(), None
+        body = obs.snapshot()
+        # Consistency-lane serving books ride the same surface: one
+        # endpoint answers "who served what, how stale, what was
+        # refused" for this server.
+        rp = getattr(getattr(self.agent, "server", None),
+                     "read_path", None)
+        if rp is not None:
+            body["read_path"] = rp.snapshot()
+        return body, None
 
     def _read_observatory(self):
         """The server's read observatory, or None (no server / disabled)
@@ -1159,6 +1236,39 @@ class HTTPServer:
             b.gauge("nomad_read_staleness_entries",
                     fresh["staleness_entries"][q],
                     labels={"quantile": q})
+        for role, lanes in fresh.get("by_role", {}).items():
+            for lane, split in lanes.items():
+                b.counter("nomad_read_lane_responses_total",
+                          split["count"],
+                          labels={"role": role, "lane": lane})
+                for q in ("p50", "p95", "p99"):
+                    b.gauge("nomad_read_lane_staleness_entries",
+                            split["staleness_entries"][q],
+                            labels={"role": role, "lane": lane,
+                                    "quantile": q})
+        rp = getattr(getattr(self.agent, "server", None),
+                     "read_path", None)
+        if rp is not None:
+            rps = rp.snapshot()
+            for role, lanes in rps["served"].items():
+                for lane, n in lanes.items():
+                    if n:
+                        b.counter("nomad_read_path_served_total", n,
+                                  labels={"role": role, "lane": lane})
+            b.counter("nomad_read_path_stale_refused_total",
+                      rps["stale"]["refused"])
+            b.counter("nomad_read_path_linear_refused_total",
+                      rps["linearizable"]["refused"])
+            b.gauge("nomad_read_path_follower_serve_share",
+                    rps["follower_serve_share"])
+            for q in ("p50", "p95", "p99"):
+                b.gauge("nomad_read_path_stale_age_ms",
+                        rps["stale"]["age_ms"][q],
+                        labels={"quantile": q})
+            ri = rps["linearizable"]["read_index"]
+            for k in ("calls", "lease_hits", "quorum_confirms",
+                      "refused"):
+                b.counter(f"nomad_read_index_{k}_total", ri[k])
 
     def agent_profile(self, req, query) -> Tuple[Any, Optional[int]]:
         """Continuous sampling profiler (nomad_tpu/profile_observe.py):

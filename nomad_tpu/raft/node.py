@@ -95,6 +95,15 @@ class RaftConfig:
     # interleaves with live AppendEntries instead of stalling behind one
     # giant frame.
     snapshot_chunk_bytes: int = 256 * 1024
+    # Leader read lease as a fraction of election_timeout_min: a quorum
+    # ack within the last (fraction × election_timeout_min) seconds lets
+    # read_index() confirm leadership from the books instead of a fresh
+    # quorum round — the lease rides the existing heartbeat traffic. The
+    # fraction < 1 is the clock-skew guard: a peer that acked at time T
+    # waits at least election_timeout_min of ITS clock past T before
+    # electing anyone, so serving within a strict fraction of that window
+    # tolerates bounded timer drift (clamped to 0.9 defensively).
+    read_lease_fraction: float = 0.75
 
 
 @dataclass
@@ -201,6 +210,16 @@ class RaftNode:
         self._wp_done: "deque" = deque(maxlen=1024)
         self._wp_seq = 0
         self._peer_ack_at: Dict[str, float] = {}
+        # Read-index / lease books (server/read_path.py's linearizable
+        # lane): calls, how each confirmed (lease hit riding heartbeat
+        # acks vs an explicit quorum round), and refusals. Last accepted
+        # leader contact (follower side) feeds the stale lane's measured
+        # staleness age.
+        self._last_leader_contact: Optional[float] = None
+        self.read_index_calls = 0
+        self.read_lease_hits = 0
+        self.read_quorum_confirms = 0
+        self.read_index_refused = 0
         self.commit_advances = 0
         self.entries_appended = 0
         self.bytes_appended = 0
@@ -254,6 +273,7 @@ class RaftNode:
         rpc.register("Raft.RequestVote", self._handle_request_vote)
         rpc.register("Raft.AppendEntries", self._handle_append_entries)
         rpc.register("Raft.InstallSnapshot", self._handle_install_snapshot)
+        rpc.register("Raft.ReadIndex", self._handle_read_index)
 
         self._threads: List[threading.Thread] = []
 
@@ -340,6 +360,113 @@ class RaftNode:
         """Commit a no-op and wait for it — the leader's read barrier."""
         future = self.apply("_noop", {})
         return future.result(timeout)
+
+    # -- linearizable reads without a log write (dissertation §6.4) ---------
+
+    def lease_window_s(self) -> float:
+        """How long a quorum ack keeps the leader's read lease valid.
+        Strictly inside election_timeout_min (see RaftConfig
+        .read_lease_fraction — the clock-skew guard)."""
+        fraction = min(max(self.config.read_lease_fraction, 0.0), 0.9)
+        return self.config.election_timeout_min * fraction
+
+    def last_contact_s(self) -> Optional[float]:
+        """Age of the last accepted leader contact (AppendEntries or
+        InstallSnapshot chunk that passed the term check). 0.0 on the
+        leader itself; None when this node has never heard from a
+        leader — the stale lane's measured staleness age."""
+        with self._lock:
+            if self.role == LEADER:
+                return 0.0
+            if self._last_leader_contact is None:
+                return None
+            return max(time.monotonic() - self._last_leader_contact, 0.0)
+
+    def _lease_valid_locked(self, now: float) -> bool:
+        """Quorum of peers acked within the lease window (self counts).
+        Acks are only ever recorded for the CURRENT term
+        (_replicate_to_locked_out re-checks term before stamping), so a
+        fresh quorum proves no higher term could have been committed
+        when the newest qualifying ack landed."""
+        window = self.lease_window_s()
+        need = len(self.config.peers) // 2 + 1
+        fresh = 1 + sum(
+            1 for pid in self._other_peers()
+            if now - self._peer_ack_at.get(pid, float("-inf")) <= window
+        )
+        return fresh >= need
+
+    def read_index(self, timeout: float = 2.0) -> int:
+        """Linearizable read point WITHOUT a log write (the ReadIndex
+        protocol): capture the commit index, confirm leadership, return
+        the index once both hold. The caller serves the read after its
+        applied index reaches the returned value. Confirmation is free
+        when the heartbeat-riding lease is fresh; otherwise one explicit
+        quorum wait (acks newer than the request) — still no log entry.
+        Raises NotLeaderError on a non-leader or a deposed leader, and
+        TimeoutError when no quorum confirms in time."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            if self.role != LEADER:
+                self.read_index_refused += 1
+                raise NotLeaderError(self.leader_addr)
+            self.read_index_calls += 1
+            term_ok = (self.commit_index > self.log_offset
+                       or self.commit_index > 0) and (
+                self._term_at(self.commit_index) == self.current_term)
+        if not term_ok:
+            # Right after election the current-term no-op may not have
+            # committed yet, so commit_index can lag commits a prior
+            # leader made that we haven't learned of (§5.4.2). Commit a
+            # barrier no-op — the one case the linearizable lane ever
+            # touches the log, once per term.
+            self.barrier(max(deadline - time.monotonic(), 0.001))
+        with self._lock:
+            if self.role != LEADER:
+                self.read_index_refused += 1
+                raise NotLeaderError(self.leader_addr)
+            read_idx = self.commit_index
+            if self._lease_valid_locked(time.monotonic()):
+                self.read_lease_hits += 1
+                return read_idx
+        # Lease expired (quiet cluster, stalled heartbeats, or a
+        # partitioned leader): one explicit confirmation round. A quorum
+        # of acks newer than t_req proves this node's leadership — and
+        # therefore read_idx's currency — at the time of the request.
+        t_req = time.monotonic()
+        self._replicate_now.set()
+        while True:
+            with self._lock:
+                if self.role != LEADER:
+                    self.read_index_refused += 1
+                    raise NotLeaderError(self.leader_addr)
+                need = len(self.config.peers) // 2 + 1
+                fresh = 1 + sum(
+                    1 for pid in self._other_peers()
+                    if self._peer_ack_at.get(pid, 0.0) >= t_req
+                )
+                if fresh >= need:
+                    self.read_quorum_confirms += 1
+                    return read_idx
+            if time.monotonic() >= deadline:
+                with self._lock:
+                    self.read_index_refused += 1
+                raise TimeoutError(
+                    f"read_index: no leadership confirmation in "
+                    f"{timeout:.3f}s"
+                )
+            time.sleep(0.002)
+            self._replicate_now.set()
+
+    def _handle_read_index(self, args: dict) -> dict:
+        """Raft.ReadIndex RPC: a follower's linearizable lane asks the
+        leader for a confirmed read index (no log write). Raises through
+        the RPC envelope on a non-leader; the forwarding layer retries
+        against the new leader."""
+        timeout = min(max(float(args.get("timeout") or 1.0), 0.001), 5.0)
+        index = self.read_index(timeout=timeout)
+        with self._lock:
+            return {"index": index, "term": self.current_term}
 
     # -- membership change (single-server, committed through the log) -------
 
@@ -481,6 +608,18 @@ class RaftNode:
                     # slightly-lagging followers replicate normally.
                     "retained_below_snapshot": max(
                         self.snapshot_index - self.log_offset, 0
+                    ),
+                },
+                "read_index": {
+                    "calls": self.read_index_calls,
+                    "lease_hits": self.read_lease_hits,
+                    "quorum_confirms": self.read_quorum_confirms,
+                    "refused": self.read_index_refused,
+                    "lease_window_s": round(self.lease_window_s(), 4),
+                    "last_contact_s": (
+                        None if self._last_leader_contact is None
+                        or self.role == LEADER
+                        else round(now - self._last_leader_contact, 4)
                     ),
                 },
                 "snapshot": {
@@ -1082,6 +1221,7 @@ class RaftNode:
                 self._become_follower(term, args["leader_id"])
             self.leader_id = args["leader_id"]
             self._election_deadline = self._random_deadline()
+            self._last_leader_contact = time.monotonic()
 
             snap_index = args["last_included_index"]
             snap_term = args["last_included_term"]
@@ -1292,6 +1432,7 @@ class RaftNode:
                 self._become_follower(term, args["leader_id"])
             self.leader_id = args["leader_id"]
             self._election_deadline = self._random_deadline()
+            self._last_leader_contact = time.monotonic()
             if self.removed:
                 # A leader talking to us means we are a member again
                 # (re-added by a committed _config entry on its side).

@@ -176,9 +176,15 @@ class ReadRecorder:
         self.sse_heartbeats = 0
         self._sse_lag = telemetry.AggregateSample()
         # Freshness: per-response staleness (leader commit − applied, in
-        # raft entries) as stamped on the wire.
+        # raft entries) as stamped on the wire — the flat aggregate plus
+        # a (serving role × consistency lane) split. Before follower
+        # serving, one ledger was honest; with it, leader-served default
+        # reads and follower-served stale reads are different promises
+        # and averaging them together hides exactly the number the
+        # stale-bound contract is about.
         self.responses_stamped = 0
         self._staleness = telemetry.AggregateSample()
+        self._staleness_split: Dict[tuple, Any] = {}
 
     # -- per-request attribution --------------------------------------------
 
@@ -247,10 +253,22 @@ class ReadRecorder:
 
     # -- freshness ------------------------------------------------------------
 
-    def record_staleness(self, age_entries: int) -> None:
+    def record_staleness(self, age_entries: int, role: str = "leader",
+                         lane: str = "default") -> None:
+        """One stamped response: ``role`` is the serving server's raft
+        role at stamp time, ``lane`` the consistency lane served
+        (default/stale/linearizable — NOT the transport lane)."""
         with self._lock:
             self.responses_stamped += 1
             self._staleness.ingest(float(max(age_entries, 0)))
+            key = (role or "leader", lane or "default")
+            split = self._staleness_split.get(key)
+            if split is None:
+                split = self._staleness_split[key] = {
+                    "count": 0, "sample": telemetry.AggregateSample(),
+                }
+            split["count"] += 1
+            split["sample"].ingest(float(max(age_entries, 0)))
 
     # -- exposition -----------------------------------------------------------
 
@@ -272,6 +290,20 @@ class ReadRecorder:
                 "freshness": {
                     "responses_stamped": self.responses_stamped,
                     "staleness_entries": _q(self._staleness),
+                    "by_role": {
+                        role: {
+                            lane: {
+                                "count": split["count"],
+                                "staleness_entries": _q(split["sample"]),
+                            }
+                            for (r, lane), split
+                            in sorted(self._staleness_split.items())
+                            if r == role
+                        }
+                        for role in sorted({
+                            r for r, _ in self._staleness_split
+                        })
+                    },
                 },
             }
 

@@ -194,6 +194,11 @@ class ClusterServer(Server):
         self.express_lane.start()
         self.capacity_accountant.start()
         self.raft_observatory.start()
+        # Start the read observatory here too: this override previously
+        # omitted it, so cluster members served every HTTP read with the
+        # freshness/serving ledger stopped at its construction snapshot —
+        # exactly the servers whose follower-serving books matter most.
+        self.read_observatory.start()
         self.runtime_observatory.start()
         from nomad_tpu.server.worker import Worker
 
@@ -273,6 +278,16 @@ class ClusterServer(Server):
             self.express_lane.demote()
 
     # -- forwarding (rpc.go:163-228) ------------------------------------------
+    #
+    # Forwarding audit (the consistency-lane contract): ONLY writes and
+    # leader-owned machinery cross the wire from a follower — Eval.* broker
+    # ops, Plan.Submit, Express.Reconcile, Job.*/Node.* mutations, and the
+    # linearizable lane's Raft.ReadIndex (an 8-byte index exchange, not the
+    # read itself). Every read RPC in _register_endpoints below
+    # (Node.GetAllocs, Eval.GetEval, Job.GetJob, Alloc.GetAlloc, Status.*)
+    # and every HTTP GET run against LOCAL state on whichever server was
+    # dialed; the stale lane never produces a leader RPC (regression-pinned
+    # by tests/test_read_path.py::test_stale_read_zero_leader_rpcs).
 
     def _forward(self, method: str, args: dict,
                  timeout: Optional[float] = None):
@@ -401,6 +416,24 @@ class ClusterServer(Server):
             return self.plan_queue.enqueue(plan).wait()
         out = self._forward("Plan.Submit", {"plan": to_dict(plan)})
         return from_dict(PlanResult, out)
+
+    def confirmed_read_index(self, timeout: float = 2.0) -> int:
+        """Linearizable-lane seam: the leader confirms via its own read
+        lease / quorum round; a follower asks the leader for a confirmed
+        index over Raft.ReadIndex — the only read-path traffic that ever
+        crosses the wire (the data itself is served from local state once
+        applied catches up, read_path._await_read_index)."""
+        if self.raft.is_leader:
+            return self.raft.read_index(timeout=timeout)
+        try:
+            out = self._forward("Raft.ReadIndex", {"timeout": timeout},
+                                timeout=timeout + 2.0)
+        except RemoteError as e:
+            # Leader-side refusal (deposed mid-call, stalled quorum)
+            # crosses the wire untyped; surface it as the retriable
+            # refusal the lane maps to a typed STALE_BOUND reject.
+            raise TimeoutError(f"read index forward failed: {e}") from e
+        return int(out["index"])
 
     def express_reconcile(self, job: Job, evals: List[Evaluation]) -> int:
         """Express slow-path reconciliation rides to the CURRENT leader:

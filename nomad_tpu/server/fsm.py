@@ -335,11 +335,39 @@ class InProcRaft:
         # here would cost the hot path a dumps it never needed.
         self._wp_done: "deque" = deque(maxlen=1024)
         self._wp_seq = 0
+        # Read-index books (server/read_path.py): a quorum of one is
+        # always itself, so every linearizable read confirms trivially —
+        # counted honestly rather than pretended away, so the DevMode
+        # /v1/agent/reads books name the posture they were measured in.
+        self.read_index_calls = 0
+        self.read_lease_hits = 0
+        self.read_quorum_confirms = 0
+        self.read_index_refused = 0
 
     @property
     def applied_index(self) -> int:
         with self._lock:
             return self._index
+
+    @property
+    def is_leader(self) -> bool:
+        """A quorum of one: always the leader of itself."""
+        return True
+
+    def read_index(self, timeout: float = 2.0) -> int:
+        """Trivially-confirmed linearizable read point: synchronous
+        replication means the applied index IS the commit index and the
+        single member IS the quorum. Books kept honest (lease_hits) so
+        lane accounting is comparable across DevMode and cluster runs."""
+        del timeout
+        with self._lock:
+            self.read_index_calls += 1
+            self.read_lease_hits += 1
+            return self._index
+
+    def last_contact_s(self) -> float:
+        """The single member is its own leader: contact age is zero."""
+        return 0.0
 
     def write_path_records(self, since: int):
         """(sequence, finalized records newer than ``since``) — the raft

@@ -156,6 +156,16 @@ class ServerConfig:
     # writes plain books, nothing feeds back — pinned by the read-storm
     # contrast arm).
     reads: Optional[Dict] = None
+    # Follower read plane spec (ReadPathConfig.parse mapping,
+    # nomad_tpu/server/read_path.py): consistency-tiered read serving —
+    # the stale lane's staleness-bound enforcement, the linearizable
+    # lane's read-index/lease confirmation, per-(role, lane) serve
+    # books. None = defaults (enabled). Decision scope: this is a
+    # SERVING path (it refuses requests), not an observatory — but it is
+    # read-decision-invariant for the write path: no lane ever touches
+    # the log beyond the once-per-term barrier no-op, pinned by the
+    # read-storm digest equality.
+    read_path: Optional[Dict] = None
     # Runtime self-observatory spec (ProfileObserveConfig.parse mapping,
     # nomad_tpu/profile_observe.py): the read-only observer behind
     # /v1/agent/profile and /v1/agent/runtime — continuous stack-
@@ -222,6 +232,9 @@ class ServerConfig:
         from nomad_tpu.read_observe import ReadObserveConfig
 
         self.reads_config = ReadObserveConfig.parse(self.reads)
+        from nomad_tpu.server.read_path import ReadPathConfig
+
+        self.read_path_config = ReadPathConfig.parse(self.read_path)
         from nomad_tpu.profile_observe import ProfileObserveConfig
 
         self.profile_config = ProfileObserveConfig.parse(self.profile)
@@ -363,6 +376,15 @@ class Server:
             self.config.reads_config,
             events=self.fsm.events,
         )
+        # The follower read plane (server/read_path.py): consistency-
+        # lane resolution for every HTTP read — stale-bound enforcement,
+        # linearizable read-index confirmation, per-(role, lane) serve
+        # books. A serving-path component (not an observatory): it can
+        # refuse a request, so it lives with the server, and it re-reads
+        # self.raft per request (ClusterServer swaps in a RaftNode).
+        from nomad_tpu.server.read_path import ReadPath
+
+        self.read_path = ReadPath(self, self.config.read_path_config)
         # The runtime self-observatory (nomad_tpu/profile_observe.py):
         # stack-sampling profiler + lock-contention table + byte-economy
         # ledger. Same OBS001 composition-root contract. The ring/table
@@ -1154,6 +1176,15 @@ class Server:
         pending = self.plan_queue.enqueue(plan)
         return pending.wait()
 
+    # -- Read plane (server/read_path.py) ------------------------------------
+
+    def confirmed_read_index(self, timeout: float = 2.0) -> int:
+        """A leadership-confirmed read index for the linearizable lane
+        (no raft log write). DevMode's InProcRaft confirms trivially; a
+        ClusterServer follower overrides this to forward Raft.ReadIndex
+        to the leader."""
+        return self.raft.read_index(timeout=timeout)
+
     # -- Express endpoint (nomad_tpu/server/express.py) ----------------------
 
     def express_reconcile(self, job: Job, evals: List[Evaluation]) -> int:
@@ -1204,6 +1235,7 @@ class Server:
                              else None),
             "reads": (self.read_observatory.summary()
                       if self.config.reads_config.enabled else None),
+            "read_path": self.read_path.summary(),
             "runtime": (self.runtime_observatory.summary()
                         if self.config.profile_config.enabled else None),
         }

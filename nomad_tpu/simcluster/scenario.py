@@ -441,19 +441,38 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
                 ReadFleetInjector(
                     seed, pollers=6, watchers=6, sse_tails=3,
                     poll_interval=0.3, start=1.0, duration=16.0,
+                    max_stale_ms=5000.0,
                 ),
             ],
+            # A real 3-member cell: the read fleet rotates the two
+            # FOLLOWERS' front ends (stale lane with the bound above,
+            # every 5th poll linearizable) while the leader keeps the
+            # whole write plane — the follower-serve-share and
+            # leader-plan-p50 halves of the read-lane gate.
+            cluster_members=3,
+            cluster_overrides={
+                # The partition-flap posture: wide seeded elections so a
+                # loaded one-GIL 3-member cell cannot churn leadership
+                # mid-window (a mid-run Leader event would land in the
+                # canonical digest).
+                "election_timeout_min": 2.5,
+                "election_timeout_max": 5.0,
+                "heartbeat_interval": 0.1,
+            },
             server_overrides={
                 # Fresh read books: the observatory rolls every 250ms
                 # and stamps a Read event snapshot every 2s.
                 "reads": {"poll_interval": 0.25, "events_interval": 2.0},
             },
-            # The reads-OFF arm: identical write load AND identical read
-            # fleet, observatory disabled. Its canonical digest must
-            # EQUAL the main arm's — reads never touch the decision
-            # path, observed or not.
+            # The leader-only arm: identical write load AND identical
+            # read fleet, read lanes and observatory disabled — every
+            # read lands on the leader's front end (the r16 posture,
+            # the pile-up the follower plane exists to relieve). Its
+            # canonical digest must EQUAL the main arm's — reads never
+            # touch the decision path, however they are routed.
             contrast_overrides={
                 "reads": {"enabled": False},
+                "read_path": {"enabled": False},
             },
             contrast_digest_invariant=True,
             # ack_cap=0: the post-quiesce harness acks would land as a
@@ -461,17 +480,21 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
             # first-round ABSOLUTE slo gate on plumbing, not placement
             # (the express-mix bank made the same cut).
             quiesce_timeout=300.0, ack_cap=0,
-            description="the read-path proof: the steady-10k write load "
-                        "(24 service jobs x420 tasks over ~18s, node-"
-                        "refresh writes riding along) while a seeded "
-                        "impolite read fleet (6 pollers, 6 blocking "
-                        "watchers, 3 SSE tails) hammers the leader's "
-                        "HTTP front end; the reads section banks "
-                        "per-route serving attribution, the blocking "
-                        "hold/serve partition, SSE session books, watch-"
-                        "registry wake economy and the staleness "
-                        "distribution, and a reads-observatory-OFF "
-                        "contrast arm proves digest equality",
+            description="the follower-read-plane proof: the steady-10k "
+                        "write load (24 service jobs x420 tasks over "
+                        "~18s, node-refresh writes riding along) on a "
+                        "3-member cell while a seeded impolite read "
+                        "fleet (6 pollers, 6 blocking watchers, 3 SSE "
+                        "tails) rides the FOLLOWERS' front ends — stale "
+                        "lane under a 5s bound, every 5th poll "
+                        "linearizable via the leader's read-index "
+                        "lease; the reads section banks the serving "
+                        "books per member plus the lanes verdict "
+                        "(follower serve share, staleness-age "
+                        "distribution, read-index floor), and a leader-"
+                        "only contrast arm (lanes+observatory OFF) "
+                        "proves digest equality while exhibiting the "
+                        "leader pile-up the plane relieves",
         ),
         "read-storm-800": ScenarioSpec(
             name="read-storm-800", n_nodes=800,
@@ -482,8 +505,18 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
                 ReadFleetInjector(
                     seed, pollers=2, watchers=2, sse_tails=1,
                     poll_interval=0.15, start=0.5, duration=4.0,
+                    max_stale_ms=5000.0,
                 ),
             ],
+            # The full-size arm's 3-member cell, scaled down: follower
+            # fronts serve the fleet's stale/linearizable lanes in
+            # tier-1 too.
+            cluster_members=3,
+            cluster_overrides={
+                "election_timeout_min": 2.5,
+                "election_timeout_max": 5.0,
+                "heartbeat_interval": 0.1,
+            },
             server_overrides={
                 "reads": {"poll_interval": 0.2, "events_interval": 1.0},
                 "event_buffer_size": 8192,
@@ -493,16 +526,19 @@ def _spec_registry() -> Dict[str, ScenarioSpec]:
             },
             contrast_overrides={
                 "reads": {"enabled": False},
+                "read_path": {"enabled": False},
                 "event_buffer_size": 8192,
                 "max_heartbeats_per_second": 2.0,
             },
             contrast_digest_invariant=True,
             quiesce_timeout=120.0, ack_cap=0, warmup_count=100,
-            description="tier-1 read-path smoke: 800 nodes, 6 service "
-                        "jobs x120 tasks under a small impolite read "
-                        "fleet (2 pollers, 2 blocking watchers, 1 SSE "
-                        "tail); reads section banked, reads-off "
-                        "contrast arm digest-equal",
+            description="tier-1 read-path smoke: 800 nodes x 3-member "
+                        "cell, 6 service jobs x120 tasks under a small "
+                        "impolite read fleet (2 pollers, 2 blocking "
+                        "watchers, 1 SSE tail) served by the FOLLOWER "
+                        "fronts on the stale/linearizable lanes; reads "
+                        "+ lanes sections banked, leader-only contrast "
+                        "arm digest-equal",
         ),
         "restart-under-load": ScenarioSpec(
             name="restart-under-load", n_nodes=10_000,
@@ -684,6 +720,27 @@ class _HttpShim:
         return srv.rpc_addr if srv.raft.is_leader else ""
 
 
+class _MemberHttpShim:
+    """Agent facade pinned to ONE cell member — the follower read plane's
+    front end. Unlike ``_HttpShim`` (which resolves the runner's current
+    leader per request), this shim keeps serving the same member for its
+    whole life: per-follower serving from the follower's OWN FSM is the
+    point, and the lane books (role, staleness age, read-index waits)
+    must be attributed to the server that actually answered."""
+
+    def __init__(self, member):
+        self._member = member
+
+    @property
+    def server(self):
+        return self._member
+
+    def leader_addr(self) -> str:
+        if self._member.raft.is_leader:
+            return self._member.rpc_addr
+        return self._member.raft.leader_addr or ""
+
+
 class ScenarioRunner:
     def __init__(self, spec: ScenarioSpec, seed: int = 42,
                  logger: Optional[logging.Logger] = None,
@@ -756,6 +813,22 @@ class ScenarioRunner:
         self._readers: List[threading.Thread] = []
         self._reader_stats: List[Dict] = []
         self._t_actions0 = 0.0
+        # Consistency-lane bookkeeping (the follower read plane,
+        # nomad_tpu/server/read_path.py): one HTTP front end per
+        # follower when the lanes are on, the fleet's client-side lane
+        # books (staleness ages off X-Nomad-LastContact, read-index
+        # violations, missing freshness stamps), and the stale bound the
+        # fleet opted into — the artifact's reads.lanes section.
+        self._follower_https: List = []
+        self._lane_lock = threading.Lock()
+        self._lane_books: Dict[str, int] = {
+            "follower_dialed": 0, "leader_dialed": 0,
+            "stale_reads": 0, "stale_refused": 0,
+            "linear_reads": 0, "linear_violations": 0,
+            "stamp_missing": 0,
+        }
+        self._stale_ages_ms: List[float] = []
+        self._stale_bound_ms = 0.0
 
     # -- observation --------------------------------------------------------
 
@@ -1140,6 +1213,8 @@ class ScenarioRunner:
 
         from nomad_tpu.api.http import HTTPServer
 
+        from urllib.error import HTTPError
+
         if self._http is None:
             self._http = HTTPServer(
                 _HttpShim(self), port=0,
@@ -1147,6 +1222,28 @@ class ScenarioRunner:
             )
             self._http.start()
         base = self._http.addr
+        # Follower serving (the consistency-lane read plane): when the
+        # cell has followers AND the lanes are on, every follower gets
+        # its own pinned front end and the whole fleet rotates across
+        # THOSE — pollers/watchers opt into the stale lane with the
+        # payload's bound (every 5th poll rides the linearizable lane
+        # instead, pinning read-index freshness), SSE tails ride each
+        # follower's own event ring. Lanes off (the leader-only
+        # contrast arm) keeps the r16 posture byte-for-byte: everything
+        # hammers the leader's front end, plain GETs.
+        lanes_on = bool(self._srv.config.read_path_config.enabled)
+        if lanes_on and len(self._members) > 1 and not self._follower_https:
+            for m in self._followers():
+                h = HTTPServer(
+                    _MemberHttpShim(m), port=0,
+                    logger=self.logger.getChild(
+                        f"readhttp-{m.cluster.node_id}"),
+                )
+                h.start()
+                self._follower_https.append(h)
+        follower_bases = [h.addr for h in self._follower_https]
+        bound_ms = float(payload.get("max_stale_ms", 5000.0))
+        self._stale_bound_ms = bound_ms
         deadline = self._t_actions0 + float(payload["until"])
         interval = float(payload.get("poll_interval", 0.2))
         jitters = list(payload.get("poll_jitters") or [1.0])
@@ -1154,32 +1251,81 @@ class ScenarioRunner:
                  "/v1/evaluations")
         stats = self._reader_stats
         stop = self._stop
+        books = self._lane_books
+        lane_lock = self._lane_lock
+
+        def book_lane(headers, linear: bool) -> None:
+            """Client-side lane accounting for one follower-front 200:
+            the freshness-stamp contract (every response carries its
+            applied index + contact age), the measured staleness age,
+            and the linearizable floor (nothing older than the
+            confirmed read index)."""
+            applied = headers.get("X-Nomad-LastIndex")
+            contact = headers.get("X-Nomad-LastContact")
+            with lane_lock:
+                if applied is None or contact is None:
+                    books["stamp_missing"] += 1
+                    return
+                if linear:
+                    books["linear_reads"] += 1
+                    ridx = int(headers.get("X-Nomad-Read-Index") or 0)
+                    if ridx <= 0 or int(applied) < ridx:
+                        books["linear_violations"] += 1
+                else:
+                    books["stale_reads"] += 1
+                    self._stale_ages_ms.append(float(contact))
 
         def poller(k: int) -> None:
             jitter = float(jitters[k % len(jitters)])
-            n = errs = nbytes = 0
+            n = errs = nbytes = refused = 0
             while time.monotonic() < deadline and not stop.is_set():
                 path = paths[(n + k) % len(paths)]
+                linear = False
+                if follower_bases:
+                    fb = follower_bases[(n + k) % len(follower_bases)]
+                    linear = n % 5 == 4
+                    url = (f"{fb}{path}?consistent=1" if linear else
+                           f"{fb}{path}?stale=1&max_stale={bound_ms:g}")
+                    with lane_lock:
+                        books["follower_dialed"] += 1
+                else:
+                    url = base + path
                 try:
-                    with urlopen(base + path, timeout=10.0) as resp:
+                    with urlopen(url, timeout=10.0) as resp:
                         nbytes += len(resp.read())
+                        if follower_bases:
+                            book_lane(resp.headers, linear)
+                except HTTPError as e:
+                    if e.code == 429:
+                        refused += 1
+                    errs += 1
                 except Exception:
                     errs += 1
                 n += 1
                 time.sleep(interval * jitter)
             stats.append({"kind": "pollers", "requests": n,
-                          "errors": errs, "bytes": nbytes})
+                          "errors": errs, "bytes": nbytes,
+                          "lane_refused": refused})
 
         def watcher(k: int) -> None:
             path = paths[k % len(paths)]
             index = 1
             n = wakes = timeouts = errs = 0
             while time.monotonic() < deadline and not stop.is_set():
+                if follower_bases:
+                    fb = follower_bases[k % len(follower_bases)]
+                    url = (f"{fb}{path}?index={index}&wait=2s"
+                           f"&stale=1&max_stale={bound_ms:g}")
+                    with lane_lock:
+                        books["follower_dialed"] += 1
+                else:
+                    url = f"{base}{path}?index={index}&wait=2s"
                 try:
-                    with urlopen(f"{base}{path}?index={index}&wait=2s",
-                                 timeout=15.0) as resp:
+                    with urlopen(url, timeout=15.0) as resp:
                         resp.read()
                         new = int(resp.headers.get("X-Nomad-Index") or 0)
+                        if follower_bases:
+                            book_lane(resp.headers, False)
                     if new > index:
                         wakes += 1
                         index = new
@@ -1193,6 +1339,8 @@ class ScenarioRunner:
                           "errors": errs})
 
         def sse_tail(k: int) -> None:
+            sse_base = (follower_bases[k % len(follower_bases)]
+                        if follower_bases else base)
             sessions = frames = errs = 0
             while time.monotonic() < deadline and not stop.is_set():
                 # Bounded sessions that reconnect until the deadline:
@@ -1201,7 +1349,7 @@ class ScenarioRunner:
                 wait_s = max(min(deadline - time.monotonic(), 4.0), 0.5)
                 try:
                     with urlopen(
-                        f"{base}/v1/event/stream?format=sse"
+                        f"{sse_base}/v1/event/stream?format=sse"
                         f"&wait={wait_s:.1f}s",
                         timeout=30.0,
                     ) as resp:
@@ -1787,6 +1935,13 @@ class ScenarioRunner:
             if self._http is not None:
                 self._http.shutdown()
                 self._http = None
+            for h in self._follower_https:
+                try:
+                    h.shutdown()
+                except Exception:
+                    self.logger.exception(
+                        "simcluster: follower front-end shutdown failed")
+            self._follower_https = []
             fleet.stop()
             for m in (self._members or [self._srv]):
                 try:
@@ -2191,7 +2346,80 @@ class ScenarioRunner:
             out = {"enabled": True, **obs.snapshot()}
         if fleet:
             out["fleet"] = fleet
+        # Follower serving moves the per-endpoint/blocking/SSE books to
+        # the members that actually answered: bank each follower's own
+        # observatory snapshot next to the leader's (the leader's books
+        # above stay the schema anchor — near-empty by DESIGN when the
+        # lanes are on and the fleet rotates follower fronts).
+        if self._follower_https and out.get("enabled"):
+            by_member = {}
+            for m in self._followers():
+                mobs = getattr(m, "read_observatory", None)
+                if mobs is None or not m.config.reads_config.enabled:
+                    continue
+                mobs.refresh()
+                by_member[m.cluster.node_id] = mobs.snapshot()
+            out["by_member"] = by_member
+        if self._http is not None or self._follower_https:
+            out["lanes"] = self._lanes_section(srv)
         return out
+
+    def _lanes_section(self, srv) -> Dict:
+        """The consistency-lane verdict block (reads.lanes —
+        slo.evaluate_read_lanes consumes exactly this shape): per-role
+        serve counts summed across every member's read-path books, the
+        follower serve share, the stale bound the fleet opted into with
+        the CLIENT-measured staleness-age distribution (off
+        X-Nomad-LastContact), and the linearizable floor + freshness-
+        stamp violation counters. ``enabled`` falsy in the leader-only
+        contrast arm."""
+        members = self._members or [srv]
+        rp_cfg = getattr(srv.config, "read_path_config", None)
+        enabled = bool(rp_cfg is not None and rp_cfg.enabled
+                       and getattr(srv, "read_path", None) is not None)
+        if not enabled:
+            return {"enabled": False, "members": len(members)}
+        served = {"leader": 0, "follower": 0}
+        by_lane: Dict[str, int] = {}
+        stale_refused = linear_refused = 0
+        for m in members:
+            snap = m.read_path.snapshot()
+            for role, lanes in snap["served"].items():
+                served[role] += sum(lanes.values())
+                for lane, n in lanes.items():
+                    by_lane[lane] = by_lane.get(lane, 0) + n
+            stale_refused += snap["stale"]["refused"]
+            linear_refused += snap["linearizable"]["refused"]
+        total = served["leader"] + served["follower"]
+        with self._lane_lock:
+            client = dict(self._lane_books)
+            ages = sorted(self._stale_ages_ms)
+
+        def q(p: float) -> float:
+            idx = min(len(ages) - 1, max(0, int(round(p * (len(ages) - 1)))))
+            return ages[idx]
+
+        return {
+            "enabled": True,
+            "members": len(members),
+            "served": served,
+            "by_lane": by_lane,
+            "follower_serve_share": (
+                round(served["follower"] / total, 4) if total else 0.0
+            ),
+            "stale_bound_ms": self._stale_bound_ms,
+            "stale_age_ms": (
+                {"n": len(ages), "p50": round(q(0.50), 2),
+                 "p95": round(q(0.95), 2), "max": round(ages[-1], 2)}
+                if ages else {"n": 0}
+            ),
+            "stale_refused": stale_refused,
+            "linear_refused": linear_refused,
+            "linear_reads": client["linear_reads"],
+            "linear_violations": client["linear_violations"],
+            "stamp_missing": client["stamp_missing"],
+            "client": client,
+        }
 
     def _profile_section(self, srv) -> Dict:
         """The runtime self-observatory's run report

@@ -493,7 +493,8 @@ class ReadFleetInjector(Injector):
 
     def __init__(self, seed: int, pollers: int = 4, watchers: int = 4,
                  sse_tails: int = 2, poll_interval: float = 0.2,
-                 start: float = 0.5, duration: float = 10.0):
+                 start: float = 0.5, duration: float = 10.0,
+                 max_stale_ms: float = 5000.0):
         super().__init__(seed)
         self.pollers = pollers
         self.watchers = watchers
@@ -501,6 +502,10 @@ class ReadFleetInjector(Injector):
         self.poll_interval = poll_interval
         self.start = start
         self.duration = duration
+        # Staleness bound the fleet's stale-lane opt-in carries
+        # (?stale=1&max_stale=) when the cell serves follower reads —
+        # the bound the artifact's stale-age-p95 gate is judged against.
+        self.max_stale_ms = max_stale_ms
 
     def actions(self) -> List[Action]:
         # Per-reader pacing jitter is drawn HERE, from the injector's
@@ -516,6 +521,7 @@ class ReadFleetInjector(Injector):
                 "sse_tails": self.sse_tails,
                 "poll_interval": self.poll_interval,
                 "poll_jitters": jitters,
+                "max_stale_ms": self.max_stale_ms,
                 "until": self.start + self.duration,
             },
         )]

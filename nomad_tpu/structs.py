@@ -138,6 +138,11 @@ REJECT_QUEUE_FULL = "QUEUE_FULL"      # acceptance queue at its cap
 REJECT_RATE_LIMITED = "RATE_LIMITED"  # per-client token-bucket lane empty
 REJECT_SHED = "SHED"                  # SLO-coupled load shedding
 REJECT_WATCH_LIMIT = "WATCH_LIMIT"    # blocking-query watcher cap reached
+# Stale-lane staleness bound exceeded: the serving follower's last leader
+# contact is older than the client's max_stale bound. Retriable by
+# construction — a read has no side effects and another server (or the
+# same one after its next heartbeat) can satisfy the bound.
+REJECT_STALE_BOUND = "STALE_BOUND"
 
 # The wire marker RejectError stringifies to. It must survive the RPC
 # error envelope (handlers' exceptions cross as "RejectError: <str(e)>"

@@ -505,3 +505,110 @@ def test_read_gate_rides_the_scan(tmp_path, monkeypatch):
     rec = next(r for r in logged if r["event"] == "read-gate")
     assert rec["ok"] is False
     assert rec["regressed"] == ["read_latency_p95_ms"]
+
+
+def _lanes_artifact(share=1.0, age_p95=700.0, bound=5000.0,
+                    violations=0, stamp_missing=0, members=3,
+                    plan_p50=950.0, contrast_p50=820.0, enabled=True):
+    """The r19+ read-storm shape: a lanes verdict section plus the
+    leader-only contrast arm's plan books."""
+    art = _artifact(attribution=False)
+    art["scenario"] = "read-storm"
+    art["plan_latency_ms"]["p50_ms"] = plan_p50
+    art["reads"] = {"enabled": enabled, "lanes": {
+        "enabled": enabled, "members": members,
+        "follower_serve_share": share, "stale_bound_ms": bound,
+        "stale_age_ms": {"n": 100, "p95": age_p95},
+        "linear_violations": violations, "stamp_missing": stamp_missing,
+    }}
+    art["contrast"] = {"plan_latency_ms": {"p50_ms": contrast_p50},
+                       "digest_matches": True,
+                       "reads": {"enabled": False,
+                                 "lanes": {"enabled": False}}}
+    return art
+
+
+def test_read_lane_gate_scoped_to_lane_carrying_artifacts():
+    """No lanes section (pre-r19 banks) or lanes disabled (the contrast
+    arm itself, single-member dev runs) → not this gate's business."""
+    assert bench_watch.read_lane_gate(_artifact()) is None
+    assert bench_watch.read_lane_gate(
+        _lanes_artifact(enabled=False)) is None
+
+
+def test_read_lane_gate_contract_rows():
+    """The four absolute lane-contract rows plus the plan-p50 ceiling:
+    a healthy r19-shaped artifact passes outright; each broken promise
+    flips exactly its own row."""
+    good = bench_watch.read_lane_gate(_lanes_artifact())
+    assert good["ok"] is True
+    assert [c["check"] for c in good["checks"]] == [
+        "follower_serve_share", "stale_age_p95_bound_ratio",
+        "linear_violations", "stamp_missing",
+        "leader_plan_p50_vs_contrast_ms"]
+
+    def regressed(art):
+        v = bench_watch.read_lane_gate(art)
+        return [c["check"] for c in v["checks"] if c["regressed"]]
+
+    assert regressed(_lanes_artifact(share=0.5)) \
+        == ["follower_serve_share"]
+    assert regressed(_lanes_artifact(age_p95=6000.0)) \
+        == ["stale_age_p95_bound_ratio"]
+    assert regressed(_lanes_artifact(violations=1)) \
+        == ["linear_violations"]
+    assert regressed(_lanes_artifact(stamp_missing=3)) \
+        == ["stamp_missing"]
+    # A single-member cell cannot route around the leader: the share
+    # row reports unjudged instead of failing a lane that cannot exist.
+    solo = bench_watch.read_lane_gate(_lanes_artifact(members=1))
+    share_row = next(c for c in solo["checks"]
+                     if c["check"] == "follower_serve_share")
+    assert share_row["regressed"] is False
+
+
+def test_read_lane_gate_plan_ceiling_is_cliff_scaled():
+    """The leader-relief row: plan p50 inside contrast*1.25 + 50ms
+    passes (the tolerance prices the observatory-ON main arm, measured
+    ~19% at r16/r19); a pile-up multiple fails it."""
+    inside = bench_watch.read_lane_gate(
+        _lanes_artifact(plan_p50=1000.0, contrast_p50=820.0))
+    assert inside["ok"] is True
+    cliff = bench_watch.read_lane_gate(
+        _lanes_artifact(plan_p50=2500.0, contrast_p50=820.0))
+    assert cliff["ok"] is False
+    assert [c["check"] for c in cliff["checks"] if c["regressed"]] \
+        == ["leader_plan_p50_vs_contrast_ms"]
+
+
+def test_topology_change_rebanks_the_family(tmp_path, monkeypatch):
+    """A round that changes the family's cell topology (read-storm went
+    single-member -> 3-member when the follower read plane landed) is
+    judged ABSOLUTELY against its declared objectives, never
+    newest-vs-previous across different machinery — and the re-bank is
+    logged, not silent."""
+    new_art = _lanes_artifact()
+    # Would regress 50%-relative vs the old bank, but meets the
+    # scenario's declared 5s replicated-cell bound.
+    new_art["plan_latency_ms"]["p95_ms"] = 3100.0
+    old_art = _artifact(attribution=False)
+    old_art["scenario"] = "read-storm"
+    old_art["plan_latency_ms"]["p95_ms"] = 300.0
+    new = tmp_path / "SIMLOAD_read-storm_s42_r19.json"
+    old = tmp_path / "SIMLOAD_read-storm_s42_r16.json"
+    new.write_text(json.dumps(new_art))
+    old.write_text(json.dumps(old_art))
+    monkeypatch.setattr(
+        bench_watch, "_banked_simload_pairs",
+        lambda: [("read-storm_s42", str(new), str(old))])
+    logged = []
+    ok = bench_watch.slo_gate_scan(
+        log=lambda event, **kw: logged.append({"event": event, **kw}))
+    assert ok is True
+    rebank = next(r for r in logged if r["event"] == "slo-gate-rebank")
+    assert rebank["new_members"] == 3
+    assert rebank["baseline_members"] == 1
+    gate = next(r for r in logged if r["event"] == "slo-gate")
+    assert gate["baseline"] == "<absolute>"
+    lane = next(r for r in logged if r["event"] == "read-lane-gate")
+    assert lane["ok"] is True

@@ -1,0 +1,532 @@
+"""Follower read plane tests (nomad_tpu/server/read_path.py).
+
+Covers the consistency-lane contract end to end: stale-lane bound
+enforcement and typed refusal, linearizable reads riding the leader
+read-index lease (including the deposed-leader safety argument), the
+forwarding audit's regression pin (a follower-served stale read makes
+ZERO leader RPCs), and the per-follower watch registry surviving
+snapshot installs and partition heals with its cap intact.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import faults, mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import ApiClient, QueryOptions
+from nomad_tpu.raft import NotLeaderError
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.blocking import blocking_query
+from nomad_tpu.server.cluster import form_cluster, wait_for_leader
+from nomad_tpu.server.read_path import (
+    LANE_DEFAULT,
+    LANE_LINEARIZABLE,
+    LANE_STALE,
+    ROLE_FOLLOWER,
+    ROLE_LEADER,
+    ReadPath,
+    ReadPathConfig,
+)
+from nomad_tpu.state.store import item_table
+from nomad_tpu.structs import (
+    REJECT_STALE_BOUND,
+    REJECT_WATCH_LIMIT,
+    RejectError,
+)
+
+from cluster_util import relaxed_cluster_cfg, retry_write
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.get_registry().clear()
+    yield
+    faults.get_registry().clear()
+
+
+@pytest.fixture
+def cluster3():
+    # Quiesce the heap first: a GC pause mid-election is a known stall
+    # source for in-process clusters (see tests/test_cluster.py).
+    import gc
+
+    gc.collect()
+    servers = form_cluster(
+        3,
+        ServerConfig(
+            scheduler_backend="host",
+            num_schedulers=1,
+            min_heartbeat_ttl=30.0,
+        ),
+        base_cluster=relaxed_cluster_cfg(),
+    )
+    yield servers
+    for srv in servers:
+        srv.shutdown()
+
+
+def _converged_follower(servers, leader, timeout: float = 20.0):
+    """A follower that has heard from the leader and whose applied index
+    has caught the leader's commit index."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        commit = leader.raft.commit_index
+        for f in servers:
+            if f is leader or f.raft.is_leader:
+                continue
+            if (
+                f.raft.last_contact_s() is not None
+                and f.raft.applied_index >= commit
+            ):
+                return f
+        time.sleep(0.02)
+    raise TimeoutError("no converged follower")
+
+
+# ---------------------------------------------------------------------------
+# Lane mechanics against a fake raft (fast, deterministic)
+# ---------------------------------------------------------------------------
+
+
+class _FakeRaft:
+    def __init__(self, is_leader=False, applied=7, contact_s=0.1):
+        self.is_leader = is_leader
+        self.applied_index = applied
+        self.contact_s = contact_s
+        self.config = None
+
+    def last_contact_s(self):
+        return self.contact_s
+
+
+class _FakeServer:
+    def __init__(self, raft, read_index=None):
+        self.raft = raft
+        self.read_index_result = read_index
+
+    def confirmed_read_index(self, timeout: float = 2.0):
+        if isinstance(self.read_index_result, Exception):
+            raise self.read_index_result
+        return self.read_index_result
+
+
+def test_config_parse_validation():
+    cfg = ReadPathConfig.parse(None)
+    assert cfg.enabled and cfg.default_max_stale_ms == 5000.0
+    cfg = ReadPathConfig.parse(
+        {"enabled": False, "default_max_stale_ms": 250}
+    )
+    assert not cfg.enabled and cfg.default_max_stale_ms == 250.0
+    with pytest.raises(ValueError, match="unknown read_path config key"):
+        ReadPathConfig.parse({"max_stale": 1})
+    with pytest.raises(ValueError, match="must be a mapping"):
+        ReadPathConfig.parse([1, 2])
+    for bad in (
+        {"default_max_stale_ms": 0},
+        {"read_index_timeout": -1},
+        {"apply_wait_timeout": 0},
+    ):
+        with pytest.raises(ValueError, match="must be > 0"):
+            ReadPathConfig.parse(bad)
+
+
+def test_disabled_read_path_degrades_every_lane_to_default():
+    # The contrast-arm posture: lanes OFF serves everything as default —
+    # no bound enforcement, no read-index round, no refusals.
+    rp = ReadPath(
+        _FakeServer(_FakeRaft(contact_s=999.0)),
+        ReadPathConfig(enabled=False),
+    )
+    for lane in (LANE_STALE, LANE_LINEARIZABLE, LANE_DEFAULT):
+        meta = rp.enter(lane, max_stale_ms=1.0)
+        assert meta["lane"] == LANE_DEFAULT
+    snap = rp.snapshot()
+    assert snap["served"][ROLE_FOLLOWER][LANE_DEFAULT] == 3
+    assert snap["stale"]["refused"] == 0
+    assert snap["linearizable"]["refused"] == 0
+
+
+def test_stale_bound_refusal_is_typed_and_retriable():
+    rp = ReadPath(_FakeServer(_FakeRaft(contact_s=1.2)))
+    # Within bound: served, age booked, headers carry the measured age.
+    meta = rp.enter(LANE_STALE, max_stale_ms=5000.0)
+    assert meta["role"] == ROLE_FOLLOWER
+    assert meta["last_contact_ms"] == pytest.approx(1200.0)
+    assert meta["applied_index"] == 7
+    # Past bound: typed retriable refusal with zero side effects.
+    with pytest.raises(RejectError) as ei:
+        rp.enter(LANE_STALE, max_stale_ms=500.0)
+    assert ei.value.reason == REJECT_STALE_BOUND
+    assert ei.value.retry_after > 0
+    # Never-contacted follower refuses ANY bound (age is unknowable).
+    rp2 = ReadPath(_FakeServer(_FakeRaft(contact_s=None)))
+    with pytest.raises(RejectError):
+        rp2.enter(LANE_STALE, max_stale_ms=10_000_000.0)
+    snap = rp.snapshot()
+    assert snap["stale"]["refused"] == 1
+    assert snap["served"][ROLE_FOLLOWER][LANE_STALE] == 1
+    assert snap["stale"]["age_ms"]["max"] == pytest.approx(1200.0)
+
+
+def test_linearizable_lane_waits_for_read_index():
+    # Applied already past the confirmed index: serves immediately and
+    # stamps the read index into the response material.
+    rp = ReadPath(_FakeServer(_FakeRaft(applied=7), read_index=5))
+    meta = rp.enter(LANE_LINEARIZABLE)
+    assert meta["read_index"] == 5
+    assert meta["applied_index"] >= meta["read_index"]
+    # No confirmable leadership anywhere: typed retriable refusal.
+    rp2 = ReadPath(
+        _FakeServer(_FakeRaft(), read_index=NotLeaderError(None))
+    )
+    with pytest.raises(RejectError) as ei:
+        rp2.enter(LANE_LINEARIZABLE)
+    assert ei.value.reason == REJECT_STALE_BOUND
+    assert rp2.snapshot()["linearizable"]["refused"] == 1
+    # Applied never catches the confirmed index inside the wait budget:
+    # refuse rather than serve a value older than the read point.
+    rp3 = ReadPath(
+        _FakeServer(_FakeRaft(applied=7), read_index=50),
+        ReadPathConfig(apply_wait_timeout=0.05),
+    )
+    with pytest.raises(RejectError):
+        rp3.enter(LANE_LINEARIZABLE)
+
+
+# ---------------------------------------------------------------------------
+# Forwarding audit: stale-lane reads never cross the wire
+# ---------------------------------------------------------------------------
+
+
+def _count_pool_calls(srv):
+    """Wrap srv.pool.call with a recording shim; returns the log."""
+    calls = []
+    orig = srv.pool.call
+
+    def recording(addr, method, args, **kw):
+        calls.append(method)
+        return orig(addr, method, args, **kw)
+
+    srv.pool.call = recording
+    return calls
+
+
+def test_stale_read_zero_leader_rpcs(cluster3):
+    # The forwarding-audit regression pin (server/cluster.py): a stale-
+    # lane read served by a follower is answered ENTIRELY from its local
+    # FSM — zero RPCs to the leader, before, during, or after.
+    leader = wait_for_leader(cluster3)
+    node = mock.node()
+    retry_write(lambda: leader.node_register(node))
+    follower = _converged_follower(cluster3, leader)
+
+    calls = _count_pool_calls(follower)
+    for _ in range(5):
+        meta = follower.read_path.enter(LANE_STALE, max_stale_ms=60_000.0)
+        got = follower.state_store.node_by_id(node.id)
+        assert got is not None and got.id == node.id
+        assert meta["role"] == ROLE_FOLLOWER
+        assert meta["applied_index"] > 0
+        assert meta["last_contact_ms"] is not None
+    assert calls == [], f"stale-lane read crossed the wire: {calls}"
+    snap = follower.read_path.snapshot()
+    assert snap["served"][ROLE_FOLLOWER][LANE_STALE] == 5
+
+    # Positive control: the LINEARIZABLE lane on the same follower rides
+    # exactly one forwarded Raft.ReadIndex — proof the counter works and
+    # the one sanctioned read-plane RPC is the read-index fetch.
+    meta = follower.read_path.enter(LANE_LINEARIZABLE)
+    assert "Raft.ReadIndex" in calls
+    assert meta["read_index"] > 0
+    assert meta["applied_index"] >= meta["read_index"]
+
+
+def test_leader_serves_linearizable_from_lease_without_log_write(cluster3):
+    leader = wait_for_leader(cluster3)
+    retry_write(lambda: leader.node_register(mock.node()))
+    # Let a heartbeat round land so the lease is warm.
+    time.sleep(leader.raft.config.heartbeat_interval * 3)
+    log_len_before = leader.raft.applied_index
+    commit_before = leader.raft.commit_index
+    meta = leader.read_path.enter(LANE_LINEARIZABLE)
+    assert meta["role"] == ROLE_LEADER
+    assert meta["read_index"] >= commit_before
+    # Lease-riding confirmation books at least one of lease-hit /
+    # quorum-confirm; the log grew by AT MOST the once-per-term barrier
+    # no-op (never one entry per read).
+    stats = leader.read_path.snapshot()["linearizable"]["read_index"]
+    assert stats["calls"] >= 1
+    assert stats["lease_hits"] + stats["quorum_confirms"] >= 1
+    for _ in range(10):
+        leader.read_path.enter(LANE_LINEARIZABLE)
+    assert leader.raft.applied_index <= log_len_before + 1
+
+
+# ---------------------------------------------------------------------------
+# Lease safety: a deposed leader cannot serve a linearizable read
+# ---------------------------------------------------------------------------
+
+
+def test_deposed_leader_cannot_serve_linearizable_read(cluster3):
+    leader = wait_for_leader(cluster3)
+    old_id = leader.cluster.node_id
+    retry_write(lambda: leader.node_register(mock.node()))
+
+    # Clock-skew guard first: the lease window is strictly inside the
+    # election timeout, so a fresh quorum provably predates any new
+    # leader's earliest possible election.
+    assert leader.raft.lease_window_s() < leader.raft.config.election_timeout_min
+
+    # Fully isolate the old leader (both directions, appends AND votes)
+    # without telling it: it keeps believing it leads while the majority
+    # moves on — the classic split-brain read hazard.
+    faults.get_registry().load({"sites": {
+        "raft.append": [
+            {"mode": "drop", "probability": 1.0, "match": f"{old_id}->"},
+            {"mode": "drop", "probability": 1.0, "match": f"->{old_id}"},
+        ],
+        "raft.vote": [
+            {"mode": "drop", "probability": 1.0, "match": f"{old_id}->"},
+            {"mode": "drop", "probability": 1.0, "match": f"->{old_id}"},
+        ],
+    }})
+    try:
+        # Majority side elects a new leader and commits in the new term.
+        majority = [s for s in cluster3 if s is not leader]
+        deadline = time.monotonic() + 30.0
+        new_leader = None
+        while time.monotonic() < deadline:
+            leaders = [s for s in majority if s.raft.is_leader]
+            if leaders:
+                new_leader = leaders[0]
+                break
+            time.sleep(0.05)
+        assert new_leader is not None, "majority side never elected"
+        retry_write(lambda: new_leader.node_register(mock.node()))
+        assert new_leader.raft.current_term > 0
+
+        # The deposed leader's lease has long expired (the new election
+        # alone outlasts it) and no quorum can confirm it: the
+        # linearizable lane must REFUSE, never answer from stale books.
+        with pytest.raises((NotLeaderError, TimeoutError)):
+            leader.raft.read_index(timeout=0.3)
+        with pytest.raises(RejectError) as ei:
+            ReadPath(leader).enter(LANE_LINEARIZABLE)
+        assert ei.value.reason == REJECT_STALE_BOUND
+        # The NEW leader serves: its index covers the new-term commit.
+        assert new_leader.raft.read_index() >= new_leader.raft.commit_index
+    finally:
+        faults.get_registry().clear()
+
+    # Partition heal: the old leader hears the higher term, steps down,
+    # and its linearizable lane works again (forwarded read index).
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if not leader.raft.is_leader and leader.raft.last_contact_s() is not None:
+            try:
+                meta = leader.read_path.enter(LANE_LINEARIZABLE)
+                assert meta["applied_index"] >= meta["read_index"]
+                break
+            except RejectError:
+                pass  # re-election still settling; retriable by contract
+        time.sleep(0.1)
+    else:
+        pytest.fail("old leader never rejoined the read plane")
+
+
+# ---------------------------------------------------------------------------
+# Per-follower watch registry: snapshot install, partition heal, caps
+# ---------------------------------------------------------------------------
+
+
+def test_follower_watch_wakes_across_snapshot_install():
+    srv = Server(ServerConfig(
+        scheduler_backend="host", max_blocking_watchers=8))
+    srv.start()
+    try:
+        srv.node_register(mock.node())
+        start_index = srv.fsm.state.get_index("nodes")
+        out = {}
+
+        def park():
+            idx, n = blocking_query(
+                get_store=lambda: srv.fsm.state,
+                items=lambda store: [item_table("nodes")],
+                run=lambda store: (
+                    store.get_index("nodes"), len(store.nodes())),
+                min_index=start_index,
+                timeout=8.0,
+            )
+            out["index"], out["nodes"] = idx, n
+
+        t = threading.Thread(target=park)
+        t.start()
+        time.sleep(0.3)  # let the watcher park
+        # Snapshot install rebinds fsm.state to a fresh store. The parked
+        # watcher must be woken by the old store's farewell notify and
+        # re-park on the NEW store — never sleep through the rebind.
+        srv.fsm.restore_bytes(srv.fsm.snapshot_bytes())
+        assert srv.fsm.state.watch.max_watchers == 8, \
+            "snapshot install silently unbounded the watcher cap"
+        time.sleep(0.2)
+        srv.node_register(mock.node())  # the write lands on the NEW store
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "watcher slept through the store rebind"
+        assert out["index"] > start_index
+        assert out["nodes"] == 2
+    finally:
+        srv.shutdown()
+
+
+def test_watcher_cap_is_per_server_not_global():
+    a = Server(ServerConfig(scheduler_backend="host",
+                            max_blocking_watchers=2))
+    b = Server(ServerConfig(scheduler_backend="host",
+                            max_blocking_watchers=2))
+    a.start()
+    b.start()
+    try:
+        wa, wb = a.fsm.state.watch, b.fsm.state.watch
+        t1 = wa.register([item_table("nodes")])
+        t2 = wa.register([item_table("jobs")])
+        with pytest.raises(RejectError) as ei:
+            wa.register([item_table("evals")])
+        assert ei.value.reason == REJECT_WATCH_LIMIT
+        assert ei.value.retry_after > 0
+        # Server B's registry is untouched by A's saturation: the cap is
+        # a per-server serving budget, not a fleet-global one.
+        t3 = wb.register([item_table("nodes")])
+        wa.unregister(t1)
+        wa.unregister(t2)
+        wb.unregister(t3)
+        # A freed slot admits again.
+        wa.unregister(wa.register([item_table("nodes")]))
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_follower_event_ring_gapless_after_partition_heal(cluster3):
+    # Per-follower watch/SSE serving rests on every member's OWN event
+    # ring carrying the same apply stream. Starve one follower behind a
+    # partition, write through the leader, heal — the follower's ring
+    # must converge to the identical, strictly-index-ordered sequence
+    # (the gapless-wake guarantee its blocking watchers ride).
+    leader = wait_for_leader(cluster3)
+    retry_write(lambda: leader.node_register(mock.node()))
+    follower = _converged_follower(cluster3, leader)
+    fid = follower.cluster.node_id
+    faults.get_registry().load({"sites": {
+        "raft.append": [
+            {"mode": "drop", "probability": 1.0, "match": f"->{fid}"},
+        ],
+        "raft.vote": [
+            {"mode": "drop", "probability": 1.0, "match": f"{fid}->"},
+        ],
+    }})
+    try:
+        for _ in range(4):
+            retry_write(lambda: leader.node_register(mock.node()))
+    finally:
+        faults.get_registry().clear()
+
+    # Heal: wait for a settled leader (the starved follower may force a
+    # re-election with its bumped term) and full convergence.
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        leaders = [s for s in cluster3 if s.raft.is_leader]
+        if len(leaders) == 1:
+            settled = leaders[0]
+            commit = settled.raft.commit_index
+            if all(s.raft.applied_index >= commit for s in cluster3):
+                break
+        time.sleep(0.05)
+    else:
+        pytest.fail("cluster never converged after heal")
+
+    # Each member's ring also carries its own LOCAL events (Leader
+    # acquisitions/losses), so rings are not byte-identical — but the
+    # REPLICATED apply stream (here: Node registrations) must be, in
+    # order, on every member.
+    def apply_stream(srv):
+        return [
+            (e.topic, e.type, e.key)
+            for e in srv.fsm.events.all_events()
+            if e.topic == "Node"
+        ]
+
+    assert apply_stream(follower) == apply_stream(settled)
+    assert len(apply_stream(follower)) >= 5  # partition-era writes made it
+    indexes = [e.index for e in follower.fsm.events.all_events()]
+    assert indexes == sorted(indexes)  # gapless, index-ordered wakes
+    # Resuming from before the ring head is honest about completeness.
+    latest, evs, truncated = follower.fsm.events.events_after(
+        indexes[0] - 1)
+    assert not truncated and [e.index for e in evs] == indexes
+
+
+# ---------------------------------------------------------------------------
+# HTTP + SDK integration (DevMode agent)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def dev_agent(tmp_path_factory):
+    config = AgentConfig.dev()
+    config.data_dir = str(tmp_path_factory.mktemp("read_path_agent"))
+    config.http_port = 0
+    config.scheduler_backend = "host"
+    a = Agent(config)
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def test_http_stamps_freshness_headers_per_lane(dev_agent):
+    client = ApiClient(address=dev_agent.http.addr)
+    # Default lane: applied index + contact age on every read.
+    _, meta = client.nodes().list()
+    assert meta.applied_index >= 0
+    assert meta.read_index == 0  # not a linearizable response
+    # Stale lane: opt-in with bound, same stamps.
+    _, meta = client.nodes().list(
+        q=QueryOptions(allow_stale=True, max_stale_ms=5000.0))
+    assert meta.applied_index >= 0
+    assert meta.last_contact == 0.0  # DevMode single node IS the leader
+    # Linearizable lane: the confirmed read index rides the response and
+    # nothing older than it was served.
+    _, meta = client.nodes().list(q=QueryOptions(consistent=True))
+    assert meta.read_index >= 0
+    assert meta.applied_index >= meta.read_index
+    books = dev_agent.server.read_path.snapshot()
+    assert books["served"][ROLE_LEADER][LANE_LINEARIZABLE] >= 1
+    assert books["served"][ROLE_LEADER][LANE_STALE] >= 1
+
+
+def test_http_stale_bound_refusal_maps_to_typed_429(dev_agent):
+    rp = dev_agent.server.read_path
+    orig = rp.last_contact_ms
+    rp.last_contact_ms = lambda: 9999.0  # pretend we are a lagged follower
+    try:
+        client = ApiClient(address=dev_agent.http.addr)
+        with pytest.raises(RejectError) as ei:
+            client.nodes().list(
+                q=QueryOptions(allow_stale=True, max_stale_ms=100.0))
+        assert ei.value.reason == REJECT_STALE_BOUND
+        assert ei.value.retry_after > 0
+    finally:
+        rp.last_contact_ms = orig
+    assert rp.snapshot()["stale"]["refused"] >= 1
+
+
+def test_sdk_client_level_stale_default(dev_agent):
+    # allow_stale on the CLIENT makes every bare query ride the stale
+    # lane with the client-wide bound — no per-call QueryOptions needed.
+    client = ApiClient(address=dev_agent.http.addr, allow_stale=True,
+                      max_stale_ms=2500.0)
+    _, meta = client.jobs().list()
+    assert meta.applied_index >= 0
+    books = dev_agent.server.read_path.snapshot()
+    assert books["served"][ROLE_LEADER][LANE_STALE] >= 1

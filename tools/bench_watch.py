@@ -512,6 +512,56 @@ def read_gate(new_artifact: dict, baseline_artifact: dict | None,
     return {"ok": ok, "tolerance": tolerance, "checks": checks}
 
 
+# Read-lane gate: the consistency-lane contract checks on an artifact's
+# ``reads.lanes`` section (nomad_tpu/server/read_path.py; objective
+# vocabulary in slo.READ_LANE_OBJECTIVES). Mostly ABSOLUTE per-run
+# invariants — stale age p95 inside the client bound, follower serve
+# share >= the floor, zero linearizable violations / missing stamps —
+# plus one main-vs-contrast row: with followers serving, the leader's
+# plan p50 must stay within tolerance of the leader-only contrast arm
+# (the read plane must relieve the leader, never tax the write path).
+# The tolerance is CLIFF-scaled, not noise-scaled: the contrast arm
+# doubles as the digest-invariance proof, so it runs observatory-OFF,
+# and the observatory itself prices ~19% of plan p50 on this box (r16
+# leader-only: 137.5 vs 116.0; r19 follower-serving: 968.8 vs 814.1 —
+# the SAME ratio, i.e. the follower plane adds nothing on top). The row
+# exists to catch the leader-pile-up cliff (multiples of contrast when
+# read serving lands on the write path), so the bar sits above the
+# measured observatory cost but far below any pile-up. The absolute
+# slack covers sub-150ms p50s riding box scheduling noise.
+READ_LANE_PLAN_TOLERANCE = 0.25
+READ_LANE_PLAN_SLACK_MS = 50.0
+
+
+def read_lane_gate(new_artifact: dict) -> dict | None:
+    """Gate a read-lane-carrying artifact (reads.lanes present and
+    enabled; the r19+ read-storm shape). Self-contained per run: rows
+    come from slo.evaluate_read_lanes plus the contrast plan-p50
+    comparison against the artifact's OWN leader-only arm — no banked
+    baseline needed, so the contract binds from the first round."""
+    from nomad_tpu.slo import evaluate_read_lanes
+
+    rows = evaluate_read_lanes(new_artifact)
+    if not rows:
+        return None
+    checks = [{"check": r["objective"], "value": r["observed"],
+               "threshold": r["threshold"],
+               "regressed": r["met"] is False} for r in rows]
+    main_p50 = (new_artifact.get("plan_latency_ms") or {}).get("p50_ms")
+    contrast = new_artifact.get("contrast") or {}
+    contrast_p50 = (contrast.get("plan_latency_ms") or {}).get("p50_ms")
+    if main_p50 is not None and contrast_p50 is not None:
+        ceiling = (contrast_p50 * (1.0 + READ_LANE_PLAN_TOLERANCE)
+                   + READ_LANE_PLAN_SLACK_MS)
+        checks.append({
+            "check": "leader_plan_p50_vs_contrast_ms",
+            "value": main_p50, "threshold": round(ceiling, 2),
+            "regressed": main_p50 > ceiling,
+        })
+    ok = not any(c["regressed"] for c in checks)
+    return {"ok": ok, "checks": checks}
+
+
 # Runtime-gate tolerance: RSS rides allocator noise and per-row mirror
 # bytes only move when buffer/dtype layout changes, so the bar is loose
 # — it exists to catch a real footprint regression (a new per-row
@@ -624,18 +674,45 @@ def chaos_gate(new_artifact: dict, baseline_artifact: dict | None,
     return {"ok": ok, "tolerance": tolerance, "checks": checks}
 
 
+def _cell_members(artifact: dict) -> int:
+    """Cluster size the artifact's wall-clock numbers were measured on.
+    The lanes section carries it explicitly (r19+); pre-lane artifacts
+    are single-member cells."""
+    lanes = ((artifact.get("reads") or {}).get("lanes") or {})
+    try:
+        return int(lanes.get("members") or 1)
+    except (TypeError, ValueError):
+        return 1
+
+
 def slo_gate_scan(log=log) -> bool:
     """Run the SLO gate over every banked artifact family: newest-vs-
     previous where a prior round exists, absolute-against-objectives for
     first-round families; log one verdict per family. Families whose
     artifacts carry the solver-panel window additionally gate on the
-    device-solve economy (solver_gate). Returns overall pass."""
+    device-solve economy (solver_gate). A round that changes the
+    family's CELL TOPOLOGY (single-member -> replicated cell, as
+    read-storm did when the follower read plane landed) re-banks: its
+    wall-clock numbers are measured on different machinery than the
+    prior round's, so the newest-vs-previous comparison is
+    apples-to-oranges and the family is judged absolutely against its
+    declared objectives instead — logged, never silent. Returns overall
+    pass."""
     ok = True
     for fam, new_path, base_path in _banked_simload_pairs():
         try:
             with open(new_path) as f:
                 new = json.load(f)
             objectives = _objectives_for(new)
+            if base_path is not None:
+                with open(base_path) as f:
+                    base_probe = json.load(f)
+                if _cell_members(new) != _cell_members(base_probe):
+                    log("slo-gate-rebank", family=fam,
+                        new_members=_cell_members(new),
+                        baseline_members=_cell_members(base_probe),
+                        baseline=os.path.basename(base_path))
+                    base_path = None
             if base_path is None:
                 verdict = slo_gate_absolute(new, objectives)
                 solver_verdict = None
@@ -680,6 +757,12 @@ def slo_gate_scan(log=log) -> bool:
                 regressed=[c["check"] for c in read_verdict["checks"]
                            if c["regressed"]])
             ok = ok and read_verdict["ok"]
+        lane_verdict = read_lane_gate(new)
+        if lane_verdict is not None:
+            log("read-lane-gate", family=fam, ok=lane_verdict["ok"],
+                regressed=[c["check"] for c in lane_verdict["checks"]
+                           if c["regressed"]])
+            ok = ok and lane_verdict["ok"]
         if runtime_verdict is not None:
             log("runtime-gate", family=fam, ok=runtime_verdict["ok"],
                 regressed=[c["check"] for c in runtime_verdict["checks"]
